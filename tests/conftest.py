@@ -1,0 +1,51 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+The env vars must be set before jax is first imported anywhere, which is
+why they live at module top here (pytest imports conftest first). This is
+the portable substitute for a real TPU pod slice (SURVEY.md section 4.4):
+island/migration tests assert topology on the fake devices, and kernels are
+dtype/shape-identical to the TPU path.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from timetabling_ga_tpu.problem import random_instance  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tiny_problem():
+    """4 events, 2 rooms — hand-checkable."""
+    return random_instance(0, n_events=4, n_rooms=2, n_features=2,
+                           n_students=5, attend_prob=0.5)
+
+
+@pytest.fixture(scope="session")
+def small_problem():
+    """A small but non-trivial instance."""
+    return random_instance(1, n_events=30, n_rooms=4, n_features=3,
+                           n_students=20, attend_prob=0.15)
+
+
+@pytest.fixture(scope="session")
+def medium_problem():
+    return random_instance(2, n_events=80, n_rooms=8, n_features=5,
+                           n_students=60, attend_prob=0.08)
+
+
+def random_assignment(rng, problem, n):
+    """Uniformly random (slots, rooms) population, like
+    RandomInitialSolution before room matching (Solution.cpp:48-55)."""
+    slots = rng.integers(0, problem.n_slots,
+                         size=(n, problem.n_events)).astype(np.int32)
+    rooms = rng.integers(0, problem.n_rooms,
+                         size=(n, problem.n_events)).astype(np.int32)
+    return slots, rooms

@@ -1,0 +1,30 @@
+"""timetabling_ga_tpu — a TPU-native memetic-GA framework for university
+course timetabling (UCTP, Metaheuristics-Network `.tim` formulation).
+
+Re-designed from scratch for TPU (JAX/XLA) with the capabilities of the
+reference MPI+OpenMP C++ solver (nelilepo/timetabling-ga-mpi-openmp):
+
+- Population lives on-device as dense int32 tensors ``(P, E)`` slots/rooms
+  (reference: ``vector<pair<int,int>>`` per Solution, Solution.h:36).
+- Fitness (hard/soft constraint violations) is one jit+vmap tensor program
+  whose inner contractions ride the MXU (reference: O(E^2) scalar loops,
+  Solution.cpp:63-170).
+- Room assignment is a fixed-iteration parallel priority matching over the
+  (timeslot, room) grid (reference: per-slot augmenting-path max matching
+  with greedy fallback, Solution.cpp:772-891).
+- Local search is a batched K-candidate hill climb under ``lax.scan``
+  (reference: sequential first-improvement sweeps, Solution.cpp:471-769).
+- The MPI island model becomes a mesh axis: ``shard_map`` over ``island``,
+  bidirectional ring migration via ``lax.ppermute``, global best via
+  ``pmin`` (reference: MPI_Sendrecv ring + MPI_Allreduce, ga.cpp:479-541).
+"""
+
+from timetabling_ga_tpu.problem import Problem, load_tim, load_tim_file
+from timetabling_ga_tpu.ops.fitness import (
+    compute_hcv,
+    compute_scv,
+    compute_penalty,
+    batch_penalty,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,163 @@
+"""Batched fitness evaluation: hard/soft constraint violations as one
+jit+vmap tensor program.
+
+This is the TPU-native redesign of the reference's scalar evaluation loops
+(Solution::computeHcv Solution.cpp:141-160, Solution::computeScv 86-139,
+Solution::computeFeasibility 63-84, Solution::computePenalty 162-170).
+Where the reference walks O(E^2) event pairs per solution, the kernels here
+express the same counts as dense contractions over one-hot occupancy
+tensors so XLA tiles them onto the MXU and a whole population is evaluated
+in one launch:
+
+  room/slot clash pairs : occupancy counts n[t, r] via (T,E)x(E,R) matmul,
+                          then sum n(n-1)/2
+  correlated-slot pairs : einsum('te,ef,tf->', X, C, X) with the diagonal
+                          removed, X = slot one-hot (T, E), C = conflict
+  unsuitable rooms      : one gather per event
+  soft constraints      : per-(student, slot) attendance A = attends @ X^T,
+                          then window products for runs-of-3, per-day sums
+                          for single-class days, masked sums for last-slot
+
+All operands are 0/1-valued float32, so counts are exact (<< 2^24).
+Every public function evaluates ONE individual `(E,)`; `batch_*` wrappers
+vmap over a population axis.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Penalty encoding (reference Solution.cpp:167 and ga.cpp:191):
+INFEASIBLE_OFFSET = 1_000_000
+
+
+def slot_onehot(slots: jnp.ndarray, n_slots: int) -> jnp.ndarray:
+    """(E,) int32 -> (T, E) float32 one-hot of event timeslots."""
+    return (slots[None, :] == jnp.arange(n_slots, dtype=slots.dtype)[:, None]
+            ).astype(jnp.float32)
+
+
+def room_onehot(rooms: jnp.ndarray, n_rooms: int) -> jnp.ndarray:
+    """(E,) int32 -> (R, E) float32 one-hot of event rooms."""
+    return (rooms[None, :] == jnp.arange(n_rooms, dtype=rooms.dtype)[:, None]
+            ).astype(jnp.float32)
+
+
+def compute_hcv(pa, slots: jnp.ndarray, rooms: jnp.ndarray) -> jnp.ndarray:
+    """Hard-constraint violations of one individual (int32 scalar).
+
+    Exact count parity with Solution::computeHcv (Solution.cpp:141-160).
+    """
+    T = pa.n_slots
+    R = pa.n_rooms
+    X = slot_onehot(slots, T)                      # (T, E)
+    Y = room_onehot(rooms, R)                      # (R, E)
+
+    # (a) events sharing (slot, room): occupancy n[t, r], pairs = C(n, 2)
+    occ = X @ Y.T                                   # (T, R) counts, MXU
+    pair_clash = jnp.sum(occ * (occ - 1.0)) * 0.5
+
+    # (b) correlated events sharing a slot: sum_t x_t^T C x_t counts each
+    # unordered pair twice and each event once on the diagonal (an event is
+    # in exactly one slot and C[e,e]=1 iff the event has students).
+    cx = pa.conflict @ X.T                          # (E, T), MXU
+    full = jnp.sum(X.T * cx)
+    diag = jnp.sum(jnp.diagonal(pa.conflict))
+    corr_pairs = (full - diag) * 0.5
+
+    # (c) event in unsuitable room
+    unsuitable = jnp.sum(~pa.possible[jnp.arange(slots.shape[0]), rooms])
+
+    return (pair_clash + corr_pairs).astype(jnp.int32) + unsuitable.astype(
+        jnp.int32)
+
+
+def attendance_matrix(pa, slots: jnp.ndarray) -> jnp.ndarray:
+    """Per-(student, slot) attended-event counts A (S, T) float32.
+
+    A = attends @ X^T — the big MXU contraction shared by all soft
+    constraints; kept public so the local search can rank-1-update it.
+    """
+    X = slot_onehot(slots, pa.n_slots)              # (T, E)
+    return pa.attends @ X.T                         # (S, T)
+
+
+def scv_from_attendance(pa, slots: jnp.ndarray,
+                        att: jnp.ndarray) -> jnp.ndarray:
+    """Soft-constraint violations given the attendance count matrix.
+
+    Semantics of Solution::computeScv (Solution.cpp:86-139); attendance is
+    binarized (B = A > 0) exactly as the reference's per-slot early-exit
+    event scan does (Solution.cpp:105-114).
+    """
+    spd = pa.slots_per_day
+    D = pa.n_days
+
+    # (a) class in last slot of day: studentNumber[e] per offending event
+    last = jnp.sum(jnp.where(slots % spd == spd - 1, pa.student_count, 0))
+
+    B = (att > 0).reshape(att.shape[0], D, spd)     # (S, D, spd) bool
+
+    # (b) each attended slot that is the >=3rd consecutive within a day
+    consec = jnp.sum((B[:, :, 2:] & B[:, :, 1:-1] & B[:, :, :-2]
+                      ).astype(jnp.int32))
+
+    # (c) exactly one attended slot in a day
+    single = jnp.sum((B.sum(axis=2) == 1).astype(jnp.int32))
+
+    return last.astype(jnp.int32) + consec + single
+
+
+def compute_scv(pa, slots: jnp.ndarray) -> jnp.ndarray:
+    """Soft-constraint violations of one individual (int32 scalar)."""
+    return scv_from_attendance(pa, slots, attendance_matrix(pa, slots))
+
+
+def compute_feasible(pa, slots, rooms) -> jnp.ndarray:
+    """feasible <=> hcv == 0 (Solution.cpp:63-84 checks the same three
+    conditions with early exit)."""
+    return compute_hcv(pa, slots, rooms) == 0
+
+
+def compute_penalty(pa, slots, rooms):
+    """Internal selection penalty (Solution.cpp:162-170):
+    scv if feasible else 1_000_000 + hcv.
+
+    Returns (penalty, hcv, scv) — callers almost always want the parts too.
+    """
+    hcv = compute_hcv(pa, slots, rooms)
+    scv = compute_scv(pa, slots)
+    penalty = jnp.where(hcv == 0, scv, INFEASIBLE_OFFSET + hcv)
+    return penalty, hcv, scv
+
+
+def reported_evaluation(hcv, scv) -> int:
+    """The evaluation the JSONL log reports for infeasible solutions:
+    hcv * 1e6 + scv (ga.cpp:191, 218, 247). Host-side only: forced to
+    Python ints so it cannot wrap int32 (hcv >= 2148 would overflow)."""
+    return int(hcv) * INFEASIBLE_OFFSET + int(scv)
+
+
+# ---------------------------------------------------------------------------
+# Batched (population) forms
+
+
+@functools.partial(jax.jit, static_argnames=())
+def batch_penalty(pa, slots, rooms):
+    """Evaluate a whole population: slots/rooms (P, E) -> (P,) x3."""
+    return jax.vmap(lambda s, r: compute_penalty(pa, s, r))(slots, rooms)
+
+
+def batch_hcv(pa, slots, rooms):
+    return jax.vmap(lambda s, r: compute_hcv(pa, s, r))(slots, rooms)
+
+
+def batch_scv(pa, slots):
+    return jax.vmap(lambda s: compute_scv(pa, s))(slots)
+
+
+def batch_feasible(pa, slots, rooms):
+    return jax.vmap(lambda s, r: compute_feasible(pa, s, r))(slots, rooms)

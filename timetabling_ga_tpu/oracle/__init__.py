@@ -1,0 +1,8 @@
+from timetabling_ga_tpu.oracle.reference_oracle import (
+    oracle_hcv,
+    oracle_scv,
+    oracle_feasible,
+    oracle_penalty,
+    oracle_reported_evaluation,
+    ParkMillerLCG,
+)

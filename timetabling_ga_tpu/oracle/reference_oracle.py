@@ -1,0 +1,127 @@
+"""Scalar Python oracle implementing the reference's fitness semantics.
+
+This module is deliberately slow and literal: it transcribes the *meaning*
+of the reference's evaluation routines (Solution.cpp:63-170) so the batched
+TPU kernels can be tested for exact integer equality against it. It is used
+only by tests and never on the hot path.
+
+The reference has no tests (SURVEY.md section 4); this oracle is the
+ground-truth half of the test strategy built to replace that gap.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def oracle_hcv(problem, slots, rooms) -> int:
+    """Hard-constraint violations of one solution.
+
+    Semantics of Solution::computeHcv (Solution.cpp:141-160):
+      (a) +1 for each unordered pair of events sharing (timeslot, room)
+      (b) +1 for each unordered pair of correlated events sharing a timeslot
+      (c) +1 for each event placed in an unsuitable room
+    """
+    e = problem.n_events
+    hcv = 0
+    for i in range(e):
+        for j in range(i + 1, e):
+            if slots[i] == slots[j] and rooms[i] == rooms[j]:
+                hcv += 1
+            if slots[i] == slots[j] and problem.conflict[i][j]:
+                hcv += 1
+        if not problem.possible[i][rooms[i]]:
+            hcv += 1
+    return hcv
+
+
+def oracle_feasible(problem, slots, rooms) -> bool:
+    """Solution::computeFeasibility (Solution.cpp:63-84): hcv == 0."""
+    return oracle_hcv(problem, slots, rooms) == 0
+
+
+def oracle_scv(problem, slots, rooms=None) -> int:
+    """Soft-constraint violations of one solution.
+
+    Semantics of Solution::computeScv (Solution.cpp:86-139):
+      (a) class in the last slot of a day: +studentNumber[e] per event
+      (b) per student: each class that is the >=3rd consecutive attended
+          slot within one day: +1 ("more than two in a row")
+      (c) per student per day with exactly one attended slot: +1
+
+    Attendance per (student, slot) is binary: the reference breaks out of
+    its event scan after the first attended event in the slot
+    (Solution.cpp:105-114), so double-booked slots still count once.
+    """
+    spd = problem.slots_per_day
+    n_slots = problem.n_days * spd
+    scv = 0
+    for i in range(problem.n_events):
+        if slots[i] % spd == spd - 1:
+            scv += int(problem.student_count[i])
+
+    # binary attendance matrix (student, slot)
+    att = np.zeros((problem.n_students, n_slots), dtype=bool)
+    for e in range(problem.n_events):
+        t = int(slots[e])
+        for s in range(problem.n_students):
+            if problem.attends[s][e]:
+                att[s, t] = True
+
+    for s in range(problem.n_students):
+        consecutive = 0
+        for t in range(n_slots):
+            if t % spd == 0:
+                consecutive = 0
+            if att[s, t]:
+                consecutive += 1
+                if consecutive > 2:
+                    scv += 1
+            else:
+                consecutive = 0
+        for d in range(problem.n_days):
+            day = att[s, d * spd:(d + 1) * spd]
+            if day.sum() == 1:
+                scv += 1
+    return scv
+
+
+def oracle_penalty(problem, slots, rooms) -> int:
+    """Solution::computePenalty (Solution.cpp:162-170):
+    scv if feasible else 1_000_000 + hcv."""
+    h = oracle_hcv(problem, slots, rooms)
+    if h == 0:
+        return oracle_scv(problem, slots, rooms)
+    return 1_000_000 + h
+
+
+def oracle_reported_evaluation(problem, slots, rooms) -> int:
+    """The *reported* evaluation used by the JSONL log for infeasible
+    solutions: hcv * 1_000_000 + scv (ga.cpp:191, 218, 247). Note this
+    differs from the internal penalty formula — both are kept."""
+    return (oracle_hcv(problem, slots, rooms) * 1_000_000
+            + oracle_scv(problem, slots, rooms))
+
+
+class ParkMillerLCG:
+    """Park-Miller minimal-standard LCG with Schrage's trick.
+
+    Host-side oracle for the reference RNG (Random.cc:27-37,
+    IA=16807 IM=2^31-1 IQ=127773 IR=2836). The TPU framework uses
+    threefry keys (jax.random) — bit-parity with this generator under
+    vmap is impossible and not a goal; this exists so golden tests can
+    reproduce reference-side random choices when needed.
+    """
+
+    IA, IM, IQ, IR = 16807, 2147483647, 127773, 2836
+    AM = 1.0 / 2147483647
+
+    def __init__(self, seed: int):
+        self.seed = int(seed)
+
+    def next(self) -> float:
+        k = self.seed // self.IQ
+        self.seed = self.IA * (self.seed - k * self.IQ) - self.IR * k
+        if self.seed < 0:
+            self.seed += self.IM
+        return self.AM * self.seed

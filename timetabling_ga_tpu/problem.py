@@ -1,0 +1,233 @@
+"""Problem model and `.tim` instance loader.
+
+Capability parity with the reference loader (Problem.cpp:3-96), re-designed
+for device residency: instead of ragged C arrays the instance becomes a set
+of packed numpy/jnp tensors that are uploaded once and stay in HBM.
+
+`.tim` format (Metaheuristics-Network / ITC-2002):
+
+    E R F S                      header (events, rooms, features, students)
+    <R ints>                     room sizes
+    <S*E 0/1 ints>               student-event attendance, student-major
+    <R*F 0/1 ints>               room features
+    <E*F 0/1 ints>               event feature requirements
+
+Derived data (reference Problem.cpp:34-95):
+    student_count[e]   = column sums of attendance
+    conflict[i, j]     = events i, j share >= 1 student  (eventCorrelations)
+    possible[e, r]     = roomSize[r] >= student_count[e] and the room
+                         satisfies every feature the event requires
+
+The timeslot grid is parametrized (n_days x slots_per_day) instead of the
+reference's hard-wired 45 = 5 x 9 (Solution.cpp:52, 57, 100).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DAYS_DEFAULT = 5
+SLOTS_PER_DAY_DEFAULT = 9
+
+
+@dataclasses.dataclass(frozen=True)
+class Problem:
+    """A timetabling instance, packed as dense arrays.
+
+    All arrays are host numpy; ``device_arrays()`` returns the jnp copies
+    used by the kernels. Frozen: an instance never changes after load.
+    """
+
+    n_events: int
+    n_rooms: int
+    n_features: int
+    n_students: int
+    room_size: np.ndarray      # (R,)    int32
+    attends: np.ndarray        # (S, E)  int8   student-event attendance
+    room_features: np.ndarray  # (R, F)  int8
+    event_features: np.ndarray  # (E, F) int8
+    # derived
+    student_count: np.ndarray  # (E,)    int32
+    conflict: np.ndarray       # (E, E)  bool   shared-student correlation
+    possible: np.ndarray       # (E, R)  bool   room suitability
+    n_days: int = DAYS_DEFAULT
+    slots_per_day: int = SLOTS_PER_DAY_DEFAULT
+
+    @property
+    def n_slots(self) -> int:
+        return self.n_days * self.slots_per_day
+
+    def device_arrays(self):
+        """Upload the kernel-facing arrays to the default device once.
+
+        Returns a ``ProblemArrays`` pytree (jnp arrays) that every kernel
+        takes as its first argument — the analogue of the reference's
+        ``Problem*`` held by each Solution (Solution.h:38), except the data
+        is replicated into HBM instead of chased through host pointers.
+        """
+        return ProblemArrays(
+            attends=jnp.asarray(self.attends, dtype=jnp.float32),
+            conflict=jnp.asarray(self.conflict, dtype=jnp.float32),
+            possible=jnp.asarray(self.possible, dtype=jnp.bool_),
+            student_count=jnp.asarray(self.student_count, dtype=jnp.int32),
+            n_days=self.n_days,
+            slots_per_day=self.slots_per_day,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ProblemArrays:
+    """Device-resident view of a Problem (a pytree of jnp arrays).
+
+    ``attends`` and ``conflict`` are float32 so the fitness contractions
+    lower straight onto the MXU; all values are exact small integers so
+    float accumulation is bit-exact (counts << 2^24).
+    """
+
+    attends: "object"        # (S, E) f32
+    conflict: "object"       # (E, E) f32, diagonal = event has >=1 student
+    possible: "object"       # (E, R) bool
+    student_count: "object"  # (E,)   i32
+    n_days: int
+    slots_per_day: int
+
+    @property
+    def n_slots(self) -> int:
+        return self.n_days * self.slots_per_day
+
+    @property
+    def n_events(self) -> int:
+        return self.possible.shape[0]
+
+    @property
+    def n_rooms(self) -> int:
+        return self.possible.shape[1]
+
+
+# Register ProblemArrays as a pytree with static day/slot geometry.
+def _pa_flatten(pa: ProblemArrays):
+    children = (pa.attends, pa.conflict, pa.possible, pa.student_count)
+    aux = (pa.n_days, pa.slots_per_day)
+    return children, aux
+
+
+def _pa_unflatten(aux, children):
+    attends, conflict, possible, student_count = children
+    n_days, slots_per_day = aux
+    return ProblemArrays(attends, conflict, possible, student_count,
+                         n_days, slots_per_day)
+
+
+jax.tree_util.register_pytree_node(ProblemArrays, _pa_flatten, _pa_unflatten)
+
+
+def derive(n_events: int, n_rooms: int, n_features: int, n_students: int,
+           room_size: np.ndarray, attends: np.ndarray,
+           room_features: np.ndarray, event_features: np.ndarray,
+           n_days: int = DAYS_DEFAULT,
+           slots_per_day: int = SLOTS_PER_DAY_DEFAULT) -> Problem:
+    """Build a Problem from raw arrays, computing the derived matrices.
+
+    Vectorized equivalents of the reference's triple loops:
+    - conflict:  attends.T @ attends > 0   (Problem.cpp:49-58 O(E^2*S) loop)
+    - possible:  size-fits AND features-subset (Problem.cpp:83-95)
+    """
+    attends = np.asarray(attends, dtype=np.int8)
+    room_size = np.asarray(room_size, dtype=np.int32)
+    room_features = np.asarray(room_features, dtype=np.int8)
+    event_features = np.asarray(event_features, dtype=np.int8)
+
+    student_count = attends.astype(np.int64).sum(axis=0).astype(np.int32)
+    conflict = (attends.astype(np.int32).T @ attends.astype(np.int32)) > 0
+
+    size_ok = room_size[None, :] >= student_count[:, None]          # (E, R)
+    # event needs feature f and room lacks it -> unsuitable
+    missing = (event_features.astype(np.int32)[:, None, :]
+               * (1 - room_features.astype(np.int32))[None, :, :]).sum(-1)
+    possible = size_ok & (missing == 0)
+
+    return Problem(
+        n_events=n_events, n_rooms=n_rooms, n_features=n_features,
+        n_students=n_students, room_size=room_size, attends=attends,
+        room_features=room_features, event_features=event_features,
+        student_count=student_count, conflict=conflict, possible=possible,
+        n_days=n_days, slots_per_day=slots_per_day,
+    )
+
+
+def load_tim(source: Union[str, io.TextIOBase],
+             n_days: int = DAYS_DEFAULT,
+             slots_per_day: int = SLOTS_PER_DAY_DEFAULT) -> Problem:
+    """Parse a `.tim` instance from a string or text stream.
+
+    Whitespace-insensitive token stream, like the reference's
+    ``ifs >>`` parsing (Problem.cpp:7-74).
+    """
+    if isinstance(source, str):
+        text = source
+    else:
+        text = source.read()
+    tokens = np.array(text.split(), dtype=np.int64)
+    pos = 0
+
+    def take(n):
+        nonlocal pos
+        out = tokens[pos:pos + n]
+        if out.size != n:
+            raise ValueError(
+                f"truncated .tim instance: wanted {n} tokens at {pos}, "
+                f"got {out.size}")
+        pos += n
+        return out
+
+    e, r, f, s = (int(x) for x in take(4))
+    room_size = take(r).astype(np.int32)
+    attends = take(s * e).reshape(s, e).astype(np.int8)
+    room_features = take(r * f).reshape(r, f).astype(np.int8)
+    event_features = take(e * f).reshape(e, f).astype(np.int8)
+    if pos != tokens.size:
+        raise ValueError(
+            f".tim instance has {tokens.size - pos} trailing tokens")
+    return derive(e, r, f, s, room_size, attends, room_features,
+                  event_features, n_days=n_days, slots_per_day=slots_per_day)
+
+
+def load_tim_file(path: str, **kw) -> Problem:
+    with open(path, "r") as fh:
+        return load_tim(fh, **kw)
+
+
+def random_instance(key_or_seed, n_events: int, n_rooms: int,
+                    n_features: int, n_students: int,
+                    attend_prob: float = 0.05,
+                    feature_prob: float = 0.3,
+                    n_days: int = DAYS_DEFAULT,
+                    slots_per_day: int = SLOTS_PER_DAY_DEFAULT) -> Problem:
+    """Synthetic instance generator (for tests and benchmarks).
+
+    Room sizes are drawn to make most events placeable, mirroring the
+    character of the ITC-2002 set; there is no reference equivalent (the
+    reference ships no instances or generators).
+    """
+    rng = np.random.default_rng(key_or_seed)
+    attends = (rng.random((n_students, n_events)) < attend_prob).astype(np.int8)
+    event_features = (rng.random((n_events, n_features))
+                      < feature_prob).astype(np.int8)
+    # Rooms: feature-rich enough that every event has at least one match.
+    room_features = (rng.random((n_rooms, n_features)) < 0.6).astype(np.int8)
+    # make room 0 satisfy everything so possible[] rows are never empty
+    room_features[0, :] = 1
+    student_count = attends.sum(axis=0)
+    cap = max(int(student_count.max()), 1)
+    room_size = rng.integers(max(cap // 2, 1), cap + 1,
+                             size=n_rooms).astype(np.int32)
+    room_size[0] = cap
+    return derive(n_events, n_rooms, n_features, n_students, room_size,
+                  attends, room_features, event_features,
+                  n_days=n_days, slots_per_day=slots_per_day)
