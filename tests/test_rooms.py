@@ -1,0 +1,113 @@
+"""Room-matching kernel properties (ops/rooms.py vs reference semantics).
+
+The reference's assignRooms (Solution.cpp:772-833) guarantees: matched
+events get a suitable room each; unmatched events fall back to the
+least-busy suitable room. Our greedy kernel must (a) always pick suitable
+rooms when any exist, (b) produce clash-free assignments whenever rooms
+are plentiful, (c) never do worse than random assignment on room-hcv.
+"""
+
+import numpy as np
+
+from timetabling_ga_tpu.ops import rooms
+from timetabling_ga_tpu.problem import derive, random_instance
+from tests.conftest import random_assignment
+
+
+def _room_hcv_parts(problem, slots, rooms_arr):
+    """(pair clashes, unsuitable count) for one solution, scalar oracle."""
+    clash = 0
+    e = problem.n_events
+    for i in range(e):
+        for j in range(i + 1, e):
+            if slots[i] == slots[j] and rooms_arr[i] == rooms_arr[j]:
+                clash += 1
+    unsuit = sum(1 for i in range(e) if not problem.possible[i][rooms_arr[i]])
+    return clash, unsuit
+
+
+def test_always_suitable_when_possible():
+    problem = random_instance(5, n_events=40, n_rooms=6, n_features=3,
+                              n_students=25, attend_prob=0.1)
+    rng = np.random.default_rng(0)
+    slots, _ = random_assignment(rng, problem, 8)
+    pa = problem.device_arrays()
+    assigned = np.asarray(rooms.batch_assign_rooms(pa, slots))
+    for p in range(8):
+        for e in range(problem.n_events):
+            if problem.possible[e].any():
+                assert problem.possible[e][assigned[p, e]], (p, e)
+
+
+def test_clash_free_when_rooms_plentiful():
+    """Every event fits every room and there are more rooms than events
+    per slot -> greedy matching must produce zero room clashes."""
+    n_events, n_rooms = 12, 12
+    attends = np.zeros((3, n_events), dtype=np.int8)
+    problem = derive(n_events, n_rooms, 1, 3,
+                     room_size=np.full(n_rooms, 100, np.int32),
+                     attends=attends,
+                     room_features=np.ones((n_rooms, 1), np.int8),
+                     event_features=np.zeros((n_events, 1), np.int8))
+    pa = problem.device_arrays()
+    rng = np.random.default_rng(1)
+    slots = rng.integers(0, problem.n_slots,
+                         size=(16, n_events)).astype(np.int32)
+    assigned = np.asarray(rooms.batch_assign_rooms(pa, slots))
+    for p in range(16):
+        clash, unsuit = _room_hcv_parts(problem, slots[p], assigned[p])
+        assert clash == 0, p
+        assert unsuit == 0, p
+
+
+def test_matching_beats_random_rooms():
+    """Greedy matching's room-related hcv must be <= random rooms' on
+    average (sanity: the matcher is doing real work)."""
+    problem = random_instance(6, n_events=50, n_rooms=5, n_features=3,
+                              n_students=30, attend_prob=0.1)
+    pa = problem.device_arrays()
+    rng = np.random.default_rng(2)
+    slots, rand_rooms = random_assignment(rng, problem, 16)
+    matched = np.asarray(rooms.batch_assign_rooms(pa, slots))
+
+    def total_room_hcv(rooms_arr):
+        tot = 0
+        for p in range(16):
+            clash, unsuit = _room_hcv_parts(problem, slots[p], rooms_arr[p])
+            tot += clash + unsuit
+        return tot
+
+    assert total_room_hcv(matched) <= total_room_hcv(rand_rooms)
+
+
+def test_occupancy_counts():
+    problem = random_instance(7, n_events=20, n_rooms=4, n_features=2,
+                              n_students=10)
+    pa = problem.device_arrays()
+    rng = np.random.default_rng(3)
+    slots, rms = random_assignment(rng, problem, 1)
+    occ = np.asarray(rooms.occupancy(pa, slots[0], rms[0]))
+    assert occ.sum() == problem.n_events
+    for e in range(problem.n_events):
+        assert occ[slots[0][e], rms[0][e]] >= 1
+
+
+def test_choose_room_prefers_free_suitable():
+    """Single-event insert: picks a free suitable room (best capacity fit)
+    over a busy one, and the fallback is least-busy suitable."""
+    # 1 event, 3 rooms: room0 too small, room1 fits (cap 10), room2 fits
+    # (cap 50). Best-fit => room1 when free.
+    attends = np.ones((5, 1), dtype=np.int8)  # event has 5 students
+    problem = derive(1, 3, 1, 5, room_size=np.array([2, 10, 50]),
+                     attends=attends,
+                     room_features=np.ones((3, 1), np.int8),
+                     event_features=np.zeros((1, 1), np.int8))
+    pa = problem.device_arrays()
+    free = np.zeros(3, np.int32)
+    assert int(rooms.choose_room(pa, free, np.int32(0))) == 1
+    # room1 busy -> still prefer free suitable room2 over busy room1
+    busy1 = np.array([0, 1, 0], np.int32)
+    assert int(rooms.choose_room(pa, busy1, np.int32(0))) == 2
+    # both suitable rooms busy -> least busy of them
+    busy = np.array([0, 2, 1], np.int32)
+    assert int(rooms.choose_room(pa, busy, np.int32(0))) == 2

@@ -76,6 +76,7 @@ class Problem:
             conflict=jnp.asarray(self.conflict, dtype=jnp.float32),
             possible=jnp.asarray(self.possible, dtype=jnp.bool_),
             student_count=jnp.asarray(self.student_count, dtype=jnp.int32),
+            room_size=jnp.asarray(self.room_size, dtype=jnp.int32),
             n_days=self.n_days,
             slots_per_day=self.slots_per_day,
         )
@@ -94,6 +95,7 @@ class ProblemArrays:
     conflict: "object"       # (E, E) f32, diagonal = event has >=1 student
     possible: "object"       # (E, R) bool
     student_count: "object"  # (E,)   i32
+    room_size: "object"      # (R,)   i32
     n_days: int
     slots_per_day: int
 
@@ -112,16 +114,17 @@ class ProblemArrays:
 
 # Register ProblemArrays as a pytree with static day/slot geometry.
 def _pa_flatten(pa: ProblemArrays):
-    children = (pa.attends, pa.conflict, pa.possible, pa.student_count)
+    children = (pa.attends, pa.conflict, pa.possible, pa.student_count,
+                pa.room_size)
     aux = (pa.n_days, pa.slots_per_day)
     return children, aux
 
 
 def _pa_unflatten(aux, children):
-    attends, conflict, possible, student_count = children
+    attends, conflict, possible, student_count, room_size = children
     n_days, slots_per_day = aux
     return ProblemArrays(attends, conflict, possible, student_count,
-                         n_days, slots_per_day)
+                         room_size, n_days, slots_per_day)
 
 
 jax.tree_util.register_pytree_node(ProblemArrays, _pa_flatten, _pa_unflatten)
@@ -142,6 +145,16 @@ def derive(n_events: int, n_rooms: int, n_features: int, n_students: int,
     room_size = np.asarray(room_size, dtype=np.int32)
     room_features = np.asarray(room_features, dtype=np.int8)
     event_features = np.asarray(event_features, dtype=np.int8)
+
+    expected = {
+        "room_size": (room_size.shape, (n_rooms,)),
+        "attends": (attends.shape, (n_students, n_events)),
+        "room_features": (room_features.shape, (n_rooms, n_features)),
+        "event_features": (event_features.shape, (n_events, n_features)),
+    }
+    for name, (got, want) in expected.items():
+        if got != want:
+            raise ValueError(f"{name}: expected shape {want}, got {got}")
 
     student_count = attends.astype(np.int64).sum(axis=0).astype(np.int32)
     conflict = (attends.astype(np.int32).T @ attends.astype(np.int32)) > 0
