@@ -1,0 +1,96 @@
+"""Benchmark: candidate fitness evaluations per second per chip.
+
+The north-star metric (BASELINE.json / BASELINE.md): how many candidate
+timetables the framework can evaluate per second on one chip — the
+quantity that bounds the whole memetic GA, since >95% of the reference's
+runtime is candidate evaluation inside local search (SURVEY section 3.2).
+
+Prints ONE JSON line:
+  {"metric": "fitness_evals_per_sec_per_chip", "value": N,
+   "unit": "evals/s", "vs_baseline": R}
+
+`vs_baseline` is the ratio against the same workload run with the same
+XLA kernels on the host CPU (all cores, measured in a subprocess) — the
+stand-in for the reference's CPU-node throughput until a same-box
+MPI+OpenMP build exists (none is possible here: no mpicxx in the image;
+BASELINE.md records the protocol).
+
+Workload: comp05-scale synthetic instance (400 events, 10 rooms, 350
+students, 45 slots), population 4096, full penalty evaluation (hcv + scv
++ penalty composition).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+N_EVENTS, N_ROOMS, N_FEATURES, N_STUDENTS = 400, 10, 10, 350
+POP = 4096
+WARMUP, ITERS = 2, 10
+
+
+def measure(label: str) -> float:
+    import jax
+    import numpy as np
+    from timetabling_ga_tpu.ops import fitness
+    from timetabling_ga_tpu.problem import random_instance
+
+    problem = random_instance(1234, n_events=N_EVENTS, n_rooms=N_ROOMS,
+                              n_features=N_FEATURES,
+                              n_students=N_STUDENTS, attend_prob=0.02)
+    pa = problem.device_arrays()
+    rng = np.random.default_rng(0)
+    slots = rng.integers(0, problem.n_slots, size=(POP, N_EVENTS),
+                         dtype=np.int32)
+    rooms = rng.integers(0, N_ROOMS, size=(POP, N_EVENTS), dtype=np.int32)
+    slots = jax.device_put(slots)
+    rooms = jax.device_put(rooms)
+
+    for _ in range(WARMUP):
+        jax.block_until_ready(fitness.batch_penalty(pa, slots, rooms))
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fitness.batch_penalty(pa, slots, rooms)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    evals_per_sec = POP * ITERS / dt
+    print(f"# {label}: {evals_per_sec:,.0f} evals/s "
+          f"({dt / ITERS * 1e3:.2f} ms/batch of {POP})", file=sys.stderr)
+    return evals_per_sec
+
+
+def main() -> None:
+    if os.environ.get("_BENCH_CPU_CHILD") == "1":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps({"cpu_evals_per_sec": measure("cpu")}))
+        return
+
+    tpu = measure("tpu")
+
+    env = dict(os.environ, _BENCH_CPU_CHILD="1")
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=1200, check=True)
+        cpu = json.loads(out.stdout.strip().splitlines()[-1])[
+            "cpu_evals_per_sec"]
+        vs_baseline = tpu / cpu
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"# cpu baseline failed: {e}", file=sys.stderr)
+        vs_baseline = 0.0
+
+    print(json.dumps({
+        "metric": "fitness_evals_per_sec_per_chip",
+        "value": round(tpu, 1),
+        "unit": "evals/s",
+        "vs_baseline": round(vs_baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

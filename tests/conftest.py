@@ -9,11 +9,19 @@ dtype/shape-identical to the TPU path.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even if the environment pre-sets JAX_PLATFORMS (e.g. to the
+# real TPU via axon) — the suite must run on the virtual 8-device mesh.
+# The axon plugin overrides the env var, so the config.update below (after
+# import, before first backend use) is the authoritative switch.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,140 @@
+"""GA operator and generation-loop tests (ops/moves.py, ops/ga.py).
+
+Property tests per SURVEY section 4.2: move invariants (every event keeps
+exactly one slot/room; swaps preserve the slot multiset), selection and
+crossover semantics, and an end-to-end evolution run that must reach
+feasibility on an easy instance.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from timetabling_ga_tpu.ops import fitness, ga, moves
+from timetabling_ga_tpu.problem import derive, random_instance
+from tests.conftest import random_assignment
+
+
+def _one_solution(problem, seed=0):
+    rng = np.random.default_rng(seed)
+    slots, rooms = random_assignment(rng, problem, 1)
+    return jnp.asarray(slots[0]), jnp.asarray(rooms[0])
+
+
+def test_move1_semantics(small_problem):
+    pa = small_problem.device_arrays()
+    slots, rooms = _one_solution(small_problem)
+    e, t = jnp.int32(3), jnp.int32(17)
+    s2, r2 = moves.move1(pa, slots, rooms, e, t)
+    assert int(s2[3]) == 17
+    # all other events untouched
+    keep = np.ones(small_problem.n_events, bool)
+    keep[3] = False
+    np.testing.assert_array_equal(np.asarray(s2)[keep],
+                                  np.asarray(slots)[keep])
+    np.testing.assert_array_equal(np.asarray(r2)[keep],
+                                  np.asarray(rooms)[keep])
+    # moved event got a suitable room (instance guarantees one exists)
+    assert small_problem.possible[3][int(r2[3])]
+
+
+def test_move2_swaps_slots(small_problem):
+    pa = small_problem.device_arrays()
+    slots, rooms = _one_solution(small_problem, 1)
+    e1, e2 = jnp.int32(2), jnp.int32(9)
+    s2, _ = moves.move2(pa, slots, rooms, e1, e2)
+    assert int(s2[2]) == int(slots[9])
+    assert int(s2[9]) == int(slots[2])
+    # slot multiset preserved
+    assert sorted(np.asarray(s2).tolist()) == sorted(
+        np.asarray(slots).tolist())
+
+
+def test_move3_cycles_slots(small_problem):
+    pa = small_problem.device_arrays()
+    slots, rooms = _one_solution(small_problem, 2)
+    s2, _ = moves.move3(pa, slots, rooms, jnp.int32(0), jnp.int32(4),
+                        jnp.int32(7))
+    assert int(s2[0]) == int(slots[4])
+    assert int(s2[4]) == int(slots[7])
+    assert int(s2[7]) == int(slots[0])
+    assert sorted(np.asarray(s2).tolist()) == sorted(
+        np.asarray(slots).tolist())
+
+
+def test_random_move_only_move1(small_problem):
+    """With p1=1, p2=p3=0 every move is a Move1: at most one slot entry
+    changes (Solution.cpp:441-469 type sampling)."""
+    pa = small_problem.device_arrays()
+    slots, rooms = _one_solution(small_problem, 3)
+    for i in range(10):
+        key = jax.random.key(i)
+        s2, _ = moves.random_move(pa, key, slots, rooms, 1.0, 0.0, 0.0)
+        assert int(jnp.sum(s2 != slots)) <= 1
+
+
+def test_random_move_never_move1(small_problem):
+    """With p1=0 the slot multiset is always preserved (Move2/Move3 are
+    permutations)."""
+    pa = small_problem.device_arrays()
+    slots, rooms = _one_solution(small_problem, 4)
+    for i in range(10):
+        key = jax.random.key(100 + i)
+        s2, _ = moves.random_move(pa, key, slots, rooms, 0.0, 1.0, 1.0)
+        assert sorted(np.asarray(s2).tolist()) == sorted(
+            np.asarray(slots).tolist())
+
+
+def test_tournament_picks_best_of_draws(small_problem):
+    penalty = jnp.asarray(np.arange(100, 0, -1, dtype=np.int32))  # best=99
+    for i in range(20):
+        key = jax.random.key(i)
+        w = int(ga.tournament(key, penalty, 5))
+        draws = np.asarray(jax.random.randint(key, (5,), 0, 100))
+        assert w == draws[np.argmin(np.asarray(penalty)[draws])]
+
+
+def test_init_population_sorted_and_valid(small_problem):
+    pa = small_problem.device_arrays()
+    st = ga.init_population(pa, jax.random.key(0), 32)
+    pen = np.asarray(st.penalty)
+    assert (np.diff(pen) >= 0).all()
+    # penalties consistent with a fresh evaluation
+    pen2, hcv2, scv2 = fitness.batch_penalty(pa, st.slots, st.rooms)
+    np.testing.assert_array_equal(pen, np.asarray(pen2))
+    # all rooms suitable (greedy matcher; instance has suitable rooms)
+    possible = small_problem.possible
+    sl = np.asarray(st.slots)
+    rm = np.asarray(st.rooms)
+    for p in range(32):
+        for e in range(small_problem.n_events):
+            if possible[e].any():
+                assert possible[e][rm[p, e]]
+
+
+def test_generation_monotone_best(small_problem):
+    """mu+lambda truncation can never worsen the best penalty."""
+    pa = small_problem.device_arrays()
+    cfg = ga.GAConfig(pop_size=16)
+    st = ga.init_population(pa, jax.random.key(1), 16)
+    best = int(st.penalty[0])
+    for i in range(5):
+        st = ga.generation(pa, jax.random.key(10 + i), st, cfg)
+        nb = int(st.penalty[0])
+        assert nb <= best
+        best = nb
+
+
+def test_run_reaches_feasibility_easy_instance():
+    """End-to-end: an easy instance (few conflicts, plentiful rooms) must
+    reach hcv==0 within a small generation budget (SURVEY section 4.5)."""
+    problem = random_instance(11, n_events=20, n_rooms=6, n_features=2,
+                              n_students=15, attend_prob=0.08)
+    pa = problem.device_arrays()
+    cfg = ga.GAConfig(pop_size=32)
+    st = ga.init_population(pa, jax.random.key(2), 32)
+    st, trace = ga.run(pa, jax.random.key(3), st, cfg, 60)
+    assert int(st.hcv[0]) == 0, int(st.penalty[0])
+    # trace is the per-generation best and is monotone non-increasing
+    tr = np.asarray(trace)
+    assert (np.diff(tr) <= 0).all()

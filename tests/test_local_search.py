@@ -1,0 +1,63 @@
+"""Batched local-search tests (ops/local_search.py).
+
+Key properties: penalty never worsens (hill-climb acceptance), feasibility
+is never broken once reached (the penalty encoding's phase-2 gate,
+Solution.cpp:619-768 semantics), and search makes real progress from
+random starts.
+"""
+
+import numpy as np
+import jax
+
+from timetabling_ga_tpu.ops import fitness, ga, local_search
+from timetabling_ga_tpu.problem import random_instance
+
+
+def test_never_worsens(small_problem):
+    pa = small_problem.device_arrays()
+    st = ga.init_population(pa, jax.random.key(0), 16)
+    pen0 = np.asarray(st.penalty)
+    s2, r2 = local_search.batch_local_search(
+        pa, jax.random.key(1), st.slots, st.rooms, n_rounds=20)
+    pen1, _, _ = fitness.batch_penalty(pa, s2, r2)
+    assert (np.asarray(pen1) <= pen0).all()
+
+
+def test_feasible_stays_feasible(small_problem):
+    """Once hcv==0, accepted moves can never re-break feasibility: an
+    infeasible candidate has penalty >= 1e6 > any scv."""
+    pa = small_problem.device_arrays()
+    st = ga.init_population(pa, jax.random.key(2), 32)
+    s2, r2 = local_search.batch_local_search(
+        pa, jax.random.key(3), st.slots, st.rooms, n_rounds=60)
+    _, hcv1, _ = fitness.batch_penalty(pa, s2, r2)
+    s3, r3 = local_search.batch_local_search(
+        pa, jax.random.key(4), s2, r2, n_rounds=30)
+    _, hcv2, _ = fitness.batch_penalty(pa, s3, r3)
+    was_feasible = np.asarray(hcv1) == 0
+    assert (np.asarray(hcv2)[was_feasible] == 0).all()
+
+
+def test_makes_progress(medium_problem):
+    """From random starts, mean penalty must drop substantially."""
+    pa = medium_problem.device_arrays()
+    st = ga.init_population(pa, jax.random.key(5), 16)
+    pen0 = np.asarray(st.penalty).mean()
+    s2, r2 = local_search.jit_batch_local_search(
+        pa, jax.random.key(6), st.slots, st.rooms, n_rounds=50,
+        n_candidates=8)
+    pen1, _, _ = fitness.batch_penalty(pa, s2, r2)
+    assert np.asarray(pen1).mean() < pen0
+
+
+def test_memetic_generation_beats_plain(request):
+    """A memetic generation (GA + LS) must reach feasibility faster than
+    plain GA on a small instance — the whole point of the memetic design
+    (ga.cpp:574 runs localSearch on every child)."""
+    problem = random_instance(21, n_events=25, n_rooms=5, n_features=2,
+                              n_students=15, attend_prob=0.12)
+    pa = problem.device_arrays()
+    cfg = ga.GAConfig(pop_size=16, ls_steps=10, ls_candidates=8)
+    st = ga.init_population(pa, jax.random.key(7), 16)
+    st, _ = ga.run(pa, jax.random.key(8), st, cfg, 10)
+    assert int(st.hcv[0]) == 0

@@ -1,0 +1,181 @@
+"""Host-runtime tests: CLI flag parsing, JSONL schema, engine end-to-end,
+checkpoint/resume (SURVEY C17-C19, section 5).
+"""
+
+import io
+import json
+
+import numpy as np
+import jax
+import pytest
+
+from timetabling_ga_tpu.ops import ga
+from timetabling_ga_tpu.problem import dump_tim, load_tim, random_instance
+from timetabling_ga_tpu.runtime import checkpoint as ckpt
+from timetabling_ga_tpu.runtime import jsonl
+from timetabling_ga_tpu.runtime.config import RunConfig, parse_args
+from timetabling_ga_tpu.runtime.engine import build_ga_config, run
+
+
+# --------------------------------------------------------------------- config
+
+def test_parse_reference_flags():
+    cfg = parse_args(["-i", "x.tim", "-s", "42", "-c", "4", "-p", "2",
+                      "-t", "30", "-p1", "0.7", "-p3", "0.1"])
+    assert cfg.input == "x.tim"
+    assert cfg.seed == 42
+    assert cfg.threads == 4
+    assert cfg.problem_type == 2
+    assert cfg.time_limit == 30
+    assert cfg.p1 == 0.7 and cfg.p3 == 0.1
+    # LS budget by problem type (ga.cpp:389-397)
+    assert cfg.resolved_max_steps() == 1000
+
+
+def test_parse_extensions():
+    cfg = parse_args(["-i", "x.tim", "--islands", "4", "--pop-size", "64",
+                      "--backend", "cpu", "--resume",
+                      "--checkpoint", "/tmp/c.npz"])
+    assert cfg.islands == 4 and cfg.pop_size == 64
+    assert cfg.backend == "cpu" and cfg.resume
+    assert cfg.checkpoint == "/tmp/c.npz"
+
+
+def test_missing_input_exits():
+    with pytest.raises(SystemExit):
+        parse_args(["-s", "1"])
+
+
+def test_unknown_flag_exits():
+    with pytest.raises(SystemExit):
+        parse_args(["-i", "x.tim", "--bogus", "1"])
+
+
+def test_ls_budget_mapping():
+    cfg = parse_args(["-i", "x.tim", "-p", "1", "--ls-candidates", "8"])
+    g = build_ga_config(cfg)
+    assert g.ls_steps == 200 // 8
+    cfg2 = parse_args(["-i", "x.tim", "-m", "80", "--ls-candidates", "8"])
+    assert build_ga_config(cfg2).ls_steps == 10
+
+
+# ---------------------------------------------------------------------- jsonl
+
+def test_jsonl_schema():
+    buf = io.StringIO()
+    jsonl.log_entry(buf, 0, 1, 117, 2.5)
+    jsonl.solution_record(buf, 0, 1, 10.0, 5, True,
+                          timeslots=[1, 2], rooms=[0, 1])
+    jsonl.solution_record(buf, 1, 0, 10.0, 3000007, False)
+    jsonl.run_entry(buf, 5, True)
+    jsonl.run_entry(buf, 5, True, procs_num=8, threads_num=4,
+                    total_time=10.0)
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    assert lines[0] == {"logEntry": {"procID": 0, "threadID": 1,
+                                     "best": 117, "time": 2.5}}
+    sol = lines[1]["solution"]
+    assert sol["feasible"] is True
+    assert sol["timeslots"] == [1, 2] and sol["rooms"] == [0, 1]
+    # infeasible solution records omit the timetable arrays
+    # (ga.cpp:189-196 feasible branch only appends arrays)
+    assert "timeslots" not in lines[2]["solution"]
+    assert lines[3] == {"runEntry": {"totalBest": 5, "feasible": True}}
+    assert set(lines[4]["runEntry"]) == {
+        "totalBest", "feasible", "procsNum", "threadsNum", "totalTime"}
+
+
+def test_reported_best_formula():
+    assert jsonl.reported_best(0, 42) == 42
+    assert jsonl.reported_best(3, 7) == 3_000_007
+
+
+# --------------------------------------------------------------------- engine
+
+@pytest.fixture(scope="module")
+def tim_file(tmp_path_factory):
+    problem = random_instance(55, n_events=15, n_rooms=5, n_features=2,
+                              n_students=10, attend_prob=0.1)
+    path = tmp_path_factory.mktemp("inst") / "tiny.tim"
+    path.write_text(dump_tim(problem))
+    return str(path)
+
+
+def test_tim_round_trip(tim_file):
+    with open(tim_file) as fh:
+        problem = load_tim(fh)
+    assert problem.n_events == 15
+    text2 = dump_tim(problem)
+    assert dump_tim(load_tim(text2)) == text2
+
+
+def test_engine_end_to_end(tim_file):
+    buf = io.StringIO()
+    cfg = RunConfig(input=tim_file, seed=3, pop_size=8, islands=2,
+                    generations=40, migration_period=10,
+                    problem_type=1, max_steps=16, time_limit=300,
+                    backend="cpu")
+    best = run(cfg, out=buf)
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    kinds = [next(iter(x)) for x in lines]
+    # protocol shape: logEntries, then one solution per island, then the
+    # two runEntry lines (ga.cpp:603-609)
+    assert kinds.count("solution") == 2
+    assert kinds.count("runEntry") == 2
+    assert kinds[-1] == "runEntry" and kinds[-2] == "runEntry"
+    assert "procsNum" in lines[-1]["runEntry"]
+    run_best = lines[-1]["runEntry"]["totalBest"]
+    assert run_best == best
+    # solution totalBest must be consistent with runEntry (min over islands)
+    sol_bests = [x["solution"]["totalBest"] for x in lines
+                 if "solution" in x]
+    assert min(sol_bests) == run_best
+    # logEntry bests per island are strictly decreasing
+    per_island = {}
+    for x in lines:
+        if "logEntry" in x:
+            e = x["logEntry"]
+            per_island.setdefault(e["procID"], []).append(e["best"])
+    for bests in per_island.values():
+        assert bests == sorted(bests, reverse=True)
+        assert len(set(bests)) == len(bests)
+
+
+def test_checkpoint_roundtrip(tmp_path, small_problem):
+    pa = small_problem.device_arrays()
+    st = ga.init_population(pa, jax.random.key(0), 8)
+    gacfg = ga.GAConfig(pop_size=8)
+    fp = ckpt.config_fingerprint(small_problem, gacfg)
+    path = str(tmp_path / "ck.npz")
+    key = jax.random.key(7)
+    ckpt.save(path, st, key, 120, fp)
+    st2, key2, gen2 = ckpt.load(path, fp)
+    assert gen2 == 120
+    np.testing.assert_array_equal(np.asarray(st.slots),
+                                  np.asarray(st2.slots))
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(key)),
+        np.asarray(jax.random.key_data(key2)))
+    # fingerprint mismatch refuses to load
+    with pytest.raises(ValueError):
+        ckpt.load(path, fp + "X")
+
+
+def test_engine_resume(tim_file, tmp_path):
+    ck = str(tmp_path / "resume.npz")
+    cfg = RunConfig(input=tim_file, seed=5, pop_size=8, islands=2,
+                    generations=20, migration_period=10,
+                    max_steps=8, time_limit=300, backend="cpu",
+                    checkpoint=ck, checkpoint_every=1)
+    run(cfg, out=io.StringIO())
+    # resume continues from the checkpoint (generation counter there)
+    import numpy as np
+    with np.load(ck, allow_pickle=False) as z:
+        assert int(z["generation"]) == 20
+    cfg2 = RunConfig(input=tim_file, seed=5, pop_size=8, islands=2,
+                     generations=40, migration_period=10,
+                     max_steps=8, time_limit=300, backend="cpu",
+                     checkpoint=ck, checkpoint_every=1, resume=True)
+    buf = io.StringIO()
+    run(cfg2, out=buf)
+    with np.load(ck, allow_pickle=False) as z:
+        assert int(z["generation"]) == 40

@@ -19,12 +19,19 @@ reference MPI+OpenMP C++ solver (nelilepo/timetabling-ga-mpi-openmp):
   ``pmin`` (reference: MPI_Sendrecv ring + MPI_Allreduce, ga.cpp:479-541).
 """
 
-from timetabling_ga_tpu.problem import Problem, load_tim, load_tim_file
+from timetabling_ga_tpu.problem import (
+    Problem, dump_tim, load_tim, load_tim_file)
 from timetabling_ga_tpu.ops.fitness import (
     compute_hcv,
     compute_scv,
     compute_penalty,
     batch_penalty,
 )
+from timetabling_ga_tpu.ops.ga import GAConfig, PopState, init_population
+from timetabling_ga_tpu.ops.rooms import assign_rooms, batch_assign_rooms
+from timetabling_ga_tpu.ops.local_search import batch_local_search
+from timetabling_ga_tpu.parallel import (
+    make_mesh, init_island_population, make_island_runner)
+from timetabling_ga_tpu.runtime import RunConfig, parse_args, run
 
 __version__ = "0.1.0"

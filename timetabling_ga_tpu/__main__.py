@@ -1,0 +1,7 @@
+"""`python -m timetabling_ga_tpu` == `python -m timetabling_ga_tpu.cli`."""
+
+import sys
+
+from timetabling_ga_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,27 @@
+"""Command-line entry point.
+
+Usage mirrors the reference binary (`timetabling.ga.uk.2 -i instance.tim
+-s 42 -c 4 -p 1`, Control.cpp:3-176) plus the TPU extensions:
+
+    python -m timetabling_ga_tpu.cli -i comp01.tim -s 42 -p 1 \
+        --islands 8 --pop-size 128 --generations 2001
+
+Output is the reference's JSONL protocol on stdout (or -o <file>).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from timetabling_ga_tpu.runtime import parse_args
+from timetabling_ga_tpu.runtime.engine import run
+
+
+def main(argv=None) -> int:
+    cfg = parse_args(sys.argv[1:] if argv is None else argv)
+    run(cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

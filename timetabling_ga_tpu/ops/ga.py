@@ -1,0 +1,176 @@
+"""Population-level GA operators and the generation loop.
+
+TPU-native redesign of the reference's breeding machinery:
+
+- tournament selection, size 5 (ga.cpp:129-145 `selection5`)
+- uniform crossover with p=0.8 (Solution::crossover Solution.cpp:893-910;
+  applied at ga.cpp:562-566), with a FULL room rematch of the child — the
+  same thing the reference's crossover does by re-running assignRooms over
+  all 45 slots (Solution.cpp:905-908), minus its stale-`timeslot_events`
+  bug (SURVEY C11), which cannot exist here because occupancy is always
+  recomputed from the genotype.
+- mutation = one random move with p=0.5 (ga.cpp:569-571, Solution.cpp:912)
+- replacement: the reference replaces the single worst member per child
+  inside an OpenMP critical and re-sorts (ga.cpp:580-585, steady-state).
+  Steady-state is inherently serial; the TPU variant is generational
+  (mu+lambda) truncation: P children are bred in one vmapped batch,
+  concatenated with the parents, and the best P survive. This preserves
+  elitist pressure (documented divergence, SURVEY C13).
+
+The whole generation is one jitted tensor program; `run` wraps it in
+`lax.scan` so an entire evolution runs on-device in a single dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from timetabling_ga_tpu.ops import fitness
+from timetabling_ga_tpu.ops.moves import random_move
+from timetabling_ga_tpu.ops.rooms import assign_rooms, batch_assign_rooms
+
+
+@dataclasses.dataclass(frozen=True)
+class GAConfig:
+    """Breeding hyper-parameters (reference defaults cited).
+
+    Frozen/hashable so it can be a jit static argument."""
+
+    pop_size: int = 10            # ga.cpp:64
+    tournament_k: int = 5         # ga.cpp:129-145
+    p_crossover: float = 0.8      # ga.cpp:562
+    p_mutation: float = 0.5       # ga.cpp:569
+    p1: float = 1.0               # move-type probs, Control.cpp:103-125
+    p2: float = 1.0
+    p3: float = 0.0
+    ls_steps: int = 0             # local-search rounds per child (C8); 0=off
+    ls_candidates: int = 8        # candidate moves per LS round
+
+
+class PopState(NamedTuple):
+    """Device-resident population: the dense replacement for the
+    reference's `Solution* pop[]` (ga.cpp:60). Sorted by penalty
+    ascending after every generation (best first, like ga.cpp:583)."""
+
+    slots: jnp.ndarray    # (P, E) int32
+    rooms: jnp.ndarray    # (P, E) int32
+    penalty: jnp.ndarray  # (P,)   int32
+    hcv: jnp.ndarray      # (P,)   int32
+    scv: jnp.ndarray      # (P,)   int32
+
+
+def evaluate(pa, slots, rooms_arr) -> PopState:
+    """Build a PopState by evaluating (P, E) genotypes, sorted best-first."""
+    penalty, hcv, scv = fitness.batch_penalty(pa, slots, rooms_arr)
+    order = jnp.argsort(penalty)
+    return PopState(slots=slots[order], rooms=rooms_arr[order],
+                    penalty=penalty[order], hcv=hcv[order], scv=scv[order])
+
+
+def init_population(pa, key, pop_size: int) -> PopState:
+    """Random initial population: uniform random timeslots then greedy room
+    matching per individual (RandomInitialSolution, Solution.cpp:48-61).
+
+    Unlike the reference, every island initializes its own population from
+    its own key rather than broadcasting rank 0's population everywhere
+    (ga.cpp:429-444) — a documented divergence (SURVEY C17) that buys
+    diversity for free.
+    """
+    E = pa.n_events
+    slots = jax.random.randint(key, (pop_size, E), 0, pa.n_slots,
+                               dtype=jnp.int32)
+    rooms_arr = batch_assign_rooms(pa, slots)
+    return evaluate(pa, slots, rooms_arr)
+
+
+def tournament(key, penalty: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Tournament selection: k uniform draws, return index of the best
+    (ga.cpp:129-145 selection5: 5 draws, argmin penalty). The reference
+    reads the population unlocked while other threads sort (a data race,
+    SURVEY C14); here the population is immutable within a generation."""
+    P = penalty.shape[0]
+    draws = jax.random.randint(key, (k,), 0, P)
+    return draws[jnp.argmin(penalty[draws])]
+
+
+def _make_child(pa, key, state: PopState, cfg: GAConfig):
+    """Breed one child: 2x tournament -> crossover(p) -> mutation(p).
+
+    (ga.cpp:543-571 minus the wasteful throwaway Solution allocs at
+    543-548.) Returns (slots, rooms) of the child; evaluation happens
+    batched in `generation`."""
+    k_a, k_b, k_x, k_mask, k_m, k_mv = jax.random.split(key, 6)
+    ia = tournament(k_a, state.penalty, cfg.tournament_k)
+    ib = tournament(k_b, state.penalty, cfg.tournament_k)
+    s_a, r_a = state.slots[ia], state.rooms[ia]
+    s_b = state.slots[ib]
+
+    # uniform crossover on timeslots + full room rematch (Solution.cpp:
+    # 893-910); with prob 1-p_crossover the child is a copy of parent A
+    # (ga.cpp:565-566)
+    mask = jax.random.bernoulli(k_mask, 0.5, (s_a.shape[0],))
+    x_slots = jnp.where(mask, s_a, s_b)
+    x_rooms = assign_rooms(pa, x_slots)
+    do_x = jax.random.bernoulli(k_x, cfg.p_crossover)
+    slots = jnp.where(do_x, x_slots, s_a)
+    rooms_arr = jnp.where(do_x, x_rooms, r_a)
+
+    # mutation: one random move with p_mutation (ga.cpp:569-571)
+    m_slots, m_rooms = random_move(pa, k_mv, slots, rooms_arr,
+                                   cfg.p1, cfg.p2, cfg.p3)
+    do_m = jax.random.bernoulli(k_m, cfg.p_mutation)
+    slots = jnp.where(do_m, m_slots, slots)
+    rooms_arr = jnp.where(do_m, m_rooms, rooms_arr)
+    return slots, rooms_arr
+
+
+def generation(pa, key, state: PopState, cfg: GAConfig) -> PopState:
+    """One generation: breed P children in a single vmapped batch, then
+    mu+lambda truncation over parents+children."""
+    keys = jax.random.split(key, cfg.pop_size)
+    ch_slots, ch_rooms = jax.vmap(
+        lambda k: _make_child(pa, k, state, cfg))(keys)
+
+    if cfg.ls_steps > 0:
+        from timetabling_ga_tpu.ops.local_search import batch_local_search
+        k_ls = jax.random.fold_in(key, 0x15)
+        ch_slots, ch_rooms = batch_local_search(
+            pa, k_ls, ch_slots, ch_rooms,
+            n_rounds=cfg.ls_steps, n_candidates=cfg.ls_candidates,
+            p1=cfg.p1, p2=cfg.p2, p3=cfg.p3)
+
+    c_pen, c_hcv, c_scv = fitness.batch_penalty(pa, ch_slots, ch_rooms)
+    all_slots = jnp.concatenate([state.slots, ch_slots])
+    all_rooms = jnp.concatenate([state.rooms, ch_rooms])
+    all_pen = jnp.concatenate([state.penalty, c_pen])
+    all_hcv = jnp.concatenate([state.hcv, c_hcv])
+    all_scv = jnp.concatenate([state.scv, c_scv])
+    order = jnp.argsort(all_pen)[:cfg.pop_size]
+    return PopState(slots=all_slots[order], rooms=all_rooms[order],
+                    penalty=all_pen[order], hcv=all_hcv[order],
+                    scv=all_scv[order])
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n_generations"))
+def run(pa, key, state: PopState, cfg: GAConfig, n_generations: int):
+    """Evolve `n_generations` on-device in one dispatch.
+
+    The reference's generation loop is ~2001 iterations statically split
+    over OpenMP threads (ga.cpp:510); here it is a lax.scan whose body
+    breeds the whole population at once. Returns the final state and the
+    per-generation best penalty trace (the data behind the JSONL
+    `logEntry` records, ga.cpp:203-228)."""
+
+    def step(st, k):
+        st = generation(pa, k, st, cfg)
+        return st, st.penalty[0]
+
+    keys = jax.random.split(key, n_generations)
+    state, best_trace = lax.scan(step, state, keys)
+    return state, best_trace

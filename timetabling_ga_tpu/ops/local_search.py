@@ -1,0 +1,92 @@
+"""Batched local search: the TPU redesign of the reference's hot loop.
+
+The reference's `Solution::localSearch` (Solution.cpp:471-769) is a
+sequential first-improvement sweep: for each event it tries all 45 target
+slots (Move1), all swap partners (Move2), optionally 3-cycles (Move3),
+deep-copying the solution per candidate and accepting the first strictly
+improving move; its step counter resets on every improvement, and >95% of
+program time is spent here (SURVEY section 3.2). Data-dependent loops and
+per-candidate allocations cannot map onto XLA.
+
+The redesign (SURVEY section 7.4): per individual, each round proposes K
+random candidate moves, evaluates ALL of them with the batched fitness
+kernels, and accepts the best candidate if it strictly improves. Rounds
+run under `lax.scan` with fixed shapes; `vmap` runs every individual's
+search simultaneously, so one TPU dispatch performs P*K candidate
+evaluations per round.
+
+The reference's two phases — hcv repair while infeasible
+(Solution.cpp:497-618), then scv polish that never re-breaks feasibility
+(619-768) — need no explicit gate here: acceptance compares the scalar
+penalty `scv if feasible else 1e6+hcv` (Solution.cpp:162-170), whose
+ordering makes any hcv reduction dominate while infeasible and makes any
+feasibility-breaking move unacceptable once feasible.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from timetabling_ga_tpu.ops import fitness
+from timetabling_ga_tpu.ops.moves import random_move
+from timetabling_ga_tpu.ops.rooms import capacity_rank
+
+
+def local_search(pa, key, slots, rooms_arr, n_rounds: int,
+                 n_candidates: int = 8,
+                 p1: float = 1.0, p2: float = 1.0, p3: float = 0.0):
+    """Hill-climb one individual for `n_rounds` fixed-shape rounds.
+
+    Each round: K random moves -> evaluate all -> accept argmin penalty if
+    strictly better (the batched analogue of first-improvement with
+    counter reset, Solution.cpp:521-527). Returns (slots, rooms).
+    """
+    cap_rank = capacity_rank(pa)
+
+    def one_round(carry, k):
+        s, r, pen = carry
+        keys = jax.random.split(k, n_candidates)
+        c_slots, c_rooms = jax.vmap(
+            lambda kk: random_move(pa, kk, s, r, p1, p2, p3, cap_rank)
+        )(keys)                                        # (K, E) each
+        c_pen, _, _ = jax.vmap(
+            lambda cs, cr: fitness.compute_penalty(pa, cs, cr)
+        )(c_slots, c_rooms)                            # (K,)
+        best = jnp.argmin(c_pen)
+        better = c_pen[best] < pen
+        s = jnp.where(better, c_slots[best], s)
+        r = jnp.where(better, c_rooms[best], r)
+        pen = jnp.where(better, c_pen[best], pen)
+        return (s, r, pen), None
+
+    pen0, _, _ = fitness.compute_penalty(pa, slots, rooms_arr)
+    keys = jax.random.split(key, n_rounds)
+    (slots, rooms_arr, _), _ = lax.scan(
+        one_round, (slots, rooms_arr, pen0), keys)
+    return slots, rooms_arr
+
+
+def batch_local_search(pa, key, slots, rooms_arr, n_rounds: int,
+                       n_candidates: int = 8,
+                       p1: float = 1.0, p2: float = 1.0, p3: float = 0.0):
+    """Run `local_search` on a whole population (P, E) simultaneously."""
+    P = slots.shape[0]
+    keys = jax.random.split(key, P)
+    return jax.vmap(
+        lambda k, s, r: local_search(pa, k, s, r, n_rounds, n_candidates,
+                                     p1, p2, p3)
+    )(keys, slots, rooms_arr)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_rounds", "n_candidates"))
+def jit_batch_local_search(pa, key, slots, rooms_arr, n_rounds: int,
+                           n_candidates: int = 8,
+                           p1: float = 1.0, p2: float = 1.0,
+                           p3: float = 0.0):
+    return batch_local_search(pa, key, slots, rooms_arr, n_rounds,
+                              n_candidates, p1, p2, p3)

@@ -1,0 +1,106 @@
+"""Neighborhood moves as pure-functional tensor updates.
+
+TPU-native redesign of the reference's mutating moves (Solution::Move1/2/3,
+Solution.cpp:357-439, randomMove 441-469). Where the reference mutates a
+`vector<pair>` plus a ragged `timeslot_events` index and re-runs per-slot
+matching, each move here is a pure function
+
+    (slots (E,), rooms (E,)) -> (slots', rooms')
+
+that relocates events and re-rooms ONLY the moved events via the O(R)
+greedy insert (`rooms.choose_room`) — cheaper than the reference's full
+per-slot rematch, with matching quality restored at the next full
+`assign_rooms` (crossover / re-init). All moves keep the invariant that
+every event has exactly one (slot, room); there is no ragged index to go
+stale (the reference's crossover stale-index bug, SURVEY C11, cannot
+exist here by construction).
+
+`random_move` mirrors the reference's move-type sampling (p1/p2/p3
+normalized, distinct events, uniform target slot) with threefry keys.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from timetabling_ga_tpu.ops.rooms import (
+    capacity_rank, choose_room, occupancy)
+
+
+def move1(pa, slots, rooms_arr, e, t, cap_rank=None):
+    """Move event `e` to timeslot `t` (Solution::Move1, Solution.cpp:357).
+
+    The moved event is re-roomed by greedy insert into its new slot; all
+    other events are untouched.
+    """
+    if cap_rank is None:
+        cap_rank = capacity_rank(pa)
+    occ = occupancy(pa, slots, rooms_arr)
+    occ = occ.at[slots[e], rooms_arr[e]].add(-1)
+    r = choose_room(pa, occ[t], e, cap_rank)
+    return slots.at[e].set(t), rooms_arr.at[e].set(r)
+
+
+def move2(pa, slots, rooms_arr, e1, e2, cap_rank=None):
+    """Swap the timeslots of events e1, e2 (Solution::Move2,
+    Solution.cpp:378); both are re-roomed in their new slots."""
+    if cap_rank is None:
+        cap_rank = capacity_rank(pa)
+    t1, t2 = slots[e1], slots[e2]
+    occ = occupancy(pa, slots, rooms_arr)
+    occ = occ.at[t1, rooms_arr[e1]].add(-1)
+    occ = occ.at[t2, rooms_arr[e2]].add(-1)
+    r1 = choose_room(pa, occ[t2], e1, cap_rank)
+    occ = occ.at[t2, r1].add(1)
+    r2 = choose_room(pa, occ[t1], e2, cap_rank)
+    slots = slots.at[e1].set(t2).at[e2].set(t1)
+    rooms_arr = rooms_arr.at[e1].set(r1).at[e2].set(r2)
+    return slots, rooms_arr
+
+
+def move3(pa, slots, rooms_arr, e1, e2, e3, cap_rank=None):
+    """3-cycle: e1 -> slot of e2, e2 -> slot of e3, e3 -> slot of e1
+    (Solution::Move3, Solution.cpp:405; the local search tries both cycle
+    orientations — callers get the reverse cycle by permuting args)."""
+    if cap_rank is None:
+        cap_rank = capacity_rank(pa)
+    t1, t2, t3 = slots[e1], slots[e2], slots[e3]
+    occ = occupancy(pa, slots, rooms_arr)
+    occ = occ.at[t1, rooms_arr[e1]].add(-1)
+    occ = occ.at[t2, rooms_arr[e2]].add(-1)
+    occ = occ.at[t3, rooms_arr[e3]].add(-1)
+    r1 = choose_room(pa, occ[t2], e1, cap_rank)
+    occ = occ.at[t2, r1].add(1)
+    r2 = choose_room(pa, occ[t3], e2, cap_rank)
+    occ = occ.at[t3, r2].add(1)
+    r3 = choose_room(pa, occ[t1], e3, cap_rank)
+    slots = slots.at[e1].set(t2).at[e2].set(t3).at[e3].set(t1)
+    rooms_arr = rooms_arr.at[e1].set(r1).at[e2].set(r2).at[e3].set(r3)
+    return slots, rooms_arr
+
+
+def random_move(pa, key, slots, rooms_arr,
+                p1: float = 1.0, p2: float = 1.0, p3: float = 0.0,
+                cap_rank=None):
+    """One random neighborhood move (Solution::randomMove,
+    Solution.cpp:441-469): move type drawn with probabilities
+    p1:p2:p3 (normalized), distinct events, uniform target slot.
+    """
+    if cap_rank is None:
+        cap_rank = capacity_rank(pa)
+    E = slots.shape[0]
+    k_type, k_ev, k_slot = jax.random.split(key, 3)
+    probs = jnp.array([p1, p2, p3], dtype=jnp.float32)
+    probs = probs / jnp.sum(probs)
+    mtype = jax.random.choice(k_type, 3, p=probs)
+    evs = jax.random.choice(k_ev, E, shape=(3,), replace=False)
+    t = jax.random.randint(k_slot, (), 0, pa.n_slots, dtype=slots.dtype)
+
+    return lax.switch(
+        mtype,
+        [lambda s, r: move1(pa, s, r, evs[0], t, cap_rank),
+         lambda s, r: move2(pa, s, r, evs[0], evs[1], cap_rank),
+         lambda s, r: move3(pa, s, r, evs[0], evs[1], evs[2], cap_rank)],
+        slots, rooms_arr)

@@ -90,6 +90,9 @@ def assign_rooms(pa, slots: jnp.ndarray) -> jnp.ndarray:
     """
     slots = jnp.asarray(slots)
     E, R = pa.possible.shape
+    # Key-packing bounds: occupancy (<= E) and cap_rank (< R) must stay
+    # inside their bit fields or the preference order silently inverts.
+    assert E < _W_UNSUIT // _W_BUSY and R < _W_BUSY, (E, R)
     T = pa.n_slots
     suit_count = jnp.sum(pa.possible, axis=1).astype(jnp.int32)
     order = jnp.argsort(suit_count)                 # most constrained first

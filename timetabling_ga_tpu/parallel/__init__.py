@@ -1,0 +1,13 @@
+"""Distributed execution: island model over a device mesh.
+
+The reference's MPI layer (one GA island per rank, bidirectional ring
+migration, ga.cpp:370-541) becomes a `jax.sharding.Mesh` axis: islands are
+shards of the population tensor, migration is `lax.ppermute` over ICI, and
+the global best is `lax.pmin` (replacing MPI_Allreduce MIN, ga.cpp:237).
+"""
+
+from timetabling_ga_tpu.parallel.islands import (
+    make_mesh,
+    init_island_population,
+    make_island_runner,
+)

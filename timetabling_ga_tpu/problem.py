@@ -216,6 +216,20 @@ def load_tim_file(path: str, **kw) -> Problem:
         return load_tim(fh, **kw)
 
 
+def dump_tim(problem: Problem) -> str:
+    """Serialize a Problem back to `.tim` text (inverse of load_tim).
+
+    The reference has no writer (it only parses, Problem.cpp:3-74); this
+    exists for fixtures, benchmarks and round-trip tests."""
+    lines = [f"{problem.n_events} {problem.n_rooms} "
+             f"{problem.n_features} {problem.n_students}"]
+    lines += [str(int(x)) for x in problem.room_size]
+    lines += [str(int(x)) for x in problem.attends.reshape(-1)]
+    lines += [str(int(x)) for x in problem.room_features.reshape(-1)]
+    lines += [str(int(x)) for x in problem.event_features.reshape(-1)]
+    return "\n".join(lines) + "\n"
+
+
 def random_instance(key_or_seed, n_events: int, n_rooms: int,
                     n_features: int, n_students: int,
                     attend_prob: float = 0.05,

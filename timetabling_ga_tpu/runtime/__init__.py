@@ -1,0 +1,12 @@
+"""Host runtime: CLI/config, JSONL protocol, engine loop, checkpointing.
+
+The host-side layer of the framework (reference: Control.{h,cpp} CLI +
+ga.cpp main() orchestration + the JSONL protocol, SURVEY C17-C19). The
+device-side work is dispatched through `timetabling_ga_tpu.parallel`;
+everything here runs on the host: flag parsing, problem loading, epoch
+scheduling, incremental-best logging, checkpoint/resume, and final
+reporting.
+"""
+
+from timetabling_ga_tpu.runtime.config import RunConfig, parse_args
+from timetabling_ga_tpu.runtime.engine import run

@@ -1,0 +1,132 @@
+"""Run configuration and CLI flag parsing.
+
+Flag surface parity with the reference's Control (Control.cpp:3-176),
+which parses `-key value` pairs into a string map with typed getters and
+warn-on-default behavior:
+
+    -c <int>    threads (Control.cpp:22-28)    — recorded in runEntry
+                threadsNum; on TPU the intra-island parallelism is the
+                vmapped population, so this does not change execution
+    -i <path>   input instance, required (Control.cpp:32-39)
+    -o <path>   output stream (Control.cpp:43-48), default stdout
+    -n <int>    tries, default 10 legacy / 1 here (Control.cpp:52-58; the
+                MPI binary never used it, SURVEY C19)
+    -t <secs>   time limit, default 90 (Control.cpp:62-68)
+    -p <int>    problem type 1/2/3, default 1 (Control.cpp:72-78); sets
+                the local-search budget 200/1000/2000 (ga.cpp:389-397)
+    -m <int>    explicit LS maxSteps override (Control.cpp:83-89)
+    -l <secs>   LS time limit (Control.cpp:93-99) — accepted, unused
+                (fixed-shape search has no data-dependent timeout)
+    -p1/-p2/-p3 move-type probabilities, default 1.0/1.0/0.0
+                (Control.cpp:103-125)
+    -s <int>    seed, default time() (Control.cpp:129-136)
+
+TPU-specific extensions (SURVEY section 7.6):
+    --backend {tpu,cpu}   device backend (cpu = same kernels on host CPU)
+    --pop-size <int>      population per island (reference fixed 10,
+                          ga.cpp:64)
+    --islands <int>       number of islands (reference: MPI world size)
+    --generations <int>   generation budget per island (reference 2001,
+                          ga.cpp:510)
+    --migration-period <int>  generations between migrations (reference:
+                          every 100 local periods, ga.cpp:514)
+    --ls-candidates <int> candidate moves per LS round
+    --checkpoint <path>   checkpoint file (npz); enables save/resume
+    --checkpoint-every <int>  epochs between checkpoints
+    --resume              resume from --checkpoint if it exists
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+from typing import Optional
+
+
+@dataclasses.dataclass
+class RunConfig:
+    threads: int = 1
+    input: Optional[str] = None
+    output: Optional[str] = None
+    tries: int = 1
+    time_limit: float = 90.0
+    problem_type: int = 1
+    max_steps: Optional[int] = None
+    ls_time_limit: float = 99999.0
+    p1: float = 1.0
+    p2: float = 1.0
+    p3: float = 0.0
+    seed: Optional[int] = None
+    backend: str = "tpu"
+    pop_size: int = 10
+    islands: Optional[int] = None
+    generations: int = 2001
+    migration_period: int = 100
+    ls_candidates: int = 8
+    checkpoint: Optional[str] = None
+    checkpoint_every: int = 1
+    resume: bool = False
+
+    def resolved_seed(self) -> int:
+        # reference default: time(NULL) (Control.cpp:129-136)
+        return int(time.time()) if self.seed is None else self.seed
+
+    def resolved_max_steps(self) -> int:
+        """LS budget by problem type (ga.cpp:389-397) unless -m given."""
+        if self.max_steps is not None:
+            return self.max_steps
+        return {1: 200, 2: 1000}.get(self.problem_type, 2000)
+
+
+_FLAG_MAP = {
+    "-c": ("threads", int),
+    "-i": ("input", str),
+    "-o": ("output", str),
+    "-n": ("tries", int),
+    "-t": ("time_limit", float),
+    "-p": ("problem_type", int),
+    "-m": ("max_steps", int),
+    "-l": ("ls_time_limit", float),
+    "-p1": ("p1", float),
+    "-p2": ("p2", float),
+    "-p3": ("p3", float),
+    "-s": ("seed", int),
+    "--backend": ("backend", str),
+    "--pop-size": ("pop_size", int),
+    "--islands": ("islands", int),
+    "--generations": ("generations", int),
+    "--migration-period": ("migration_period", int),
+    "--ls-candidates": ("ls_candidates", int),
+    "--checkpoint": ("checkpoint", str),
+    "--checkpoint-every": ("checkpoint_every", int),
+}
+
+_BOOL_FLAGS = {"--resume": "resume"}
+
+
+def parse_args(argv) -> RunConfig:
+    """Parse `-key value` pairs (Control.cpp:14-16 parsing model).
+
+    Unknown flags raise; a missing `-i` raises like the reference's
+    exit-on-missing-input (Control.cpp:36-39)."""
+    cfg = RunConfig()
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in _BOOL_FLAGS:
+            setattr(cfg, _BOOL_FLAGS[a], True)
+            i += 1
+            continue
+        if a not in _FLAG_MAP:
+            raise SystemExit(f"unknown flag: {a}")
+        if i + 1 >= len(argv):
+            raise SystemExit(f"flag {a} needs a value")
+        field, typ = _FLAG_MAP[a]
+        setattr(cfg, field, typ(argv[i + 1]))
+        i += 2
+    if cfg.input is None:
+        raise SystemExit("No instance file specified, use -i <file>")
+    if cfg.backend not in ("tpu", "cpu"):
+        raise SystemExit(f"unknown backend: {cfg.backend}")
+    return cfg

@@ -1,0 +1,161 @@
+"""The run engine: host orchestration of the island GA.
+
+The TPU-native re-design of ga.cpp main() (ga.cpp:370-613). Where the
+reference interleaves MPI bootstrap, OpenMP breeding loops and ad-hoc
+logging in one function, the engine is a host loop over *epochs*: each
+epoch is one fully on-device dispatch (migration_period generations on
+every island + ring migration, see parallel/islands.py), after which the
+host reads back per-island bests to drive the JSONL protocol, the wall
+clock bound (-t, Control.cpp:62-68), and checkpointing.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import numpy as np
+
+from timetabling_ga_tpu.ops import ga
+from timetabling_ga_tpu.parallel import islands
+from timetabling_ga_tpu.problem import load_tim_file
+from timetabling_ga_tpu.runtime import checkpoint as ckpt
+from timetabling_ga_tpu.runtime import jsonl
+from timetabling_ga_tpu.runtime.config import RunConfig
+
+INT_MAX = 2 ** 31 - 1
+
+
+def build_ga_config(cfg: RunConfig) -> ga.GAConfig:
+    """Map run flags to breeding hyper-parameters.
+
+    The reference's LS budget counts candidate evaluations
+    (stepCount, Solution.cpp:471-769); one of our LS rounds evaluates
+    `ls_candidates` candidates, so rounds = maxSteps / ls_candidates keeps
+    the candidate budget comparable."""
+    max_steps = cfg.resolved_max_steps()
+    ls_rounds = max(1, max_steps // cfg.ls_candidates)
+    return ga.GAConfig(
+        pop_size=cfg.pop_size,
+        p1=cfg.p1, p2=cfg.p2, p3=cfg.p3,
+        ls_steps=ls_rounds, ls_candidates=cfg.ls_candidates,
+    )
+
+
+def run(cfg: RunConfig, out=None) -> int:
+    """Execute the configured run; emit the JSONL protocol on `out`.
+
+    Returns the global best reported evaluation (scv if feasible else
+    hcv*1e6+scv), the quantity the reference's runEntry reports.
+    """
+    t_start = time.monotonic()
+    if cfg.backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    close_out = False
+    if out is None:
+        if cfg.output:
+            out = open(cfg.output, "w")
+            close_out = True
+        else:
+            out = sys.stdout
+
+    try:
+        return _run_tries(cfg, out, t_start)
+    finally:
+        if close_out:
+            out.close()
+
+
+def _run_tries(cfg: RunConfig, out, t_start: float) -> int:
+    problem = load_tim_file(cfg.input)
+    pa = problem.device_arrays()
+
+    devices = jax.devices()
+    n_islands = cfg.islands if cfg.islands is not None else len(devices)
+    if n_islands > len(devices):
+        print(f"warning: {n_islands} islands requested but only "
+              f"{len(devices)} devices; using {len(devices)}",
+              file=sys.stderr)
+        n_islands = len(devices)
+    mesh = islands.make_mesh(n_islands)
+
+    gacfg = build_ga_config(cfg)
+    seed = cfg.resolved_seed()
+    fingerprint = ckpt.config_fingerprint(problem, gacfg)
+
+    runner = islands.make_island_runner(
+        mesh, gacfg, n_epochs=1, gens_per_epoch=cfg.migration_period)
+
+    global_best = INT_MAX
+    # The reference's try loop is legacy Control behavior (Control.cpp:
+    # 188-246) unused by the MPI binary; we honor -n but default it to 1.
+    for trial in range(cfg.tries):
+        key = jax.random.key(seed + trial)
+        k_init, key = jax.random.split(key)
+
+        gens_done = 0
+        state = None
+        if cfg.resume and cfg.checkpoint:
+            try:
+                state, key, gens_done = ckpt.load(cfg.checkpoint,
+                                                  fingerprint)
+            except FileNotFoundError:
+                state = None
+        if state is None:
+            state = islands.init_island_population(
+                pa, k_init, mesh, cfg.pop_size)
+
+        best_seen = [INT_MAX] * n_islands
+        epoch = 0
+        while gens_done < cfg.generations:
+            if time.monotonic() - t_start > cfg.time_limit:
+                break
+            key, k_epoch = jax.random.split(key)
+            state, _trace, _gbest = runner(pa, k_epoch, state)
+            gens_done += cfg.migration_period
+            epoch += 1
+
+            hcv = np.asarray(state.hcv).reshape(n_islands, -1)[:, 0]
+            scv = np.asarray(state.scv).reshape(n_islands, -1)[:, 0]
+            now = time.monotonic() - t_start
+            for i in range(n_islands):
+                rep = jsonl.reported_best(hcv[i], scv[i])
+                if rep < best_seen[i]:
+                    best_seen[i] = rep
+                    jsonl.log_entry(out, i, 0, rep, now)
+
+            if cfg.checkpoint and epoch % cfg.checkpoint_every == 0:
+                ckpt.save(cfg.checkpoint, state, key, gens_done,
+                          fingerprint)
+
+        # final per-island solution records (endTry, ga.cpp:169-197)
+        P = cfg.pop_size
+        slots = np.asarray(state.slots).reshape(n_islands, P, -1)
+        rooms = np.asarray(state.rooms).reshape(n_islands, P, -1)
+        hcv = np.asarray(state.hcv).reshape(n_islands, P)[:, 0]
+        scv = np.asarray(state.scv).reshape(n_islands, P)[:, 0]
+        total_time = time.monotonic() - t_start
+        for i in range(n_islands):
+            feas = hcv[i] == 0
+            rep = jsonl.reported_best(hcv[i], scv[i])
+            jsonl.solution_record(
+                out, i, 0, total_time, rep, feas,
+                timeslots=slots[i, 0].tolist() if feas else None,
+                rooms=rooms[i, 0].tolist() if feas else None)
+
+        # cluster-level best (setGlobalCost's Allreduce MIN, ga.cpp:
+        # 234-257): first runEntry line
+        trial_best = min(jsonl.reported_best(hcv[i], scv[i])
+                         for i in range(n_islands))
+        feasible = bool((hcv == 0).any())
+        jsonl.run_entry(out, trial_best, feasible)
+        # final runEntry with procsNum/threadsNum/totalTime appended
+        # (ga.cpp:604-607)
+        jsonl.run_entry(out, trial_best, feasible,
+                        procs_num=n_islands, threads_num=cfg.threads,
+                        total_time=total_time)
+        global_best = min(global_best, trial_best)
+
+    return global_best

@@ -1,0 +1,76 @@
+"""JSONL reporting protocol — byte-compatible with the reference.
+
+The reference emits one JSON object per line with JsonCpp's
+StreamWriterBuilder and `indentation=""` (ga.cpp:169-171, 469-470). Three
+record types (SURVEY C18); field names verified against ga.cpp:
+
+  {"logEntry":{"procID":i,"threadID":t,"best":b,"time":s}}
+      on every new local best (ga.cpp:502, setCurrentCost 203-228);
+      `best` is scv when feasible, else hcv*1e6+scv
+  {"solution":{"procID":i,"threadID":t,"totalTime":s,"totalBest":b,
+               "feasible":f[,"timeslots":[...],"rooms":[...]]}}
+      per process at the end (endTry, ga.cpp:169-197, 474); the timetable
+      arrays are present only when feasible
+  {"runEntry":{"totalBest":b,"feasible":f}}
+      cluster-level best after the Allreduce (setGlobalCost, ga.cpp:
+      234-257), then the same object re-emitted with procsNum/threadsNum/
+      totalTime appended (ga.cpp:604-607) — both lines are reproduced.
+
+This protocol is the reference's de-facto external API, so the schema is
+kept verbatim (keys, nesting, and which records appear when).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import IO, List, Optional
+
+
+def _write(stream: IO, obj: dict) -> None:
+    stream.write(json.dumps(obj, separators=(",", ":")) + "\n")
+    stream.flush()
+
+
+def reported_best(hcv: int, scv: int) -> int:
+    """The value the protocol reports: scv when feasible, else
+    hcv*1e6+scv (ga.cpp:205-228)."""
+    return int(scv) if int(hcv) == 0 else int(hcv) * 1_000_000 + int(scv)
+
+
+def log_entry(stream: IO, proc_id: int, thread_id: int, best: int,
+              time_s: float) -> None:
+    _write(stream, {"logEntry": {
+        "procID": proc_id,
+        "threadID": thread_id,
+        "best": int(best),
+        "time": max(0.0, float(time_s)),
+    }})
+
+
+def solution_record(stream: IO, proc_id: int, thread_id: int,
+                    total_time: float, total_best: int, feasible: bool,
+                    timeslots: Optional[List[int]] = None,
+                    rooms: Optional[List[int]] = None) -> None:
+    rec = {
+        "procID": proc_id,
+        "threadID": thread_id,
+        "totalTime": float(total_time),
+        "totalBest": int(total_best),
+        "feasible": bool(feasible),
+    }
+    if feasible:
+        rec["timeslots"] = [int(x) for x in timeslots]
+        rec["rooms"] = [int(x) for x in rooms]
+    _write(stream, {"solution": rec})
+
+
+def run_entry(stream: IO, total_best: int, feasible: bool,
+              procs_num: Optional[int] = None,
+              threads_num: Optional[int] = None,
+              total_time: Optional[float] = None) -> None:
+    rec = {"totalBest": int(total_best), "feasible": bool(feasible)}
+    if procs_num is not None:
+        rec["procsNum"] = int(procs_num)
+        rec["threadsNum"] = int(threads_num)
+        rec["totalTime"] = float(total_time)
+    _write(stream, {"runEntry": rec})
