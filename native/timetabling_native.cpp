@@ -1,0 +1,564 @@
+// Native host runtime: .tim loader, fitness evaluator, and a complete
+// single-process memetic GA for the CPU backend.
+//
+// This is the C++ half of the framework (SURVEY section 7: "C++ host
+// retained... a pure-C++ single-process evaluation path so --backend=cpu
+// works without Python"). It is a clean-room implementation of the
+// *semantics* documented in SURVEY.md against the reference
+// (Problem.cpp:3-96 loader; Solution.cpp:63-170 fitness;
+// Solution.cpp:357-469 moves; Solution.cpp:772-833 room assignment with
+// greedy fallback; ga.cpp:113-145 selection; Solution.cpp:893-910
+// crossover; ga.cpp:580-585 replacement) — not a translation of the
+// reference's code.
+//
+// Build (see native/Makefile):
+//   libtimetabling_native.so  C ABI for ctypes (evaluation + GA)
+//   tt_cpu                    standalone CLI emitting the JSONL protocol
+//
+// Parallelism: OpenMP over the population inside evaluation and breeding
+// (the reference's intra-island axis, ga.cpp:488-588, without its shared
+// RNG and unlocked-read races: each individual owns an RNG stream).
+
+#include <algorithm>
+#include <climits>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace tt {
+
+// ---------------------------------------------------------------- RNG
+// SplitMix64: tiny, seedable, per-individual streams. (The reference
+// shares one Park-Miller LCG across all threads unsynchronized,
+// Random.cc:27-37 + ga.cpp:47 — a race we must not reproduce.)
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed ^ 0x9e3779b97f4a7c15ULL) {}
+  uint64_t next_u64() {
+    s += 0x9e3779b97f4a7c15ULL;
+    uint64_t z = s;
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+    return z ^ (z >> 31);
+  }
+  // unbiased-enough for GA purposes
+  int next_int(int n) { return (int)(next_u64() % (uint64_t)n); }
+  double next_double() { return (next_u64() >> 11) * (1.0 / 9007199254740992.0); }
+};
+
+// ------------------------------------------------------------- Problem
+struct Problem {
+  int E = 0, R = 0, F = 0, S = 0;
+  int days = 5, spd = 9;              // timeslot grid (45 = 5 x 9)
+  std::vector<int> room_size;         // (R)
+  std::vector<int8_t> attends;        // (S, E)
+  std::vector<int8_t> room_features;  // (R, F)
+  std::vector<int8_t> event_features; // (E, F)
+  // derived (Problem.cpp:34-95 semantics)
+  std::vector<int> student_count;     // (E)
+  std::vector<int8_t> conflict;       // (E, E)
+  std::vector<int8_t> possible;       // (E, R)
+  std::vector<std::vector<int>> suitable; // per event: suitable room list
+  int n_slots() const { return days * spd; }
+
+  void derive() {
+    student_count.assign(E, 0);
+    for (int s = 0; s < S; ++s)
+      for (int e = 0; e < E; ++e)
+        if (attends[(size_t)s * E + e]) student_count[e]++;
+
+    conflict.assign((size_t)E * E, 0);
+    for (int s = 0; s < S; ++s)
+      for (int i = 0; i < E; ++i)
+        if (attends[(size_t)s * E + i])
+          for (int j = 0; j < E; ++j)
+            if (attends[(size_t)s * E + j]) conflict[(size_t)i * E + j] = 1;
+
+    possible.assign((size_t)E * R, 0);
+    suitable.assign(E, {});
+    for (int e = 0; e < E; ++e)
+      for (int r = 0; r < R; ++r) {
+        if (room_size[r] < student_count[e]) continue;
+        bool ok = true;
+        for (int f = 0; f < F && ok; ++f)
+          if (event_features[(size_t)e * F + f] &&
+              !room_features[(size_t)r * F + f]) ok = false;
+        if (ok) {
+          possible[(size_t)e * R + r] = 1;
+          suitable[e].push_back(r);
+        }
+      }
+  }
+};
+
+static bool load_tim(const char *path, Problem &p) {
+  FILE *fh = std::fopen(path, "r");
+  if (!fh) return false;
+  auto rd = [&](int &out) { return std::fscanf(fh, "%d", &out) == 1; };
+  if (!rd(p.E) || !rd(p.R) || !rd(p.F) || !rd(p.S)) { std::fclose(fh); return false; }
+  p.room_size.resize(p.R);
+  for (int r = 0; r < p.R; ++r) if (!rd(p.room_size[r])) { std::fclose(fh); return false; }
+  auto rd8 = [&](std::vector<int8_t> &v, size_t n) {
+    v.resize(n);
+    for (size_t i = 0; i < n; ++i) {
+      int x; if (std::fscanf(fh, "%d", &x) != 1) return false;
+      v[i] = (int8_t)x;
+    }
+    return true;
+  };
+  bool ok = rd8(p.attends, (size_t)p.S * p.E) &&
+            rd8(p.room_features, (size_t)p.R * p.F) &&
+            rd8(p.event_features, (size_t)p.E * p.F);
+  std::fclose(fh);
+  if (ok) p.derive();
+  return ok;
+}
+
+// ------------------------------------------------------------- fitness
+// Exact count semantics of Solution::computeHcv / computeScv
+// (Solution.cpp:86-160); see the Python oracle for the same spec.
+static int compute_hcv(const Problem &p, const int *slots, const int *rooms) {
+  int hcv = 0;
+  for (int i = 0; i < p.E; ++i) {
+    for (int j = i + 1; j < p.E; ++j) {
+      if (slots[i] == slots[j]) {
+        if (rooms[i] == rooms[j]) hcv++;
+        if (p.conflict[(size_t)i * p.E + j]) hcv++;
+      }
+    }
+    if (!p.possible[(size_t)i * p.R + rooms[i]]) hcv++;
+  }
+  return hcv;
+}
+
+static int compute_scv(const Problem &p, const int *slots,
+                       std::vector<uint8_t> &att_scratch) {
+  const int T = p.n_slots();
+  int scv = 0;
+  for (int e = 0; e < p.E; ++e)
+    if (slots[e] % p.spd == p.spd - 1) scv += p.student_count[e];
+
+  att_scratch.assign((size_t)p.S * T, 0);
+  for (int e = 0; e < p.E; ++e) {
+    const int t = slots[e];
+    for (int s = 0; s < p.S; ++s)
+      if (p.attends[(size_t)s * p.E + e]) att_scratch[(size_t)s * T + t] = 1;
+  }
+  for (int s = 0; s < p.S; ++s) {
+    const uint8_t *row = &att_scratch[(size_t)s * T];
+    for (int d = 0; d < p.days; ++d) {
+      int consec = 0, cnt = 0;
+      for (int k = 0; k < p.spd; ++k) {
+        if (row[d * p.spd + k]) {
+          cnt++; consec++;
+          if (consec > 2) scv++;
+        } else consec = 0;
+      }
+      if (cnt == 1) scv++;
+    }
+  }
+  return scv;
+}
+
+static long long penalty_of(int hcv, int scv) {
+  return hcv == 0 ? (long long)scv : 1000000LL + hcv;  // Solution.cpp:162-170
+}
+
+// ------------------------------------------------------ room assignment
+// Greedy most-constrained-first matching; same policy as the JAX kernel
+// (ops/rooms.py) and the reference's unmatched fallback
+// (Solution.cpp:814-830): free suitable best-fit, else least-busy
+// suitable, else least-busy any.
+// Stateless w.r.t. assignment: `assign_all` keeps its occupancy grid on
+// the stack so one Matcher is safely shared by all OpenMP threads.
+struct Matcher {
+  const Problem &p;
+  std::vector<int> order;        // events by ascending #suitable
+  std::vector<int> cap_rank;     // rooms by ascending capacity
+  explicit Matcher(const Problem &pp) : p(pp) {
+    order.resize(p.E);
+    for (int e = 0; e < p.E; ++e) order[e] = e;
+    std::stable_sort(order.begin(), order.end(), [&](int a, int b) {
+      return p.suitable[a].size() < p.suitable[b].size();
+    });
+    std::vector<int> by_cap(p.R);
+    for (int r = 0; r < p.R; ++r) by_cap[r] = r;
+    std::stable_sort(by_cap.begin(), by_cap.end(), [&](int a, int b) {
+      return p.room_size[a] < p.room_size[b];
+    });
+    cap_rank.assign(p.R, 0);
+    for (int i = 0; i < p.R; ++i) cap_rank[by_cap[i]] = i;
+  }
+
+  int choose(const int *occ_row, int e) const {
+    long best_key = LONG_MAX;
+    int best_r = 0;
+    for (int r = 0; r < p.R; ++r) {
+      long key = (p.possible[(size_t)e * p.R + r] ? 0L : (1L << 24)) +
+                 (long)occ_row[r] * (1L << 12) + cap_rank[r];
+      if (key < best_key) { best_key = key; best_r = r; }
+    }
+    return best_r;
+  }
+
+  void assign_all(const int *slots, int *rooms) const {
+    std::vector<int> occ((size_t)p.n_slots() * p.R, 0);
+    for (int k = 0; k < p.E; ++k) {
+      const int e = order[k], t = slots[e];
+      int *row = &occ[(size_t)t * p.R];
+      const int r = choose(row, e);
+      rooms[e] = r;
+      row[r]++;
+    }
+  }
+
+  // re-room one moved event given current rooms of all others
+  int insert(const int *slots, const int *rooms, int e, int new_t) const {
+    std::vector<int> row(p.R, 0);
+    for (int j = 0; j < p.E; ++j)
+      if (j != e && slots[j] == new_t) row[rooms[j]]++;
+    return choose(row.data(), e);
+  }
+};
+
+// ---------------------------------------------------------------- moves
+// Move1/2/3 semantics (Solution.cpp:357-439) with greedy insert
+// re-rooming, matching ops/moves.py.
+struct MoveCtx {
+  const Problem &p;
+  const Matcher &m;
+  Rng &rng;
+  double p1, p2, p3;
+};
+
+static void random_move(const MoveCtx &c, std::vector<int> &slots,
+                        std::vector<int> &rooms) {
+  const int E = c.p.E, T = c.p.n_slots();
+  double tot = c.p1 + c.p2 + c.p3;
+  double u = c.rng.next_double() * (tot > 0 ? tot : 1.0);
+  int e1 = c.rng.next_int(E), e2, e3;
+  do { e2 = c.rng.next_int(E); } while (e2 == e1 && E > 1);
+  do { e3 = c.rng.next_int(E); } while ((e3 == e1 || e3 == e2) && E > 2);
+
+  if (u < c.p1 || tot <= 0) {                       // Move1
+    const int t = c.rng.next_int(T);
+    slots[e1] = t;
+    rooms[e1] = c.m.insert(slots.data(), rooms.data(), e1, t);
+  } else if (u < c.p1 + c.p2) {                     // Move2: swap slots
+    std::swap(slots[e1], slots[e2]);
+    rooms[e1] = c.m.insert(slots.data(), rooms.data(), e1, slots[e1]);
+    rooms[e2] = c.m.insert(slots.data(), rooms.data(), e2, slots[e2]);
+  } else {                                          // Move3: 3-cycle
+    const int t1 = slots[e1];
+    slots[e1] = slots[e2]; slots[e2] = slots[e3]; slots[e3] = t1;
+    rooms[e1] = c.m.insert(slots.data(), rooms.data(), e1, slots[e1]);
+    rooms[e2] = c.m.insert(slots.data(), rooms.data(), e2, slots[e2]);
+    rooms[e3] = c.m.insert(slots.data(), rooms.data(), e3, slots[e3]);
+  }
+}
+
+// ------------------------------------------------------------------- GA
+struct Individual {
+  std::vector<int> slots, rooms;
+  int hcv = 0, scv = 0;
+  long long pen = 0;
+};
+
+struct GaParams {
+  int pop_size = 10;          // ga.cpp:64
+  int generations = 2001;     // ga.cpp:510
+  int tournament_k = 5;       // ga.cpp:129-145
+  double p_crossover = 0.8;   // ga.cpp:562
+  double p_mutation = 0.5;    // ga.cpp:569
+  double p1 = 1.0, p2 = 1.0, p3 = 0.0;
+  int ls_rounds = 25;         // maxSteps / ls_candidates
+  int ls_candidates = 8;
+  uint64_t seed = 1;
+  double time_limit = 90.0;   // Control.cpp:62-68
+  int threads = 1;
+};
+
+static void evaluate(const Problem &p, Individual &ind,
+                     std::vector<uint8_t> &scratch) {
+  ind.hcv = compute_hcv(p, ind.slots.data(), ind.rooms.data());
+  ind.scv = compute_scv(p, ind.slots.data(), scratch);
+  ind.pen = penalty_of(ind.hcv, ind.scv);
+}
+
+// K-candidate hill climb, same acceptance rule as ops/local_search.py
+static void local_search(const Problem &p, const Matcher &m, Rng &rng,
+                         Individual &ind, const GaParams &g,
+                         std::vector<uint8_t> &scratch) {
+  Individual cand = ind, best = ind;
+  for (int round = 0; round < g.ls_rounds; ++round) {
+    bool improved = false;
+    best.pen = ind.pen;
+    for (int k = 0; k < g.ls_candidates; ++k) {
+      cand = ind;
+      MoveCtx c{p, m, rng, g.p1, g.p2, g.p3};
+      random_move(c, cand.slots, cand.rooms);
+      evaluate(p, cand, scratch);
+      if (cand.pen < best.pen) { best = cand; improved = true; }
+    }
+    if (improved) ind = best;
+  }
+}
+
+struct LogSink {
+  FILE *os = stdout;
+  void log_entry(int proc, int tid, long long best, double t) const {
+    std::fprintf(os,
+                 "{\"logEntry\":{\"procID\":%d,\"threadID\":%d,\"best\":%lld,"
+                 "\"time\":%.6f}}\n", proc, tid, best, t < 0 ? 0.0 : t);
+  }
+};
+
+static long long reported(const Individual &i) {  // ga.cpp:191
+  return i.hcv == 0 ? (long long)i.scv
+                    : (long long)i.hcv * 1000000LL + i.scv;
+}
+
+static double now_sec() {
+#ifdef _OPENMP
+  return omp_get_wtime();
+#else
+  return (double)clock() / CLOCKS_PER_SEC;
+#endif
+}
+
+// Generational mu+lambda GA, one island (the per-device program of the
+// TPU path, ops/ga.py, in native form).
+static Individual run_ga(const Problem &p, const GaParams &g,
+                         const LogSink *sink, int proc_id) {
+  Matcher m(p);
+  const int P = g.pop_size;
+  const double t0 = now_sec();
+
+  std::vector<Individual> pop(P), children(P);
+  std::vector<Rng> rngs;
+  for (int i = 0; i < 2 * P; ++i)
+    rngs.emplace_back(g.seed * 0x5851f42d4c957f2dULL + i);
+
+  const int nthreads = g.threads > 0 ? g.threads : 1;
+
+#pragma omp parallel num_threads(nthreads)
+  {
+    std::vector<uint8_t> scratch;
+#pragma omp for
+    for (int i = 0; i < P; ++i) {
+      Individual &ind = pop[i];
+      ind.slots.resize(p.E);
+      ind.rooms.resize(p.E);
+      for (int e = 0; e < p.E; ++e)
+        ind.slots[e] = rngs[i].next_int(p.n_slots());
+      m.assign_all(ind.slots.data(), ind.rooms.data());
+      evaluate(p, ind, scratch);
+      local_search(p, m, rngs[i], ind, g, scratch);
+    }
+  }
+  auto by_pen = [](const Individual &a, const Individual &b) {
+    return a.pen < b.pen;
+  };
+  std::sort(pop.begin(), pop.end(), by_pen);
+  long long best_seen = LLONG_MAX;
+
+  for (int gen = 0; gen < g.generations; ++gen) {
+    if (now_sec() - t0 > g.time_limit) break;
+#pragma omp parallel num_threads(nthreads)
+    {
+      std::vector<uint8_t> scratch;
+#pragma omp for
+      for (int i = 0; i < P; ++i) {
+        Rng &rng = rngs[P + i];
+        // tournament-5 x2 (ga.cpp:129-145)
+        auto pick = [&]() {
+          int best = rng.next_int(P);
+          for (int k = 1; k < g.tournament_k; ++k) {
+            int c = rng.next_int(P);
+            if (pop[c].pen < pop[best].pen) best = c;
+          }
+          return best;
+        };
+        const Individual &pa_ = pop[pick()];
+        const Individual &pb_ = pop[pick()];
+        Individual &ch = children[i];
+        ch = pa_;
+        if (rng.next_double() < g.p_crossover) {   // uniform (C11)
+          for (int e = 0; e < p.E; ++e)
+            if (rng.next_double() < 0.5) ch.slots[e] = pb_.slots[e];
+          m.assign_all(ch.slots.data(), ch.rooms.data());  // full rematch
+        }
+        if (rng.next_double() < g.p_mutation) {    // one move (C12)
+          MoveCtx c{p, m, rng, g.p1, g.p2, g.p3};
+          random_move(c, ch.slots, ch.rooms);
+        }
+        evaluate(p, ch, scratch);
+        local_search(p, m, rng, ch, g, scratch);
+      }
+    }
+    // mu+lambda truncation (generational variant of ga.cpp:580-585)
+    std::vector<Individual> all;
+    all.reserve(2 * P);
+    for (auto &x : pop) all.push_back(std::move(x));
+    // children[i] is unconditionally reassigned next generation
+    for (auto &x : children) all.push_back(std::move(x));
+    std::sort(all.begin(), all.end(), by_pen);
+    for (int i = 0; i < P; ++i) pop[i] = std::move(all[i]);
+
+    const long long rep = reported(pop[0]);
+    if (sink && rep < best_seen) {
+      best_seen = rep;
+      sink->log_entry(proc_id, 0, rep, now_sec() - t0);
+    }
+  }
+  return pop[0];
+}
+
+}  // namespace tt
+
+// =====================================================================
+// C ABI (ctypes surface)
+
+extern "C" {
+
+// Batch-evaluate P individuals; returns 0 on success. Arrays are dense
+// int32 row-major; out arrays length P.
+int tt_eval_batch(int E, int R, int F, int S, int days, int spd,
+                  const int *room_size, const int8_t *attends,
+                  const int8_t *room_features, const int8_t *event_features,
+                  const int *slots, const int *rooms, int P,
+                  long long *out_pen, int *out_hcv, int *out_scv,
+                  int threads) {
+  tt::Problem p;
+  p.E = E; p.R = R; p.F = F; p.S = S; p.days = days; p.spd = spd;
+  p.room_size.assign(room_size, room_size + R);
+  p.attends.assign(attends, attends + (size_t)S * E);
+  p.room_features.assign(room_features, room_features + (size_t)R * F);
+  p.event_features.assign(event_features, event_features + (size_t)E * F);
+  p.derive();
+  const int nthreads = threads > 0 ? threads : 1;
+  // num_threads clause, NOT omp_set_num_threads: this runs inside the
+  // caller's (Python) process and must not mutate its global OpenMP state
+#pragma omp parallel num_threads(nthreads)
+  {
+    std::vector<uint8_t> scratch;
+#pragma omp for
+    for (int i = 0; i < P; ++i) {
+      const int *s = slots + (size_t)i * E;
+      const int *r = rooms + (size_t)i * E;
+      const int hcv = tt::compute_hcv(p, s, r);
+      const int scv = tt::compute_scv(p, s, scratch);
+      out_hcv[i] = hcv;
+      out_scv[i] = scv;
+      out_pen[i] = tt::penalty_of(hcv, scv);
+    }
+  }
+  return 0;
+}
+
+// Greedy room matching for P individuals (same policy as ops/rooms.py).
+int tt_assign_rooms(int E, int R, int F, int S, int days, int spd,
+                    const int *room_size, const int8_t *attends,
+                    const int8_t *room_features, const int8_t *event_features,
+                    const int *slots, int P, int *out_rooms) {
+  tt::Problem p;
+  p.E = E; p.R = R; p.F = F; p.S = S; p.days = days; p.spd = spd;
+  p.room_size.assign(room_size, room_size + R);
+  p.attends.assign(attends, attends + (size_t)S * E);
+  p.room_features.assign(room_features, room_features + (size_t)R * F);
+  p.event_features.assign(event_features, event_features + (size_t)E * F);
+  p.derive();
+  tt::Matcher m(p);
+  for (int i = 0; i < P; ++i)
+    m.assign_all(slots + (size_t)i * E, out_rooms + (size_t)i * E);
+  return 0;
+}
+
+}  // extern "C"
+
+// =====================================================================
+// Standalone CLI (tt_cpu): the reference binary's role on a CPU host.
+#ifdef TT_MAIN
+
+int main(int argc, char **argv) {
+  const char *input = nullptr, *output = nullptr;
+  tt::GaParams g;
+  int problem_type = 1;
+  bool max_steps_set = false;
+  int max_steps = 200;
+
+  for (int i = 1; i + 1 < argc + 1; ++i) {
+    std::string a = argv[i] ? argv[i] : "";
+    auto val = [&]() { return (i + 1 < argc) ? argv[++i] : nullptr; };
+    if (a == "-i") input = val();
+    else if (a == "-o") output = val();
+    else if (a == "-s") { const char *v = val(); if (v) g.seed = std::strtoull(v, nullptr, 10); }
+    else if (a == "-c") { const char *v = val(); if (v) g.threads = std::atoi(v); }
+    else if (a == "-t") { const char *v = val(); if (v) g.time_limit = std::atof(v); }
+    else if (a == "-p") { const char *v = val(); if (v) problem_type = std::atoi(v); }
+    else if (a == "-m") { const char *v = val(); if (v) { max_steps = std::atoi(v); max_steps_set = true; } }
+    else if (a == "-p1") { const char *v = val(); if (v) g.p1 = std::atof(v); }
+    else if (a == "-p2") { const char *v = val(); if (v) g.p2 = std::atof(v); }
+    else if (a == "-p3") { const char *v = val(); if (v) g.p3 = std::atof(v); }
+    else if (a == "--pop-size") { const char *v = val(); if (v) g.pop_size = std::atoi(v); }
+    else if (a == "--generations") { const char *v = val(); if (v) g.generations = std::atoi(v); }
+    else if (a == "--ls-candidates") { const char *v = val(); if (v) g.ls_candidates = std::atoi(v); }
+    else if (!a.empty()) { std::fprintf(stderr, "unknown flag: %s\n", a.c_str()); return 2; }
+  }
+  if (!input) { std::fprintf(stderr, "No instance file specified, use -i <file>\n"); return 2; }
+  if (!max_steps_set)
+    max_steps = problem_type == 1 ? 200 : problem_type == 2 ? 1000 : 2000;
+  g.ls_rounds = std::max(1, max_steps / g.ls_candidates);
+
+  tt::Problem p;
+  if (!tt::load_tim(input, p)) {
+    std::fprintf(stderr, "cannot parse instance: %s\n", input);
+    return 1;
+  }
+
+  tt::LogSink sink;
+  if (output) {
+    sink.os = std::fopen(output, "w");
+    if (!sink.os) { std::fprintf(stderr, "cannot open %s\n", output); return 1; }
+  }
+
+  const double t0 = tt::now_sec();
+  tt::Individual best = tt::run_ga(p, g, &sink, 0);
+  const double dt = tt::now_sec() - t0;
+  const long long rep = tt::reported(best);
+  const bool feas = best.hcv == 0;
+
+  // solution record (endTry, ga.cpp:169-197)
+  std::fprintf(sink.os,
+               "{\"solution\":{\"procID\":0,\"threadID\":0,\"totalTime\":%.6f,"
+               "\"totalBest\":%lld,\"feasible\":%s", dt, rep,
+               feas ? "true" : "false");
+  if (feas) {
+    std::fprintf(sink.os, ",\"timeslots\":[");
+    for (int e = 0; e < p.E; ++e)
+      std::fprintf(sink.os, "%s%d", e ? "," : "", best.slots[e]);
+    std::fprintf(sink.os, "],\"rooms\":[");
+    for (int e = 0; e < p.E; ++e)
+      std::fprintf(sink.os, "%s%d", e ? "," : "", best.rooms[e]);
+    std::fprintf(sink.os, "]");
+  }
+  std::fprintf(sink.os, "}}\n");
+  // runEntry pair (setGlobalCost + final, ga.cpp:234-257, 603-609)
+  std::fprintf(sink.os, "{\"runEntry\":{\"totalBest\":%lld,\"feasible\":%s}}\n",
+               rep, feas ? "true" : "false");
+  std::fprintf(sink.os,
+               "{\"runEntry\":{\"totalBest\":%lld,\"feasible\":%s,"
+               "\"procsNum\":1,\"threadsNum\":%d,\"totalTime\":%.6f}}\n",
+               rep, feas ? "true" : "false", g.threads, dt);
+  if (output) std::fclose(sink.os);
+  return 0;
+}
+
+#endif  // TT_MAIN

@@ -1,0 +1,83 @@
+"""Native C++ component tests: the third independent implementation of
+the fitness semantics (C++ vs JAX kernels vs Python oracle) must agree
+exactly; the standalone CPU binary must emit the JSONL protocol.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from timetabling_ga_tpu import native
+from timetabling_ga_tpu.ops import fitness
+from timetabling_ga_tpu.problem import dump_tim, random_instance
+from tests.conftest import random_assignment
+
+pytestmark = pytest.mark.skipif(
+    not native.is_available(),
+    reason=f"native lib unavailable: {native.load_error()}")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TT_CPU = os.path.join(REPO, "native", "tt_cpu")
+
+
+def test_native_eval_matches_jax(medium_problem):
+    pa = medium_problem.device_arrays()
+    rng = np.random.default_rng(0)
+    slots, rooms = random_assignment(rng, medium_problem, 32)
+    pen_j, hcv_j, scv_j = (np.asarray(x) for x in
+                           fitness.batch_penalty(pa, slots, rooms))
+    pen_n, hcv_n, scv_n = native.eval_batch(medium_problem, slots, rooms,
+                                            threads=2)
+    np.testing.assert_array_equal(hcv_n, hcv_j)
+    np.testing.assert_array_equal(scv_n, scv_j)
+    np.testing.assert_array_equal(pen_n, pen_j.astype(np.int64))
+
+
+def test_native_matcher_suitability(small_problem):
+    rng = np.random.default_rng(1)
+    slots, _ = random_assignment(rng, small_problem, 8)
+    rooms = native.assign_rooms_batch(small_problem, slots)
+    for p in range(8):
+        for e in range(small_problem.n_events):
+            if small_problem.possible[e].any():
+                assert small_problem.possible[e][rooms[p, e]]
+
+
+def test_native_matcher_matches_jax_policy(small_problem):
+    """C++ matcher implements the same greedy policy as ops/rooms.py —
+    assignments must be identical."""
+    from timetabling_ga_tpu.ops import rooms as rooms_ops
+    pa = small_problem.device_arrays()
+    rng = np.random.default_rng(2)
+    slots, _ = random_assignment(rng, small_problem, 8)
+    native_rooms = native.assign_rooms_batch(small_problem, slots)
+    jax_rooms = np.asarray(rooms_ops.batch_assign_rooms(pa, slots))
+    np.testing.assert_array_equal(native_rooms, jax_rooms)
+
+
+@pytest.mark.skipif(not os.path.exists(TT_CPU), reason="tt_cpu not built")
+def test_tt_cpu_end_to_end(tmp_path):
+    problem = random_instance(77, n_events=20, n_rooms=5, n_features=2,
+                              n_students=12, attend_prob=0.1)
+    inst = tmp_path / "inst.tim"
+    inst.write_text(dump_tim(problem))
+    out = subprocess.run(
+        [TT_CPU, "-i", str(inst), "-s", "3", "-c", "2",
+         "--pop-size", "16", "--generations", "40", "-t", "60"],
+        capture_output=True, text=True, timeout=120, check=True)
+    lines = [json.loads(x) for x in out.stdout.splitlines()]
+    kinds = [next(iter(x)) for x in lines]
+    assert kinds.count("solution") == 1
+    assert kinds.count("runEntry") == 2
+    sol = next(x["solution"] for x in lines if "solution" in x)
+    if sol["feasible"]:
+        # validate the timetable against the Python oracle
+        from timetabling_ga_tpu.oracle import oracle_hcv, oracle_scv
+        slots = sol["timeslots"]
+        rooms = sol["rooms"]
+        assert oracle_hcv(problem, slots, rooms) == 0
+        assert oracle_scv(problem, slots) == sol["totalBest"]

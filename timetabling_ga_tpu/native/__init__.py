@@ -1,0 +1,118 @@
+"""ctypes bindings for the native C++ components (native/*.cpp).
+
+Loads `libtimetabling_native.so` (built by `make -C native`; an
+auto-build is attempted on first use). Exposes:
+
+  - `eval_batch(problem, slots, rooms, threads)` — the C++ scalar
+    evaluator over a population; an independent third implementation of
+    the fitness semantics (JAX kernels, Python oracle, C++), used for
+    cross-checking and as the CPU-side baseline in benchmarks.
+  - `assign_rooms_batch(problem, slots)` — the C++ greedy matcher.
+
+No pybind11 in this image, so the surface is a C ABI + ctypes
+(per-project constraint); arrays cross as dense int32/int8 buffers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libtimetabling_native.so")
+
+_lib = None
+_load_error: Optional[str] = None
+
+
+def _try_load():
+    global _lib, _load_error
+    if _lib is not None or _load_error is not None:
+        return
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR,
+                            "libtimetabling_native.so"],
+                           capture_output=True, check=True, timeout=300)
+        except Exception as e:
+            _load_error = f"native build failed: {e}"
+            return
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError as e:
+        _load_error = f"cannot load {_LIB_PATH}: {e}"
+        return
+
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    i8p = np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.tt_eval_batch.restype = ctypes.c_int
+    lib.tt_eval_batch.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
+        i32p, i8p, i8p, i8p,
+        i32p, i32p, ctypes.c_int,
+        i64p, i32p, i32p, ctypes.c_int]
+    lib.tt_assign_rooms.restype = ctypes.c_int
+    lib.tt_assign_rooms.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
+        i32p, i8p, i8p, i8p,
+        i32p, ctypes.c_int, i32p]
+    _lib = lib
+
+
+def is_available() -> bool:
+    _try_load()
+    return _lib is not None
+
+
+def load_error() -> Optional[str]:
+    _try_load()
+    return _load_error
+
+
+def _problem_args(problem):
+    return (problem.n_events, problem.n_rooms, problem.n_features,
+            problem.n_students, problem.n_days, problem.slots_per_day,
+            np.ascontiguousarray(problem.room_size, np.int32),
+            np.ascontiguousarray(problem.attends, np.int8),
+            np.ascontiguousarray(problem.room_features, np.int8),
+            np.ascontiguousarray(problem.event_features, np.int8))
+
+
+def eval_batch(problem, slots, rooms, threads: int = 1):
+    """(P, E) int32 arrays -> (penalty int64, hcv int32, scv int32)."""
+    _try_load()
+    if _lib is None:
+        raise RuntimeError(_load_error)
+    slots = np.ascontiguousarray(slots, np.int32)
+    rooms = np.ascontiguousarray(rooms, np.int32)
+    P = slots.shape[0]
+    pen = np.empty(P, np.int64)
+    hcv = np.empty(P, np.int32)
+    scv = np.empty(P, np.int32)
+    rc = _lib.tt_eval_batch(*_problem_args(problem), slots, rooms, P,
+                            pen, hcv, scv, threads)
+    if rc != 0:
+        raise RuntimeError(f"tt_eval_batch failed: {rc}")
+    return pen, hcv, scv
+
+
+def assign_rooms_batch(problem, slots):
+    """(P, E) slots -> (P, E) rooms via the C++ greedy matcher."""
+    _try_load()
+    if _lib is None:
+        raise RuntimeError(_load_error)
+    slots = np.ascontiguousarray(slots, np.int32)
+    P = slots.shape[0]
+    rooms = np.empty_like(slots)
+    rc = _lib.tt_assign_rooms(*_problem_args(problem), slots, P, rooms)
+    if rc != 0:
+        raise RuntimeError(f"tt_assign_rooms failed: {rc}")
+    return rooms

@@ -8,12 +8,13 @@ improving move; its step counter resets on every improvement, and >95% of
 program time is spent here (SURVEY section 3.2). Data-dependent loops and
 per-candidate allocations cannot map onto XLA.
 
-The redesign (SURVEY section 7.4): per individual, each round proposes K
-random candidate moves, evaluates ALL of them with the batched fitness
-kernels, and accepts the best candidate if it strictly improves. Rounds
-run under `lax.scan` with fixed shapes; `vmap` runs every individual's
-search simultaneously, so one TPU dispatch performs P*K candidate
-evaluations per round.
+The redesign (SURVEY section 7.4): each round proposes K random candidate
+moves per individual, evaluates them ALL with the batched population
+kernel (`fitness.batch_penalty` on a (P,)-shaped candidate batch per
+candidate slot, sequenced over K with `lax.map` to bound memory), and
+accepts each individual's best candidate if it strictly improves. Rounds
+run under `lax.scan` with fixed shapes; one TPU dispatch performs
+P*K*n_rounds candidate evaluations.
 
 The reference's two phases — hcv repair while infeasible
 (Solution.cpp:497-618), then scv polish that never re-breaks feasibility
@@ -36,50 +37,60 @@ from timetabling_ga_tpu.ops.moves import random_move
 from timetabling_ga_tpu.ops.rooms import capacity_rank
 
 
-def local_search(pa, key, slots, rooms_arr, n_rounds: int,
-                 n_candidates: int = 8,
-                 p1: float = 1.0, p2: float = 1.0, p3: float = 0.0):
-    """Hill-climb one individual for `n_rounds` fixed-shape rounds.
+def batch_local_search(pa, key, slots, rooms_arr, n_rounds: int,
+                       n_candidates: int = 8,
+                       p1: float = 1.0, p2: float = 1.0, p3: float = 0.0):
+    """Hill-climb a whole population (P, E) for `n_rounds` rounds.
 
-    Each round: K random moves -> evaluate all -> accept argmin penalty if
-    strictly better (the batched analogue of first-improvement with
-    counter reset, Solution.cpp:521-527). Returns (slots, rooms).
+    Returns improved (slots, rooms). Population-level: every round all P
+    individuals propose and evaluate K candidates simultaneously.
     """
     cap_rank = capacity_rank(pa)
+    P = slots.shape[0]
+
+    def propose(k, s, r):
+        """One candidate move for every individual: (P, E) -> (P, E)."""
+        keys = jax.random.split(k, P)
+        return jax.vmap(
+            lambda kk, ss, rr: random_move(pa, kk, ss, rr, p1, p2, p3,
+                                           cap_rank))(keys, s, r)
 
     def one_round(carry, k):
         s, r, pen = carry
-        keys = jax.random.split(k, n_candidates)
-        c_slots, c_rooms = jax.vmap(
-            lambda kk: random_move(pa, kk, s, r, p1, p2, p3, cap_rank)
-        )(keys)                                        # (K, E) each
-        c_pen, _, _ = jax.vmap(
-            lambda cs, cr: fitness.compute_penalty(pa, cs, cr)
-        )(c_slots, c_rooms)                            # (K,)
-        best = jnp.argmin(c_pen)
-        better = c_pen[best] < pen
-        s = jnp.where(better, c_slots[best], s)
-        r = jnp.where(better, c_rooms[best], r)
-        pen = jnp.where(better, c_pen[best], pen)
+
+        def eval_candidate(kk):
+            cs, cr = propose(kk, s, r)
+            cpen, _, _ = fitness.batch_penalty(pa, cs, cr)
+            return cs, cr, cpen
+
+        # K sequential P-wide evaluations: full MXU utilization per
+        # evaluation, O(P) (not O(P*K)) peak memory.
+        cand_keys = jax.random.split(k, n_candidates)
+        c_slots, c_rooms, c_pen = lax.map(eval_candidate, cand_keys)
+
+        best = jnp.argmin(c_pen, axis=0)                  # (P,)
+        ar = jnp.arange(P)
+        best_pen = c_pen[best, ar]
+        better = best_pen < pen                           # (P,)
+        s = jnp.where(better[:, None], c_slots[best, ar], s)
+        r = jnp.where(better[:, None], c_rooms[best, ar], r)
+        pen = jnp.where(better, best_pen, pen)
         return (s, r, pen), None
 
-    pen0, _, _ = fitness.compute_penalty(pa, slots, rooms_arr)
+    pen0, _, _ = fitness.batch_penalty(pa, slots, rooms_arr)
     keys = jax.random.split(key, n_rounds)
     (slots, rooms_arr, _), _ = lax.scan(
         one_round, (slots, rooms_arr, pen0), keys)
     return slots, rooms_arr
 
 
-def batch_local_search(pa, key, slots, rooms_arr, n_rounds: int,
-                       n_candidates: int = 8,
-                       p1: float = 1.0, p2: float = 1.0, p3: float = 0.0):
-    """Run `local_search` on a whole population (P, E) simultaneously."""
-    P = slots.shape[0]
-    keys = jax.random.split(key, P)
-    return jax.vmap(
-        lambda k, s, r: local_search(pa, k, s, r, n_rounds, n_candidates,
-                                     p1, p2, p3)
-    )(keys, slots, rooms_arr)
+def local_search(pa, key, slots, rooms_arr, n_rounds: int,
+                 n_candidates: int = 8,
+                 p1: float = 1.0, p2: float = 1.0, p3: float = 0.0):
+    """Single-individual form (E,) — thin wrapper over the batched path."""
+    s, r = batch_local_search(pa, key, slots[None], rooms_arr[None],
+                              n_rounds, n_candidates, p1, p2, p3)
+    return s[0], r[0]
 
 
 @functools.partial(jax.jit,
