@@ -428,21 +428,32 @@ static Individual run_ga(const Problem &p, const GaParams &g,
 
 extern "C" {
 
+// Opaque problem handle: parse + derive once, reuse across calls (the
+// O(S*E^2) conflict derivation would otherwise dominate every batch).
+void *tt_problem_create(int E, int R, int F, int S, int days, int spd,
+                        const int *room_size, const int8_t *attends,
+                        const int8_t *room_features,
+                        const int8_t *event_features) {
+  auto *p = new tt::Problem();
+  p->E = E; p->R = R; p->F = F; p->S = S; p->days = days; p->spd = spd;
+  p->room_size.assign(room_size, room_size + R);
+  p->attends.assign(attends, attends + (size_t)S * E);
+  p->room_features.assign(room_features, room_features + (size_t)R * F);
+  p->event_features.assign(event_features, event_features + (size_t)E * F);
+  p->derive();
+  return p;
+}
+
+void tt_problem_free(void *handle) {
+  delete static_cast<tt::Problem *>(handle);
+}
+
 // Batch-evaluate P individuals; returns 0 on success. Arrays are dense
 // int32 row-major; out arrays length P.
-int tt_eval_batch(int E, int R, int F, int S, int days, int spd,
-                  const int *room_size, const int8_t *attends,
-                  const int8_t *room_features, const int8_t *event_features,
-                  const int *slots, const int *rooms, int P,
+int tt_eval_batch(void *handle, const int *slots, const int *rooms, int P,
                   long long *out_pen, int *out_hcv, int *out_scv,
                   int threads) {
-  tt::Problem p;
-  p.E = E; p.R = R; p.F = F; p.S = S; p.days = days; p.spd = spd;
-  p.room_size.assign(room_size, room_size + R);
-  p.attends.assign(attends, attends + (size_t)S * E);
-  p.room_features.assign(room_features, room_features + (size_t)R * F);
-  p.event_features.assign(event_features, event_features + (size_t)E * F);
-  p.derive();
+  const tt::Problem &p = *static_cast<tt::Problem *>(handle);
   const int nthreads = threads > 0 ? threads : 1;
   // num_threads clause, NOT omp_set_num_threads: this runs inside the
   // caller's (Python) process and must not mutate its global OpenMP state
@@ -451,8 +462,8 @@ int tt_eval_batch(int E, int R, int F, int S, int days, int spd,
     std::vector<uint8_t> scratch;
 #pragma omp for
     for (int i = 0; i < P; ++i) {
-      const int *s = slots + (size_t)i * E;
-      const int *r = rooms + (size_t)i * E;
+      const int *s = slots + (size_t)i * p.E;
+      const int *r = rooms + (size_t)i * p.E;
       const int hcv = tt::compute_hcv(p, s, r);
       const int scv = tt::compute_scv(p, s, scratch);
       out_hcv[i] = hcv;
@@ -464,20 +475,11 @@ int tt_eval_batch(int E, int R, int F, int S, int days, int spd,
 }
 
 // Greedy room matching for P individuals (same policy as ops/rooms.py).
-int tt_assign_rooms(int E, int R, int F, int S, int days, int spd,
-                    const int *room_size, const int8_t *attends,
-                    const int8_t *room_features, const int8_t *event_features,
-                    const int *slots, int P, int *out_rooms) {
-  tt::Problem p;
-  p.E = E; p.R = R; p.F = F; p.S = S; p.days = days; p.spd = spd;
-  p.room_size.assign(room_size, room_size + R);
-  p.attends.assign(attends, attends + (size_t)S * E);
-  p.room_features.assign(room_features, room_features + (size_t)R * F);
-  p.event_features.assign(event_features, event_features + (size_t)E * F);
-  p.derive();
+int tt_assign_rooms(void *handle, const int *slots, int P, int *out_rooms) {
+  const tt::Problem &p = *static_cast<tt::Problem *>(handle);
   tt::Matcher m(p);
   for (int i = 0; i < P; ++i)
-    m.assign_all(slots + (size_t)i * E, out_rooms + (size_t)i * E);
+    m.assign_all(slots + (size_t)i * p.E, out_rooms + (size_t)i * p.E);
   return 0;
 }
 

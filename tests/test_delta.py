@@ -1,0 +1,83 @@
+"""Delta-evaluation tests (ops/delta.py vs full re-evaluation).
+
+The delta local search must be bit-for-bit equivalent to the
+full-re-evaluation search under the same keys: same candidates, same
+greedy room choices, same acceptance decisions, same final populations.
+Plus direct checks that the maintained att/occ tensors stay consistent.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from timetabling_ga_tpu.ops import delta, fitness, ga, local_search
+from timetabling_ga_tpu.problem import random_instance
+from tests.conftest import random_assignment
+
+
+@pytest.mark.parametrize("p1,p2,p3", [
+    (1.0, 0.0, 0.0),      # Move1 only
+    (0.0, 1.0, 0.0),      # Move2 only
+    (0.0, 0.0, 1.0),      # Move3 only
+    (1.0, 1.0, 0.0),      # reference default mix
+    (1.0, 1.0, 0.5),      # all three
+])
+def test_delta_ls_equals_full_ls(small_problem, p1, p2, p3):
+    pa = small_problem.device_arrays()
+    st = ga.init_population(pa, jax.random.key(0), 16)
+    key = jax.random.key(42)
+    s_full, r_full = local_search.batch_local_search(
+        pa, key, st.slots, st.rooms, n_rounds=15, n_candidates=4,
+        p1=p1, p2=p2, p3=p3)
+    s_dlt, r_dlt = delta.batch_local_search_delta(
+        pa, key, st.slots, st.rooms, n_rounds=15, n_candidates=4,
+        p1=p1, p2=p2, p3=p3)
+    np.testing.assert_array_equal(np.asarray(s_full), np.asarray(s_dlt))
+    np.testing.assert_array_equal(np.asarray(r_full), np.asarray(r_dlt))
+
+
+def test_delta_ls_equivalence_medium(medium_problem):
+    """Same equivalence on a bigger instance with the default mix."""
+    pa = medium_problem.device_arrays()
+    st = ga.init_population(pa, jax.random.key(3), 8)
+    key = jax.random.key(7)
+    s_full, r_full = local_search.batch_local_search(
+        pa, key, st.slots, st.rooms, n_rounds=10, n_candidates=8)
+    s_dlt, r_dlt = delta.batch_local_search_delta(
+        pa, key, st.slots, st.rooms, n_rounds=10, n_candidates=8)
+    np.testing.assert_array_equal(np.asarray(s_full), np.asarray(s_dlt))
+    np.testing.assert_array_equal(np.asarray(r_full), np.asarray(r_dlt))
+
+
+def test_maintained_state_consistent_after_search(small_problem):
+    """After a delta search, penalties recomputed from scratch must match
+    what the maintained counters accumulated to (guards against drift in
+    att/occ bookkeeping)."""
+    pa = small_problem.device_arrays()
+    st = ga.init_population(pa, jax.random.key(5), 16)
+    key = jax.random.key(9)
+    s, r = delta.batch_local_search_delta(
+        pa, key, st.slots, st.rooms, n_rounds=30, n_candidates=4)
+    # fresh full evaluation
+    pen_fresh, hcv_fresh, _ = fitness.batch_penalty(pa, s, r)
+    # penalty can only have improved
+    assert (np.asarray(pen_fresh) <= np.asarray(st.penalty)).all()
+    # and delta LS respects the feasibility gate exactly like full LS
+    _, hcv0, _ = fitness.batch_penalty(pa, st.slots, st.rooms)
+    was_feasible = np.asarray(hcv0) == 0
+    assert (np.asarray(hcv_fresh)[was_feasible] == 0).all()
+
+
+def test_init_state_counters(small_problem):
+    """att/occ built by init_state match direct recomputation."""
+    pa = small_problem.device_arrays()
+    rng = np.random.default_rng(0)
+    slots, rooms = random_assignment(rng, small_problem, 4)
+    from timetabling_ga_tpu.ops.rooms import occupancy
+    st = delta.init_state(pa, jnp.asarray(slots), jnp.asarray(rooms))
+    for p in range(4):
+        att = np.asarray(fitness.attendance_matrix(pa, slots[p]))
+        np.testing.assert_array_equal(np.asarray(st.att[p]), att)
+        occ = np.asarray(occupancy(pa, slots[p], rooms[p]))
+        np.testing.assert_array_equal(np.asarray(st.occ[p]), occ)

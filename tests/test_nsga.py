@@ -1,0 +1,84 @@
+"""NSGA-II selection tests (ops/nsga.py): front ranking vs a brute-force
+oracle, crowding-distance boundary behavior, survivor properties, and a
+multi-objective evolution run.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from timetabling_ga_tpu.ops import ga, nsga
+from timetabling_ga_tpu.problem import random_instance
+
+
+def _oracle_ranks(hcv, scv):
+    """Brute-force front peeling."""
+    n = len(hcv)
+    pts = list(zip(hcv, scv))
+
+    def dominates(a, b):
+        return a[0] <= b[0] and a[1] <= b[1] and a != b \
+            and (a[0] < b[0] or a[1] < b[1])
+
+    ranks = [-1] * n
+    assigned = 0
+    f = 0
+    while assigned < n:
+        front = [i for i in range(n) if ranks[i] < 0 and not any(
+            ranks[j] < 0 and dominates(pts[j], pts[i]) for j in range(n))]
+        for i in front:
+            ranks[i] = f
+        assigned += len(front)
+        f += 1
+    return ranks
+
+
+def test_ranks_match_oracle():
+    rng = np.random.default_rng(0)
+    hcv = rng.integers(0, 6, 60).astype(np.int32)
+    scv = rng.integers(0, 40, 60).astype(np.int32)
+    got = np.asarray(nsga.nondominated_ranks(jnp.asarray(hcv),
+                                             jnp.asarray(scv)))
+    want = _oracle_ranks(hcv.tolist(), scv.tolist())
+    np.testing.assert_array_equal(got, want)
+
+
+def test_ranks_with_duplicates():
+    """Duplicate points do not dominate each other — all in one front."""
+    hcv = jnp.asarray(np.array([2, 2, 2], np.int32))
+    scv = jnp.asarray(np.array([5, 5, 5], np.int32))
+    got = np.asarray(nsga.nondominated_ranks(hcv, scv))
+    np.testing.assert_array_equal(got, [0, 0, 0])
+
+
+def test_crowding_boundaries_infinite():
+    hcv = jnp.asarray(np.array([0, 1, 2, 3], np.int32))
+    scv = jnp.asarray(np.array([30, 20, 10, 0], np.int32))  # one front
+    ranks = nsga.nondominated_ranks(hcv, scv)
+    assert (np.asarray(ranks) == 0).all()
+    crowd = np.asarray(nsga.crowding_distance(hcv, scv, ranks))
+    assert np.isinf(crowd[0]) and np.isinf(crowd[3])
+    assert np.isfinite(crowd[1]) and np.isfinite(crowd[2])
+
+
+def test_survivors_keep_pareto_front():
+    rng = np.random.default_rng(1)
+    hcv = rng.integers(0, 5, 64).astype(np.int32)
+    scv = rng.integers(0, 50, 64).astype(np.int32)
+    keep = np.asarray(nsga.nsga_survivor_indices(
+        jnp.asarray(hcv), jnp.asarray(scv), 32))
+    assert len(set(keep.tolist())) == 32
+    ranks = _oracle_ranks(hcv.tolist(), scv.tolist())
+    front0 = {i for i in range(64) if ranks[i] == 0}
+    if len(front0) <= 32:
+        assert front0.issubset(set(keep.tolist()))
+
+
+def test_multi_objective_run_reaches_feasibility():
+    problem = random_instance(41, n_events=20, n_rooms=6, n_features=2,
+                              n_students=12, attend_prob=0.08)
+    pa = problem.device_arrays()
+    cfg = ga.GAConfig(pop_size=32, multi_objective=True)
+    st = ga.init_population(pa, jax.random.key(0), 32)
+    st, _ = ga.run(pa, jax.random.key(1), st, cfg, 60)
+    assert int(st.hcv[0]) == 0

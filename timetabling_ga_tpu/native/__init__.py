@@ -34,12 +34,13 @@ def _try_load():
     global _lib, _load_error
     if _lib is not None or _load_error is not None:
         return
-    if not os.path.exists(_LIB_PATH):
-        try:
-            subprocess.run(["make", "-C", _NATIVE_DIR,
-                            "libtimetabling_native.so"],
-                           capture_output=True, check=True, timeout=300)
-        except Exception as e:
+    # Always run make (a fresh build is a no-op): loading a stale .so
+    # after editing the .cpp would silently validate old semantics.
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR],
+                       capture_output=True, check=True, timeout=300)
+    except Exception as e:
+        if not os.path.exists(_LIB_PATH):
             _load_error = f"native build failed: {e}"
             return
     try:
@@ -51,19 +52,19 @@ def _try_load():
     i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
     i8p = np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS")
     i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.tt_problem_create.restype = ctypes.c_void_p
+    lib.tt_problem_create.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, i32p, i8p, i8p, i8p]
+    lib.tt_problem_free.restype = None
+    lib.tt_problem_free.argtypes = [ctypes.c_void_p]
     lib.tt_eval_batch.restype = ctypes.c_int
     lib.tt_eval_batch.argtypes = [
-        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.c_int,
-        i32p, i8p, i8p, i8p,
-        i32p, i32p, ctypes.c_int,
+        ctypes.c_void_p, i32p, i32p, ctypes.c_int,
         i64p, i32p, i32p, ctypes.c_int]
     lib.tt_assign_rooms.restype = ctypes.c_int
     lib.tt_assign_rooms.argtypes = [
-        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.c_int,
-        i32p, i8p, i8p, i8p,
-        i32p, ctypes.c_int, i32p]
+        ctypes.c_void_p, i32p, ctypes.c_int, i32p]
     _lib = lib
 
 
@@ -77,13 +78,32 @@ def load_error() -> Optional[str]:
     return _load_error
 
 
-def _problem_args(problem):
-    return (problem.n_events, problem.n_rooms, problem.n_features,
-            problem.n_students, problem.n_days, problem.slots_per_day,
-            np.ascontiguousarray(problem.room_size, np.int32),
-            np.ascontiguousarray(problem.attends, np.int8),
-            np.ascontiguousarray(problem.room_features, np.int8),
-            np.ascontiguousarray(problem.event_features, np.int8))
+# Problem handles: parse+derive once per Problem object, freed with it.
+_handles: dict = {}
+
+
+def _handle(problem) -> int:
+    key = id(problem)
+    cached = _handles.get(key)
+    if cached is not None:
+        return cached
+    h = _lib.tt_problem_create(
+        problem.n_events, problem.n_rooms, problem.n_features,
+        problem.n_students, problem.n_days, problem.slots_per_day,
+        np.ascontiguousarray(problem.room_size, np.int32),
+        np.ascontiguousarray(problem.attends, np.int8),
+        np.ascontiguousarray(problem.room_features, np.int8),
+        np.ascontiguousarray(problem.event_features, np.int8))
+    _handles[key] = h
+    import weakref
+    weakref.finalize(problem, _free_handle, key, h)
+    return h
+
+
+def _free_handle(key, h):
+    _handles.pop(key, None)
+    if _lib is not None:
+        _lib.tt_problem_free(h)
 
 
 def eval_batch(problem, slots, rooms, threads: int = 1):
@@ -97,7 +117,7 @@ def eval_batch(problem, slots, rooms, threads: int = 1):
     pen = np.empty(P, np.int64)
     hcv = np.empty(P, np.int32)
     scv = np.empty(P, np.int32)
-    rc = _lib.tt_eval_batch(*_problem_args(problem), slots, rooms, P,
+    rc = _lib.tt_eval_batch(_handle(problem), slots, rooms, P,
                             pen, hcv, scv, threads)
     if rc != 0:
         raise RuntimeError(f"tt_eval_batch failed: {rc}")
@@ -112,7 +132,7 @@ def assign_rooms_batch(problem, slots):
     slots = np.ascontiguousarray(slots, np.int32)
     P = slots.shape[0]
     rooms = np.empty_like(slots)
-    rc = _lib.tt_assign_rooms(*_problem_args(problem), slots, P, rooms)
+    rc = _lib.tt_assign_rooms(_handle(problem), slots, P, rooms)
     if rc != 0:
         raise RuntimeError(f"tt_assign_rooms failed: {rc}")
     return rooms

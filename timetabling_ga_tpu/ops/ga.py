@@ -51,6 +51,8 @@ class GAConfig:
     p3: float = 0.0
     ls_steps: int = 0             # local-search rounds per child (C8); 0=off
     ls_candidates: int = 8        # candidate moves per LS round
+    ls_delta: bool = True         # delta-eval LS (C6) vs full re-eval
+    multi_objective: bool = False  # NSGA-II (hcv, scv) replacement
 
 
 class PopState(NamedTuple):
@@ -138,9 +140,14 @@ def generation(pa, key, state: PopState, cfg: GAConfig) -> PopState:
         lambda k: _make_child(pa, k, state, cfg))(keys)
 
     if cfg.ls_steps > 0:
-        from timetabling_ga_tpu.ops.local_search import batch_local_search
+        if cfg.ls_delta:
+            from timetabling_ga_tpu.ops.delta import (
+                batch_local_search_delta as ls_fn)
+        else:
+            from timetabling_ga_tpu.ops.local_search import (
+                batch_local_search as ls_fn)
         k_ls = jax.random.fold_in(key, 0x15)
-        ch_slots, ch_rooms = batch_local_search(
+        ch_slots, ch_rooms = ls_fn(
             pa, k_ls, ch_slots, ch_rooms,
             n_rounds=cfg.ls_steps, n_candidates=cfg.ls_candidates,
             p1=cfg.p1, p2=cfg.p2, p3=cfg.p3)
@@ -151,7 +158,15 @@ def generation(pa, key, state: PopState, cfg: GAConfig) -> PopState:
     all_pen = jnp.concatenate([state.penalty, c_pen])
     all_hcv = jnp.concatenate([state.hcv, c_hcv])
     all_scv = jnp.concatenate([state.scv, c_scv])
-    order = jnp.argsort(all_pen)[:cfg.pop_size]
+    if cfg.multi_objective:
+        # NSGA-II replacement on (hcv, scv); the population stays
+        # penalty-sorted within the survivor set so rows 0/1 remain the
+        # migration emigrants (parallel/islands.py relies on that)
+        from timetabling_ga_tpu.ops.nsga import nsga_survivor_indices
+        keep = nsga_survivor_indices(all_hcv, all_scv, cfg.pop_size)
+        order = keep[jnp.argsort(all_pen[keep])]
+    else:
+        order = jnp.argsort(all_pen)[:cfg.pop_size]
     return PopState(slots=all_slots[order], rooms=all_rooms[order],
                     penalty=all_pen[order], hcv=all_hcv[order],
                     scv=all_scv[order])

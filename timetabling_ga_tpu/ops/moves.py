@@ -81,15 +81,17 @@ def move3(pa, slots, rooms_arr, e1, e2, e3, cap_rank=None):
     return slots, rooms_arr
 
 
-def random_move(pa, key, slots, rooms_arr,
-                p1: float = 1.0, p2: float = 1.0, p3: float = 0.0,
-                cap_rank=None):
-    """One random neighborhood move (Solution::randomMove,
-    Solution.cpp:441-469): move type drawn with probabilities
-    p1:p2:p3 (normalized), distinct events, uniform target slot.
-    """
-    if cap_rank is None:
-        cap_rank = capacity_rank(pa)
+def sample_move(pa, key, slots,
+                p1: float = 1.0, p2: float = 1.0, p3: float = 0.0):
+    """Sample one random move in padded 3-relocation form.
+
+    The single source of truth for Solution::randomMove's sampling
+    (Solution.cpp:441-469): move type drawn with probabilities p1:p2:p3
+    (normalized), distinct events, uniform target slot. Returns
+    (events (3,), new_slots (3,), active (3,) bool); inactive pad
+    entries keep their current slot (exact no-ops). Both the applying
+    path (`random_move`) and the delta-evaluation path (ops/delta.py)
+    consume THIS function, so they can never desynchronize."""
     E = slots.shape[0]
     k_type, k_ev, k_slot = jax.random.split(key, 3)
     probs = jnp.array([p1, p2, p3], dtype=jnp.float32)
@@ -98,9 +100,50 @@ def random_move(pa, key, slots, rooms_arr,
     evs = jax.random.choice(k_ev, E, shape=(3,), replace=False)
     t = jax.random.randint(k_slot, (), 0, pa.n_slots, dtype=slots.dtype)
 
-    return lax.switch(
+    cur = slots[evs]                                   # (3,)
+    new_slots = lax.switch(
         mtype,
-        [lambda s, r: move1(pa, s, r, evs[0], t, cap_rank),
-         lambda s, r: move2(pa, s, r, evs[0], evs[1], cap_rank),
-         lambda s, r: move3(pa, s, r, evs[0], evs[1], evs[2], cap_rank)],
-        slots, rooms_arr)
+        [lambda: jnp.stack([t, cur[1], cur[2]]),                 # Move1
+         lambda: jnp.stack([cur[1], cur[0], cur[2]]),            # Move2
+         lambda: jnp.stack([cur[1], cur[2], cur[0]])],           # Move3
+    )
+    active = lax.switch(
+        mtype,
+        [lambda: jnp.array([True, False, False]),
+         lambda: jnp.array([True, True, False]),
+         lambda: jnp.array([True, True, True])],
+    )
+    return evs, new_slots, active
+
+
+def apply_relocation(pa, slots, rooms_arr, evs, new_slots, active,
+                     cap_rank=None):
+    """Apply a padded 3-relocation: remove the active events from the
+    occupancy grid, then re-slot and greedily re-room them in order
+    (the shared application semantics of Move1/2/3)."""
+    if cap_rank is None:
+        cap_rank = capacity_rank(pa)
+    occ = occupancy(pa, slots, rooms_arr)
+    old_slots = slots[evs]
+    old_rooms = rooms_arr[evs]
+    for m in range(3):
+        act = active[m].astype(occ.dtype)
+        occ = occ.at[old_slots[m], old_rooms[m]].add(-act)
+    for m in range(3):
+        act = active[m].astype(occ.dtype)
+        r_choice = choose_room(pa, occ[new_slots[m]], evs[m], cap_rank)
+        r_new = jnp.where(active[m], r_choice, old_rooms[m])
+        occ = occ.at[new_slots[m], r_new].add(act)
+        slots = slots.at[evs[m]].set(new_slots[m])
+        rooms_arr = rooms_arr.at[evs[m]].set(r_new)
+    return slots, rooms_arr
+
+
+def random_move(pa, key, slots, rooms_arr,
+                p1: float = 1.0, p2: float = 1.0, p3: float = 0.0,
+                cap_rank=None):
+    """One random neighborhood move (Solution::randomMove,
+    Solution.cpp:441-469): sample_move + apply_relocation."""
+    evs, new_slots, active = sample_move(pa, key, slots, p1, p2, p3)
+    return apply_relocation(pa, slots, rooms_arr, evs, new_slots, active,
+                            cap_rank)

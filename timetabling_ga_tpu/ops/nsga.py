@@ -1,0 +1,114 @@
+"""NSGA-II style multi-objective selection on (hcv, scv).
+
+The reference is single-objective (penalty scalarization,
+Solution.cpp:162-170), but the benchmark protocol (BASELINE.json config 5;
+SURVEY section 7.7) calls for a multi-objective HCV/SCV variant: treat
+hard and soft violations as two minimization objectives, rank by
+non-dominated fronts (NSGA-II, Deb et al. 2002 — public algorithm,
+re-derived here in batched tensor form), and break ties within a front by
+crowding distance.
+
+Everything is fixed-shape for XLA:
+  - the domination matrix is one (N, N) tensor expression;
+  - front peeling is a bounded `fori_loop` over at most `max_fronts`
+    rounds (any residue gets the worst rank — harmless for selection);
+  - crowding distances come from two argsorts (one per objective), with
+    +inf at each front's boundary individuals.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+INF = jnp.float32(jnp.inf)
+
+
+def domination_matrix(hcv: jnp.ndarray, scv: jnp.ndarray) -> jnp.ndarray:
+    """dom[i, j] = True iff i dominates j on (hcv, scv), both minimized:
+    i is no worse in both and strictly better in at least one."""
+    h_le = hcv[:, None] <= hcv[None, :]
+    s_le = scv[:, None] <= scv[None, :]
+    h_lt = hcv[:, None] < hcv[None, :]
+    s_lt = scv[:, None] < scv[None, :]
+    return h_le & s_le & (h_lt | s_lt)
+
+
+def nondominated_ranks(hcv: jnp.ndarray, scv: jnp.ndarray) -> jnp.ndarray:
+    """Front index per individual (0 = Pareto front). Complete peeling
+    under `lax.while_loop` — a converging integer-objective population
+    can have hundreds of fronts, so no fixed bound is imposed (the loop
+    runs at most N rounds by construction)."""
+    N = hcv.shape[0]
+    UNASSIGNED = jnp.int32(N + 1)
+    dom = domination_matrix(hcv, scv)
+    n_dominators = jnp.sum(dom, axis=0).astype(jnp.int32)     # (N,)
+    ranks0 = jnp.full((N,), UNASSIGNED, jnp.int32)
+
+    def cond(carry):
+        ranks, _, _ = carry
+        return jnp.any(ranks == UNASSIGNED)
+
+    def body(carry):
+        ranks, n_dom, f = carry
+        front = (n_dom == 0) & (ranks == UNASSIGNED)
+        ranks = jnp.where(front, f, ranks)
+        # remove the front's domination contributions
+        removed = jnp.sum(dom & front[:, None], axis=0).astype(jnp.int32)
+        n_dom = jnp.where(front, -1, n_dom - removed)
+        return ranks, n_dom, f + 1
+
+    ranks, _, _ = lax.while_loop(
+        cond, body, (ranks0, n_dominators, jnp.int32(0)))
+    return ranks
+
+
+def crowding_distance(hcv: jnp.ndarray, scv: jnp.ndarray,
+                      ranks: jnp.ndarray) -> jnp.ndarray:
+    """Per-individual crowding distance within its front (larger =
+    lonelier = preferred). Boundary individuals of each front get +inf."""
+    N = hcv.shape[0]
+    dist = jnp.zeros((N,), jnp.float32)
+    for obj_i in (hcv.astype(jnp.int64), scv.astype(jnp.int64)):
+        # sort within front: exact int64 composite key (a float composite
+        # loses the objective above 2^24 and collapses front ordering)
+        key = (ranks.astype(jnp.int64) << 32) + obj_i
+        order = jnp.argsort(key)                       # (N,)
+        obj = obj_i.astype(jnp.float32)
+        obj_s = obj[order]
+        rank_s = ranks[order]
+        lo = jnp.concatenate([jnp.array([-jnp.inf]), obj_s[:-1]])
+        hi = jnp.concatenate([obj_s[1:], jnp.array([jnp.inf])])
+        same_lo = jnp.concatenate(
+            [jnp.array([False]), rank_s[1:] == rank_s[:-1]])
+        same_hi = jnp.concatenate(
+            [rank_s[:-1] == rank_s[1:], jnp.array([False])])
+        # range normalization per front is overkill; global range works
+        # for ranking purposes and keeps everything fixed-shape
+        rng = jnp.maximum(jnp.max(obj) - jnp.min(obj), 1.0)
+        gap = jnp.where(same_lo & same_hi, (hi - lo) / rng, INF)
+        dist = dist.at[order].add(gap)
+    return dist
+
+
+def nsga_survivor_indices(hcv: jnp.ndarray, scv: jnp.ndarray,
+                          n_survivors: int) -> jnp.ndarray:
+    """Indices of the NSGA-II survivors (rank asc, crowding desc) —
+    the multi-objective replacement for mu+lambda penalty truncation."""
+    ranks = nondominated_ranks(hcv, scv)
+    crowd = crowding_distance(hcv, scv, ranks)
+    # lexicographic (rank asc, crowd desc); crowd in (0, inf] -> use
+    # 1/(1+crowd) in (0, 1) as an ascending tiebreaker
+    key = ranks.astype(jnp.float32) + 1.0 / (1.0 + crowd)
+    return jnp.argsort(key)[:n_survivors]
+
+
+def crowded_tournament(key, ranks: jnp.ndarray, crowd: jnp.ndarray,
+                       k: int) -> jnp.ndarray:
+    """k-way tournament under the crowded comparison operator
+    (rank asc, crowding desc) — the NSGA-II parent selector."""
+    N = ranks.shape[0]
+    draws = jax.random.randint(key, (k,), 0, N)
+    sel_key = ranks[draws].astype(jnp.float32) + 1.0 / (1.0 + crowd[draws])
+    return draws[jnp.argmin(sel_key)]

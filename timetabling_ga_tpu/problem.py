@@ -157,7 +157,10 @@ def derive(n_events: int, n_rooms: int, n_features: int, n_students: int,
             raise ValueError(f"{name}: expected shape {want}, got {got}")
 
     student_count = attends.astype(np.int64).sum(axis=0).astype(np.int32)
-    conflict = (attends.astype(np.int32).T @ attends.astype(np.int32)) > 0
+    # float32 matmul rides BLAS (integer matmuls do not); counts are
+    # exact in f32 up to 2^24 co-attendances per pair
+    a32 = attends.astype(np.float32)
+    conflict = (a32.T @ a32) > 0.5
 
     size_ok = room_size[None, :] >= student_count[:, None]          # (E, R)
     # event needs feature f and room lacks it -> unsuitable

@@ -67,6 +67,8 @@ class RunConfig:
     checkpoint: Optional[str] = None
     checkpoint_every: int = 1
     resume: bool = False
+    nsga2: bool = False       # NSGA-II (hcv, scv) replacement stage
+    ls_full_eval: bool = False  # disable delta evaluation (debugging)
 
     def resolved_seed(self) -> int:
         # reference default: time(NULL) (Control.cpp:129-136)
@@ -102,7 +104,8 @@ _FLAG_MAP = {
     "--checkpoint-every": ("checkpoint_every", int),
 }
 
-_BOOL_FLAGS = {"--resume": "resume"}
+_BOOL_FLAGS = {"--resume": "resume", "--nsga2": "nsga2",
+               "--ls-full-eval": "ls_full_eval"}
 
 
 def parse_args(argv) -> RunConfig:

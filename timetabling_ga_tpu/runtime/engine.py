@@ -40,6 +40,8 @@ def build_ga_config(cfg: RunConfig) -> ga.GAConfig:
         pop_size=cfg.pop_size,
         p1=cfg.p1, p2=cfg.p2, p3=cfg.p3,
         ls_steps=ls_rounds, ls_candidates=cfg.ls_candidates,
+        ls_delta=not cfg.ls_full_eval,
+        multi_objective=cfg.nsga2,
     )
 
 
