@@ -61,6 +61,92 @@ def test_crowding_boundaries_infinite():
     assert np.isfinite(crowd[1]) and np.isfinite(crowd[2])
 
 
+def _oracle_crowding(hcv, scv, ranks):
+    """Scalar re-statement of crowding_distance's documented formula:
+    within each front, per objective, boundary members get +inf and
+    interior members get (next - prev) / global_range, summed over both
+    objectives. Tie order follows the stable (rank, obj, index) sort."""
+    n = len(hcv)
+    dist = [0.0] * n
+    for obj in (hcv, scv):
+        rng = max(max(obj) - min(obj), 1.0)
+        order = sorted(range(n), key=lambda i: (ranks[i], obj[i], i))
+        for pos, i in enumerate(order):
+            interior = (pos > 0 and ranks[order[pos - 1]] == ranks[i]
+                        and pos < n - 1
+                        and ranks[order[pos + 1]] == ranks[i])
+            if interior:
+                dist[i] += (obj[order[pos + 1]] - obj[order[pos - 1]]) / rng
+            else:
+                dist[i] = float("inf")
+    return dist
+
+
+def test_crowding_multi_front_matches_oracle():
+    """Regression for the round-1 int32-truncation bug: with >1 front the
+    shifted-int64 key collapsed to the bare objective and every interior
+    individual got +inf. Exact within-front ordering is now required."""
+    # front 0: (0,30) (1,20) (2,10) (3,0); front 1: (2,30) (3,25) (4,20)
+    hcv = np.array([0, 1, 2, 3, 2, 3, 4], np.int32)
+    scv = np.array([30, 20, 10, 0, 30, 25, 20], np.int32)
+    ranks = nsga.nondominated_ranks(jnp.asarray(hcv), jnp.asarray(scv))
+    np.testing.assert_array_equal(np.asarray(ranks), [0, 0, 0, 0, 1, 1, 1])
+    crowd = np.asarray(nsga.crowding_distance(jnp.asarray(hcv),
+                                              jnp.asarray(scv), ranks))
+    want = _oracle_crowding(hcv.tolist(), scv.tolist(),
+                            np.asarray(ranks).tolist())
+    # interior members of BOTH fronts must be finite (indices 1, 2, 5)
+    assert np.isfinite(crowd[[1, 2, 5]]).all()
+    assert np.isinf(crowd[[0, 3, 4, 6]]).all()
+    np.testing.assert_allclose(crowd, want, rtol=1e-6)
+
+
+def test_crowding_multi_front_random_matches_oracle():
+    rng = np.random.default_rng(7)
+    hcv = rng.integers(0, 5, 40).astype(np.int32)
+    scv = rng.integers(0, 30, 40).astype(np.int32)
+    ranks = nsga.nondominated_ranks(jnp.asarray(hcv), jnp.asarray(scv))
+    crowd = np.asarray(nsga.crowding_distance(jnp.asarray(hcv),
+                                              jnp.asarray(scv), ranks))
+    want = _oracle_crowding(hcv.tolist(), scv.tolist(),
+                            np.asarray(ranks).tolist())
+    np.testing.assert_allclose(crowd, want, rtol=1e-6)
+
+
+def test_survivor_order_rank_then_crowding():
+    """Survivors come out rank-ascending, and within a rank
+    crowding-descending — the exact crowded-comparison order."""
+    rng = np.random.default_rng(3)
+    hcv = rng.integers(0, 5, 48).astype(np.int32)
+    scv = rng.integers(0, 40, 48).astype(np.int32)
+    ranks = np.asarray(nsga.nondominated_ranks(jnp.asarray(hcv),
+                                               jnp.asarray(scv)))
+    crowd = np.asarray(nsga.crowding_distance(
+        jnp.asarray(hcv), jnp.asarray(scv), jnp.asarray(ranks)))
+    keep = np.asarray(nsga.nsga_survivor_indices(
+        jnp.asarray(hcv), jnp.asarray(scv), 48))
+    kr, kc = ranks[keep], crowd[keep]
+    assert (np.diff(kr) >= 0).all()
+    same = kr[1:] == kr[:-1]
+    # within a front, crowding must be non-increasing
+    assert (kc[1:][same] <= kc[:-1][same] + 1e-6).all()
+
+
+def test_crowded_tournament_prefers_lower_rank_then_crowding():
+    ranks = jnp.asarray(np.array([1, 0, 0, 2], np.int32))
+    crowd = jnp.asarray(np.array([np.inf, 0.5, 2.0, np.inf], np.float32))
+    for s in range(20):
+        key = jax.random.key(s)
+        win = int(nsga.crowded_tournament(key, ranks, crowd, 4))
+        draws = np.asarray(jax.random.randint(key, (4,), 0, 4))
+        # the winner must be lexicographically minimal in
+        # (rank asc, crowding desc) among the drawn contestants
+        best = min(draws.tolist(),
+                   key=lambda i: (int(ranks[i]), -float(crowd[i])))
+        assert (int(ranks[win]), -float(crowd[win])) == \
+            (int(ranks[best]), -float(crowd[best]))
+
+
 def test_survivors_keep_pareto_front():
     rng = np.random.default_rng(1)
     hcv = rng.integers(0, 5, 64).astype(np.int32)

@@ -70,11 +70,11 @@ def crowding_distance(hcv: jnp.ndarray, scv: jnp.ndarray,
     lonelier = preferred). Boundary individuals of each front get +inf."""
     N = hcv.shape[0]
     dist = jnp.zeros((N,), jnp.float32)
-    for obj_i in (hcv.astype(jnp.int64), scv.astype(jnp.int64)):
-        # sort within front: exact int64 composite key (a float composite
-        # loses the objective above 2^24 and collapses front ordering)
-        key = (ranks.astype(jnp.int64) << 32) + obj_i
-        order = jnp.argsort(key)                       # (N,)
+    for obj_i in (hcv, scv):
+        # sort within front: exact lexicographic (rank, objective) via
+        # lexsort (stable, two int32 keys) — no int64 needed, and no
+        # composite key to overflow or truncate
+        order = jnp.lexsort((obj_i, ranks))            # (N,)
         obj = obj_i.astype(jnp.float32)
         obj_s = obj[order]
         rank_s = ranks[order]
@@ -98,10 +98,10 @@ def nsga_survivor_indices(hcv: jnp.ndarray, scv: jnp.ndarray,
     the multi-objective replacement for mu+lambda penalty truncation."""
     ranks = nondominated_ranks(hcv, scv)
     crowd = crowding_distance(hcv, scv, ranks)
-    # lexicographic (rank asc, crowd desc); crowd in (0, inf] -> use
-    # 1/(1+crowd) in (0, 1) as an ascending tiebreaker
-    key = ranks.astype(jnp.float32) + 1.0 / (1.0 + crowd)
-    return jnp.argsort(key)[:n_survivors]
+    # exact lexicographic (rank asc, crowd desc): lexsort is stable, so
+    # no composite key and no float-precision collapse (the rank step
+    # survives any magnitude, unlike rank + 1/(1+crowd))
+    return jnp.lexsort((-crowd, ranks))[:n_survivors]
 
 
 def crowded_tournament(key, ranks: jnp.ndarray, crowd: jnp.ndarray,
@@ -110,5 +110,7 @@ def crowded_tournament(key, ranks: jnp.ndarray, crowd: jnp.ndarray,
     (rank asc, crowding desc) — the NSGA-II parent selector."""
     N = ranks.shape[0]
     draws = jax.random.randint(key, (k,), 0, N)
-    sel_key = ranks[draws].astype(jnp.float32) + 1.0 / (1.0 + crowd[draws])
-    return draws[jnp.argmin(sel_key)]
+    # crowded-comparison winner: exact lexicographic (rank asc, crowd
+    # desc) over the k draws, same ordering as nsga_survivor_indices
+    best = jnp.lexsort((-crowd[draws], ranks[draws]))[0]
+    return draws[best]
