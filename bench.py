@@ -1,4 +1,5 @@
-"""Benchmark: candidate fitness evaluations per second per chip.
+"""Benchmark: candidate fitness evaluations per second per chip, plus
+full-pipeline (generation-level) throughput.
 
 The north-star metric (BASELINE.json / BASELINE.md): how many candidate
 timetables the framework can evaluate per second on one chip — the
@@ -7,112 +8,259 @@ runtime is candidate evaluation inside local search (SURVEY section 3.2).
 
 Prints ONE JSON line:
   {"metric": "fitness_evals_per_sec_per_chip", "value": N,
-   "unit": "evals/s", "vs_baseline": R}
+   "unit": "evals/s", "vs_baseline": R, "extra": {...}}
 
-`vs_baseline` is the ratio against the same workload run with the same
-XLA kernels on the host CPU (all cores, measured in a subprocess) — the
-stand-in for the reference's CPU-node throughput until a same-box
-MPI+OpenMP build exists (none is possible here: no mpicxx in the image;
-BASELINE.md records the protocol).
+`vs_baseline` is the ratio against the NATIVE C++ OpenMP evaluator
+(native/timetabling_native.cpp tt_eval_batch) at full host cores — an
+honest scalar-CPU denominator implementing identical semantics (the
+reference binary itself cannot be built here: no mpicxx in the image;
+BASELINE.md records the protocol). The round-1 denominator (same XLA
+kernels on host CPU) flattered the ratio and is gone.
+
+`extra` carries the secondary measurements the driver archives:
+  - generation-level throughput of the FULL breeding pipeline
+    (selection + crossover with room rematch + mutation + delta LS +
+    replacement) — VERDICT round-1 item 5;
+  - the 2000-event / pop-32768 scale config — VERDICT item 6;
+  - the LS-mode shootout (systematic sweep vs K-random at equal wall
+    clock) — VERDICT item 2.
 
 Workload: comp05-scale synthetic instance (400 events, 10 rooms, 350
-students, 45 slots), population 4096, full penalty evaluation (hcv + scv
-+ penalty composition).
+students, 45 slots), population 4096, full penalty evaluation.
 """
 
 from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import time
 
 N_EVENTS, N_ROOMS, N_FEATURES, N_STUDENTS = 400, 10, 10, 350
 POP = 4096
 # Enough scan iterations that the ~70ms tunnel dispatch latency is noise.
-WARMUP, ITERS = 2, 100
-CPU_ITERS = 3  # the CPU baseline is ~500x slower; 3 iterations suffice
+ITERS = 100
 
 
-def measure(label: str) -> float:
+def _instance():
+    from timetabling_ga_tpu.problem import random_instance
+    return random_instance(1234, n_events=N_EVENTS, n_rooms=N_ROOMS,
+                           n_features=N_FEATURES, n_students=N_STUDENTS,
+                           attend_prob=0.02)
+
+
+def measure_tpu_evals(problem) -> float:
+    """Dependent-chain batched evaluation on the device (see BASELINE.md
+    methodology: identical dispatches get deduplicated by the tunnel, so
+    every iteration feeds on the previous output)."""
     import jax
     import numpy as np
     from timetabling_ga_tpu.ops import fitness
-    from timetabling_ga_tpu.problem import random_instance
 
-    problem = random_instance(1234, n_events=N_EVENTS, n_rooms=N_ROOMS,
-                              n_features=N_FEATURES,
-                              n_students=N_STUDENTS, attend_prob=0.02)
     pa = problem.device_arrays()
     rng = np.random.default_rng(0)
-    slots = rng.integers(0, problem.n_slots, size=(POP, N_EVENTS),
-                         dtype=np.int32)
-    rooms = rng.integers(0, N_ROOMS, size=(POP, N_EVENTS), dtype=np.int32)
-    slots = jax.device_put(slots)
-    rooms = jax.device_put(rooms)
-
-    # Measure the production shape: a lax.scan whose every iteration's
-    # input depends on the previous output. Iterations can neither
-    # overlap nor be deduplicated, and per-dispatch host<->device latency
-    # is amortized away exactly as it is in the real GA loop (ops/ga.py
-    # runs whole generations under lax.scan).
-    iters = ITERS
+    slots = jax.device_put(rng.integers(0, problem.n_slots,
+                                        size=(POP, N_EVENTS),
+                                        dtype=np.int32))
+    rooms = jax.device_put(rng.integers(0, N_ROOMS, size=(POP, N_EVENTS),
+                                        dtype=np.int32))
 
     @jax.jit
     def chain(s, r):
         def step(carry, _):
             s, r = carry
             pen, _, _ = fitness.batch_penalty(pa, s, r)
-            s = (s + pen[:, None]) % (5 * 9)
+            s = (s + pen[:, None]) % problem.n_slots
             return (s, r), None
-        (s, r), _ = jax.lax.scan(step, (s, r), None, length=iters)
+        (s, r), _ = jax.lax.scan(step, (s, r), None, length=ITERS)
         return s
 
-    # Warm (compiles), then time with the WARMUP OUTPUT as input so the
-    # timed dispatch is not bit-identical to the warmup (the tunnel
-    # dedupes identical dispatches — see the methodology note in
-    # BASELINE.md).
     warm = chain(slots, rooms)
     jax.block_until_ready(warm)
     t0 = time.perf_counter()
     out = chain(warm, rooms)
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
-    evals_per_sec = POP * iters / dt
-    print(f"# {label}: {evals_per_sec:,.0f} evals/s "
-          f"({dt / ITERS * 1e3:.2f} ms/batch of {POP})", file=sys.stderr)
-    return evals_per_sec
+    rate = POP * ITERS / dt
+    print(f"# tpu evals: {rate:,.0f}/s ({dt / ITERS * 1e3:.2f} ms/batch "
+          f"of {POP})", file=sys.stderr)
+    return rate
+
+
+def measure_cpu_native(problem) -> float:
+    """The honest CPU denominator: the C++ OpenMP evaluator at full
+    cores on the same workload."""
+    import numpy as np
+    from timetabling_ga_tpu import native
+
+    if not native.is_available():
+        print(f"# native unavailable: {native.load_error()}",
+              file=sys.stderr)
+        return 0.0
+    threads = os.cpu_count() or 1
+    rng = np.random.default_rng(0)
+    slots = rng.integers(0, problem.n_slots, size=(POP, N_EVENTS),
+                         dtype=np.int32)
+    rooms = rng.integers(0, N_ROOMS, size=(POP, N_EVENTS), dtype=np.int32)
+    native.eval_batch(problem, slots[:64], rooms[:64], threads)  # warm
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        native.eval_batch(problem, slots, rooms, threads)
+    dt = time.perf_counter() - t0
+    rate = POP * reps / dt
+    print(f"# cpu native ({threads} threads): {rate:,.0f} evals/s",
+          file=sys.stderr)
+    return rate
+
+
+def measure_generation(problem, rooms_mode: str) -> dict:
+    """Full breeding pipeline throughput: generations/sec at comp05
+    scale with the production config (delta LS), one dispatch of a
+    dependent generation chain."""
+    import jax
+    from timetabling_ga_tpu.ops import ga
+
+    pa = problem.device_arrays()
+    pop = 1024
+    gens = 20
+    cfg = ga.GAConfig(pop_size=pop, ls_steps=25, ls_candidates=8,
+                      rooms_mode=rooms_mode)
+    state = ga.init_population(pa, jax.random.key(0), pop)
+    jax.block_until_ready(state)
+
+    run = jax.jit(lambda k, s: ga.run(pa, k, s, cfg, gens)[0],
+                  static_argnums=())
+    warm = run(jax.random.key(1), state)
+    jax.block_until_ready(warm)
+    t0 = time.perf_counter()
+    out = run(jax.random.key(2), warm)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    gps = gens / dt
+    # candidate evaluations per generation: P children + P*K*rounds LS
+    evals_per_gen = pop * (1 + cfg.ls_steps * cfg.ls_candidates)
+    print(f"# generation pipeline ({rooms_mode} rooms): {gps:.2f} gen/s, "
+          f"{gps * evals_per_gen:,.0f} LS-candidate evals/s, "
+          f"{dt / gens * 1e3:.1f} ms/gen (pop {pop})", file=sys.stderr)
+    return {"gen_per_sec": round(gps, 3),
+            "ms_per_gen": round(dt / gens * 1e3, 2),
+            "pop": pop,
+            "candidate_evals_per_sec": round(gps * evals_per_gen, 1)}
+
+
+def measure_scale() -> dict:
+    """VERDICT item 6: synthetic E=2000 / R=80, pop=32768, single chip —
+    exercises the memory plan (SURVEY hard part 3)."""
+    import jax
+    import numpy as np
+    from timetabling_ga_tpu.ops import fitness
+    from timetabling_ga_tpu.problem import random_instance
+
+    E, R, S, P = 2000, 80, 1000, 32768
+    problem = random_instance(7, n_events=E, n_rooms=R, n_features=10,
+                              n_students=S, attend_prob=0.01)
+    pa = problem.device_arrays()
+    rng = np.random.default_rng(0)
+    slots = jax.device_put(rng.integers(0, problem.n_slots, size=(P, E),
+                                        dtype=np.int32))
+    rooms = jax.device_put(rng.integers(0, R, size=(P, E), dtype=np.int32))
+    iters = 5
+
+    @jax.jit
+    def chain(s, r):
+        def step(carry, _):
+            s, r = carry
+            pen, _, _ = fitness.batch_penalty(pa, s, r)
+            s = (s + pen[:, None]) % problem.n_slots
+            return (s, r), None
+        (s, r), _ = jax.lax.scan(step, (s, r), None, length=iters)
+        return s
+
+    warm = chain(slots, rooms)
+    jax.block_until_ready(warm)
+    t0 = time.perf_counter()
+    out = chain(warm, rooms)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    rate = P * iters / dt
+    print(f"# scale E={E} R={R} pop={P}: {rate:,.0f} evals/s "
+          f"({dt / iters * 1e3:.1f} ms/batch), no OOM", file=sys.stderr)
+    return {"E": E, "R": R, "pop": P, "evals_per_sec": round(rate, 1),
+            "ms_per_batch": round(dt / iters * 1e3, 2)}
+
+
+def measure_ls_shootout(problem) -> dict:
+    """VERDICT item 2: systematic sweep vs K-random local search, equal
+    wall clock, same start population. Reports mean penalty reached —
+    lower is better; the winner is the production default."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from timetabling_ga_tpu.ops import delta, fitness, sweep
+    from timetabling_ga_tpu.ops.rooms import batch_assign_rooms
+
+    pa = problem.device_arrays()
+    P = 512
+    slots = jax.random.randint(jax.random.key(3), (P, N_EVENTS), 0,
+                               problem.n_slots, dtype=jnp.int32)
+    rooms = batch_assign_rooms(pa, slots)
+    jax.block_until_ready((slots, rooms))
+
+    def timed(fn, *args, **kw):
+        out = fn(pa, jax.random.key(4), slots, rooms, *args, **kw)
+        jax.block_until_ready(out)      # warm/compile
+        t0 = time.perf_counter()
+        out = fn(pa, jax.random.key(5), slots, rooms, *args, **kw)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        pen, _, _ = fitness.batch_penalty(pa, *out)
+        return float(np.asarray(pen).mean()), dt
+
+    # one sweep pass vs a K-random budget tuned to similar wall clock
+    sweep_pen, sweep_dt = timed(sweep.jit_sweep_local_search, 1, 8)
+    # K-random rounds sized to the sweep's measured wall clock
+    probe_rounds = 50
+    _, probe_dt = timed(delta.jit_batch_local_search_delta, probe_rounds, 8)
+    rounds = max(1, int(probe_rounds * sweep_dt / probe_dt))
+    rand_pen, rand_dt = timed(delta.jit_batch_local_search_delta, rounds, 8)
+    print(f"# LS shootout (equal wall clock): sweep {sweep_pen:,.1f} in "
+          f"{sweep_dt:.2f}s vs K-random {rand_pen:,.1f} in {rand_dt:.2f}s "
+          f"({rounds} rounds)", file=sys.stderr)
+    return {"sweep_mean_pen": round(sweep_pen, 1),
+            "sweep_seconds": round(sweep_dt, 3),
+            "krandom_mean_pen": round(rand_pen, 1),
+            "krandom_seconds": round(rand_dt, 3),
+            "krandom_rounds": rounds,
+            "winner": "sweep" if sweep_pen <= rand_pen else "krandom"}
 
 
 def main() -> None:
-    if os.environ.get("_BENCH_CPU_CHILD") == "1":
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        global ITERS
-        ITERS = CPU_ITERS
-        print(json.dumps({"cpu_evals_per_sec": measure("cpu")}))
-        return
+    problem = _instance()
+    tpu = measure_tpu_evals(problem)
+    cpu = measure_cpu_native(problem)
+    vs_baseline = tpu / cpu if cpu > 0 else 0.0
 
-    tpu = measure("tpu")
-
-    env = dict(os.environ, _BENCH_CPU_CHILD="1")
-    try:
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)], env=env,
-            capture_output=True, text=True, timeout=1200, check=True)
-        cpu = json.loads(out.stdout.strip().splitlines()[-1])[
-            "cpu_evals_per_sec"]
-        vs_baseline = tpu / cpu
-    except Exception as e:  # pragma: no cover - defensive
-        print(f"# cpu baseline failed: {e}", file=sys.stderr)
-        vs_baseline = 0.0
+    extra = {}
+    for name, fn in (
+            ("generation_scan", lambda: measure_generation(problem, "scan")),
+            ("generation_parallel",
+             lambda: measure_generation(problem, "parallel")),
+            ("scale_2000ev", measure_scale),
+            ("ls_shootout", lambda: measure_ls_shootout(problem))):
+        try:
+            extra[name] = fn()
+        except Exception as e:  # pragma: no cover - defensive
+            print(f"# {name} failed: {e}", file=sys.stderr)
+            extra[name] = {"error": str(e)[:200]}
+    extra["cpu_native_evals_per_sec"] = round(cpu, 1)
 
     print(json.dumps({
         "metric": "fitness_evals_per_sec_per_chip",
         "value": round(tpu, 1),
         "unit": "evals/s",
         "vs_baseline": round(vs_baseline, 2),
+        "extra": extra,
     }))
 
 

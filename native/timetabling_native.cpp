@@ -25,6 +25,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <functional>
 #include <string>
 #include <vector>
 
@@ -197,12 +198,16 @@ struct Matcher {
     for (int i = 0; i < p.R; ++i) cap_rank[by_cap[i]] = i;
   }
 
+  // Marginal-hcv-cost key, in lockstep with ops/rooms.py::_room_key:
+  // (occupancy + unsuitable) first, prefer suitable on ties, then
+  // best-fit capacity. Bounds E,R < 4096 enforced at tt_problem_create.
   int choose(const int *occ_row, int e) const {
     long best_key = LONG_MAX;
     int best_r = 0;
     for (int r = 0; r < p.R; ++r) {
-      long key = (p.possible[(size_t)e * p.R + r] ? 0L : (1L << 24)) +
-                 (long)occ_row[r] * (1L << 12) + cap_rank[r];
+      const long unsuit = p.possible[(size_t)e * p.R + r] ? 0L : 1L;
+      long key = ((long)occ_row[r] + unsuit) * (1L << 13) +
+                 unsuit * (1L << 12) + cap_rank[r];
       if (key < best_key) { best_key = key; best_r = r; }
     }
     return best_r;
@@ -225,6 +230,68 @@ struct Matcher {
     for (int j = 0; j < p.E; ++j)
       if (j != e && slots[j] == new_t) row[rooms[j]]++;
     return choose(row.data(), e);
+  }
+};
+
+// --------------------------------------------- exact per-slot matching
+// The reference's PRIMARY room-assignment path is an exact per-timeslot
+// maximum matching (Solution::maxMatching, Solution.cpp:836-849, via
+// networkFlow's priority-first search). Clean-room equivalent: Kuhn's
+// augmenting-path algorithm per slot (same optimum, simpler machinery),
+// with the reference's fallback for unmatched events (least-busy
+// suitable room, Solution.cpp:814-830). Used by the reference-faithful
+// baseline GA below; the framework's own matcher is the cost-greedy
+// Matcher above.
+struct ExactMatcher {
+  const Problem &p;
+  explicit ExactMatcher(const Problem &pp) : p(pp) {}
+
+  // match the events of one slot to distinct suitable rooms; unmatched
+  // events fall back to the least-busy suitable (else least-busy) room
+  void assign_slot(const std::vector<int> &evs, int *rooms) const {
+    const int R = p.R;
+    std::vector<int> match_r(R, -1);                 // room -> event idx
+    std::vector<uint8_t> seen(R);
+    std::function<bool(int)> aug = [&](int i) {
+      for (int r : p.suitable[evs[i]]) {
+        if (seen[r]) continue;
+        seen[r] = 1;
+        if (match_r[r] < 0 || aug(match_r[r])) { match_r[r] = i; return true; }
+      }
+      return false;
+    };
+    std::vector<int> assigned(evs.size(), -1);
+    for (size_t i = 0; i < evs.size(); ++i) {
+      std::fill(seen.begin(), seen.end(), 0);
+      aug((int)i);
+    }
+    for (int r = 0; r < R; ++r)
+      if (match_r[r] >= 0) assigned[match_r[r]] = r;
+    // fallback: least-busy suitable room, else least-busy any
+    std::vector<int> busy(R, 0);
+    for (size_t i = 0; i < evs.size(); ++i)
+      if (assigned[i] >= 0) busy[assigned[i]]++;
+    for (size_t i = 0; i < evs.size(); ++i) {
+      if (assigned[i] >= 0) { rooms[evs[i]] = assigned[i]; continue; }
+      const auto &suit = p.suitable[evs[i]];
+      int best = -1;
+      for (int r : suit)
+        if (best < 0 || busy[r] < busy[best]) best = r;
+      if (best < 0)
+        for (int r = 0; r < R; ++r)
+          if (best < 0 || busy[r] < busy[best]) best = r;
+      assigned[i] = best;
+      busy[best]++;
+      rooms[evs[i]] = best;
+    }
+  }
+
+  void assign_all(const int *slots, int *rooms) const {
+    const int T = p.n_slots();
+    std::vector<std::vector<int>> by_slot(T);
+    for (int e = 0; e < p.E; ++e) by_slot[slots[e]].push_back(e);
+    for (int t = 0; t < T; ++t)
+      if (!by_slot[t].empty()) assign_slot(by_slot[t], rooms);
   }
 };
 
@@ -285,6 +352,14 @@ struct GaParams {
   int threads = 1;
 };
 
+static double now_sec() {
+#ifdef _OPENMP
+  return omp_get_wtime();
+#else
+  return (double)clock() / CLOCKS_PER_SEC;
+#endif
+}
+
 static void evaluate(const Problem &p, Individual &ind,
                      std::vector<uint8_t> &scratch) {
   ind.hcv = compute_hcv(p, ind.slots.data(), ind.rooms.data());
@@ -311,6 +386,249 @@ static void local_search(const Problem &p, const Matcher &m, Rng &rng,
   }
 }
 
+// ------------------------------------- reference-faithful baseline GA
+// A faithful re-statement of the reference ALGORITHM (not its code):
+// steady-state pop-10 GA (ga.cpp:64, 580-585) whose local search is the
+// exhaustive first-improvement sweep — every event (shuffled) x all 45
+// Move1 targets (Solution.cpp:508-534) and all Move2 swap partners
+// (535-561), counter reset on improvement so it runs to a local optimum,
+// rooms re-matched EXACTLY per affected slot per candidate (the
+// reference's primary matching path). This is the quality baseline the
+// TPU path races at fixed wall clock (BASELINE.md), built because the
+// reference binary itself cannot run here (no MPI in the image).
+//
+// hcv decomposes per slot (clash pairs + correlated pairs live inside a
+// slot; unsuitable is per event), so a move's hcv delta touches only its
+// two slots. scv decomposes per (student, day) windows + the last-slot
+// term, maintained via an (S, T) attendance-count matrix.
+struct RefLS {
+  const Problem &p;
+  const ExactMatcher &xm;
+  std::vector<std::vector<int>> by_slot;   // slot -> events
+  std::vector<int> att;                    // (S, T) attendance counts
+  std::vector<std::vector<int>> attendees; // event -> students
+  int hcv = 0, scv = 0;
+
+  explicit RefLS(const Problem &pp, const ExactMatcher &x)
+      : p(pp), xm(x), attendees(pp.E) {
+    for (int s = 0; s < p.S; ++s)
+      for (int e = 0; e < p.E; ++e)
+        if (p.attends[(size_t)s * p.E + e]) attendees[e].push_back(s);
+  }
+
+  int slot_hcv(const std::vector<int> &evs, const int *slots,
+               const int *rooms) const {
+    (void)slots;
+    int h = 0;
+    for (size_t i = 0; i < evs.size(); ++i) {
+      for (size_t j = i + 1; j < evs.size(); ++j) {
+        if (rooms[evs[i]] == rooms[evs[j]]) h++;
+        if (p.conflict[(size_t)evs[i] * p.E + evs[j]]) h++;
+      }
+      if (!p.possible[(size_t)evs[i] * p.R + rooms[evs[i]]]) h++;
+    }
+    return h;
+  }
+
+  // scv of one (student, day) window from the maintained att counts
+  int day_scv(int s, int d) const {
+    const int T = p.n_slots();
+    const int *row = &att[(size_t)s * T + d * p.spd];
+    int run = 0, cnt = 0, v = 0;
+    for (int k = 0; k < p.spd; ++k) {
+      if (row[k] > 0) { cnt++; if (++run > 2) v++; }
+      else run = 0;
+    }
+    return v + (cnt == 1 ? 1 : 0);
+  }
+
+  void rebuild(Individual &ind) {
+    const int T = p.n_slots();
+    by_slot.assign(T, {});
+    for (int e = 0; e < p.E; ++e) by_slot[ind.slots[e]].push_back(e);
+    att.assign((size_t)p.S * T, 0);
+    for (int e = 0; e < p.E; ++e)
+      for (int s : attendees[e]) att[(size_t)s * T + ind.slots[e]]++;
+    std::vector<uint8_t> scratch;
+    evaluate(p, ind, scratch);
+    hcv = ind.hcv;
+    scv = ind.scv;
+  }
+
+  // scv delta of moving event e from slot t1 to t2 (t1 != t2)
+  int scv_delta(int e, int t1, int t2) const {
+    const int T = p.n_slots();
+    const int d1 = t1 / p.spd, d2 = t2 / p.spd;
+    int delta = 0;
+    if (t1 % p.spd == p.spd - 1) delta -= p.student_count[e];
+    if (t2 % p.spd == p.spd - 1) delta += p.student_count[e];
+    for (int s : attendees[e]) {
+      int *row = const_cast<int *>(&att[(size_t)s * T]);
+      const int b1 = day_scv(s, d1), b2 = d2 == d1 ? 0 : day_scv(s, d2);
+      row[t1]--; row[t2]++;
+      delta += day_scv(s, d1) - b1;
+      if (d2 != d1) delta += day_scv(s, d2) - b2;
+      row[t1]++; row[t2]--;
+    }
+    return delta;
+  }
+
+  // hcv delta (and new rooms for both slots) of moving e from t1 to t2,
+  // with EXACT re-matching of both affected slots per candidate — the
+  // reference's per-candidate cost profile (SURVEY section 3.2)
+  int hcv_delta_move1(Individual &ind, int e, int t2,
+                      std::vector<int> &new_rooms) const {
+    const int t1 = ind.slots[e];
+    int before = slot_hcv(by_slot[t1], ind.slots.data(), ind.rooms.data())
+               + slot_hcv(by_slot[t2], ind.slots.data(), ind.rooms.data());
+    // tentative: move e, re-match both slots into new_rooms
+    new_rooms = ind.rooms;
+    std::vector<int> s1;
+    for (int x : by_slot[t1]) if (x != e) s1.push_back(x);
+    std::vector<int> s2 = by_slot[t2];
+    s2.push_back(e);
+    if (!s1.empty()) xm.assign_slot(s1, new_rooms.data());
+    xm.assign_slot(s2, new_rooms.data());
+    int after = 0;
+    {
+      // slot_hcv over the tentative rooms; e's slot membership changed
+      int h = 0;
+      for (size_t i = 0; i < s1.size(); ++i) {
+        for (size_t j = i + 1; j < s1.size(); ++j) {
+          if (new_rooms[s1[i]] == new_rooms[s1[j]]) h++;
+          if (p.conflict[(size_t)s1[i] * p.E + s1[j]]) h++;
+        }
+        if (!p.possible[(size_t)s1[i] * p.R + new_rooms[s1[i]]]) h++;
+      }
+      for (size_t i = 0; i < s2.size(); ++i) {
+        for (size_t j = i + 1; j < s2.size(); ++j) {
+          if (new_rooms[s2[i]] == new_rooms[s2[j]]) h++;
+          if (p.conflict[(size_t)s2[i] * p.E + s2[j]]) h++;
+        }
+        if (!p.possible[(size_t)s2[i] * p.R + new_rooms[s2[i]]]) h++;
+      }
+      after = h;
+    }
+    return after - before;
+  }
+
+  void apply_move1(Individual &ind, int e, int t2,
+                   const std::vector<int> &new_rooms, int d_hcv,
+                   int d_scv) {
+    const int t1 = ind.slots[e];
+    auto &v1 = by_slot[t1];
+    v1.erase(std::find(v1.begin(), v1.end(), e));
+    by_slot[t2].push_back(e);
+    const int T = p.n_slots();
+    for (int s : attendees[e]) {
+      att[(size_t)s * T + t1]--;
+      att[(size_t)s * T + t2]++;
+    }
+    ind.slots[e] = t2;
+    ind.rooms = new_rooms;
+    hcv += d_hcv;
+    scv += d_scv;
+    ind.hcv = hcv;
+    ind.scv = scv;
+    ind.pen = penalty_of(hcv, scv);
+  }
+
+  // The sweep itself: first-improvement over shuffled events; phase 1
+  // (infeasible) accepts any hcv-reducing Move1/Move2; phase 2
+  // (feasible) accepts hcv-neutral scv-reducing moves. Counter resets on
+  // improvement; bounded by max_steps event visits and ls_limit seconds
+  // (Solution.cpp:471-769 semantics; -l honored here, retired on TPU).
+  void run(Individual &ind, Rng &rng, int max_steps, double ls_limit) {
+    rebuild(ind);
+    std::vector<int> order(p.E);
+    for (int e = 0; e < p.E; ++e) order[e] = e;
+    for (int e = p.E - 1; e > 0; --e)
+      std::swap(order[e], order[rng.next_int(e + 1)]);
+
+    const double t0 = now_sec();
+    const int T = p.n_slots();
+    int steps = 0, since_improve = 0;
+    std::vector<int> new_rooms;
+    for (int idx = 0; since_improve < p.E; idx = (idx + 1) % p.E) {
+      if (++steps > max_steps || now_sec() - t0 > ls_limit) break;
+      const int e = order[idx];
+      bool improved = false;
+      // Move1 sweep: all T target slots
+      for (int t2 = 0; t2 < T && !improved; ++t2) {
+        if (t2 == ind.slots[e]) continue;
+        const int dh = hcv_delta_move1(ind, e, t2, new_rooms);
+        if (hcv > 0 ? dh < 0 : dh == 0) {
+          const int ds = scv_delta(e, ind.slots[e], t2);
+          if (hcv > 0 ? true : ds < 0) {
+            apply_move1(ind, e, t2, new_rooms, dh, ds);
+            improved = true;
+          }
+        }
+      }
+      // Move2 sweep: swap with every other event (two chained Move1
+      // deltas would not be exact; evaluate the swap directly)
+      for (int j = 0; j < p.E && !improved; ++j) {
+        const int f = order[j];
+        if (f == e || ind.slots[f] == ind.slots[e]) continue;
+        const int t1 = ind.slots[e], t2 = ind.slots[f];
+        // swap = remove both, re-match both slots once
+        int before =
+            slot_hcv(by_slot[t1], ind.slots.data(), ind.rooms.data()) +
+            slot_hcv(by_slot[t2], ind.slots.data(), ind.rooms.data());
+        std::vector<int> s1, s2;
+        for (int x : by_slot[t1]) s1.push_back(x == e ? f : x);
+        for (int x : by_slot[t2]) s2.push_back(x == f ? e : x);
+        new_rooms = ind.rooms;
+        std::swap(ind.slots[e], ind.slots[f]);
+        xm.assign_slot(s1, new_rooms.data());
+        xm.assign_slot(s2, new_rooms.data());
+        int after = 0;
+        for (auto *sv : {&s1, &s2})
+          for (size_t a = 0; a < sv->size(); ++a) {
+            for (size_t b = a + 1; b < sv->size(); ++b) {
+              if (new_rooms[(*sv)[a]] == new_rooms[(*sv)[b]]) after++;
+              if (p.conflict[(size_t)(*sv)[a] * p.E + (*sv)[b]]) after++;
+            }
+            if (!p.possible[(size_t)(*sv)[a] * p.R + new_rooms[(*sv)[a]]])
+              after++;
+          }
+        std::swap(ind.slots[e], ind.slots[f]);  // undo tentative
+        const int dh = after - before;
+        if (!(hcv > 0 ? dh < 0 : dh == 0)) continue;
+        int ds = scv_delta(e, t1, t2);
+        // apply e's att shift before computing f's delta (exactness)
+        const int TT = p.n_slots();
+        for (int s : attendees[e]) {
+          att[(size_t)s * TT + t1]--; att[(size_t)s * TT + t2]++;
+        }
+        ds += scv_delta(f, t2, t1);
+        for (int s : attendees[e]) {
+          att[(size_t)s * TT + t1]++; att[(size_t)s * TT + t2]--;
+        }
+        if (hcv == 0 && ds >= 0) continue;
+        // commit the swap
+        auto &v1 = by_slot[t1];
+        auto &v2 = by_slot[t2];
+        *std::find(v1.begin(), v1.end(), e) = f;
+        *std::find(v2.begin(), v2.end(), f) = e;
+        for (int s : attendees[e]) {
+          att[(size_t)s * TT + t1]--; att[(size_t)s * TT + t2]++;
+        }
+        for (int s : attendees[f]) {
+          att[(size_t)s * TT + t2]--; att[(size_t)s * TT + t1]++;
+        }
+        std::swap(ind.slots[e], ind.slots[f]);
+        ind.rooms = new_rooms;
+        hcv += dh; scv += ds;
+        ind.hcv = hcv; ind.scv = scv;
+        ind.pen = penalty_of(hcv, scv);
+        improved = true;
+      }
+      since_improve = improved ? 0 : since_improve + 1;
+    }
+  }
+};
+
 struct LogSink {
   FILE *os = stdout;
   void log_entry(int proc, int tid, long long best, double t) const {
@@ -323,14 +641,6 @@ struct LogSink {
 static long long reported(const Individual &i) {  // ga.cpp:191
   return i.hcv == 0 ? (long long)i.scv
                     : (long long)i.hcv * 1000000LL + i.scv;
-}
-
-static double now_sec() {
-#ifdef _OPENMP
-  return omp_get_wtime();
-#else
-  return (double)clock() / CLOCKS_PER_SEC;
-#endif
 }
 
 // Generational mu+lambda GA, one island (the per-device program of the
@@ -421,6 +731,102 @@ static Individual run_ga(const Problem &p, const GaParams &g,
   return pop[0];
 }
 
+// Steady-state reference-faithful GA: pop 10, tournament-5, uniform
+// crossover (full EXACT rematch), one-move mutation, RefLS sweep to
+// local optimum, child replaces the worst, re-sort (ga.cpp:543-585
+// algorithm). Threads split the generation budget over a shared
+// population like the reference's OpenMP loop (ga.cpp:510), minus its
+// unlocked reads and shared-RNG races: selection-copy and replacement
+// run inside criticals, each thread owns an RNG.
+static Individual run_ga_reference(const Problem &p, const GaParams &g,
+                                   const LogSink *sink, int proc_id,
+                                   int max_steps, double ls_limit) {
+  ExactMatcher xm(p);
+  const int P = g.pop_size;
+  const double t0 = now_sec();
+  std::vector<Individual> pop(P);
+  std::vector<uint8_t> scratch;
+  {
+    Rng rng(g.seed);
+    RefLS ls(p, xm);
+    for (int i = 0; i < P; ++i) {
+      // every individual gets a VALID genotype (random + matching +
+      // eval) even when over the time budget; only the expensive sweep
+      // LS is skipped then — a default-constructed Individual (pen=0,
+      // empty arrays) must never reach the sort below
+      Individual &ind = pop[i];
+      ind.slots.resize(p.E);
+      ind.rooms.resize(p.E);
+      for (int e = 0; e < p.E; ++e) ind.slots[e] = rng.next_int(p.n_slots());
+      xm.assign_all(ind.slots.data(), ind.rooms.data());
+      evaluate(p, ind, scratch);
+      if (now_sec() - t0 <= g.time_limit)
+        ls.run(ind, rng, max_steps, ls_limit);
+    }
+  }
+  auto by_pen = [](const Individual &a, const Individual &b) {
+    return a.pen < b.pen;
+  };
+  std::sort(pop.begin(), pop.end(), by_pen);
+  long long best_seen = LLONG_MAX;
+
+  const int nthreads = g.threads > 0 ? g.threads : 1;
+#pragma omp parallel num_threads(nthreads)
+  {
+#ifdef _OPENMP
+    const int tid = omp_get_thread_num();
+#else
+    const int tid = 0;
+#endif
+    Rng rng(g.seed * 0x9e3779b97f4a7c15ULL + 1000 + tid);
+    RefLS ls(p, xm);
+    Matcher greedy(p);  // mutation's single-event insert re-room
+    std::vector<uint8_t> scr;
+    Individual child, pa_, pb_;
+    for (int gen = tid; gen < g.generations; gen += nthreads) {
+      if (now_sec() - t0 > g.time_limit) break;
+#pragma omp critical(ttpop)
+      {
+        auto pick = [&]() {
+          int best = rng.next_int(P);
+          for (int k = 1; k < g.tournament_k; ++k) {
+            int c = rng.next_int(P);
+            if (pop[c].pen < pop[best].pen) best = c;
+          }
+          return best;
+        };
+        pa_ = pop[pick()];
+        pb_ = pop[pick()];
+      }
+      child = pa_;
+      if (rng.next_double() < g.p_crossover) {
+        for (int e = 0; e < p.E; ++e)
+          if (rng.next_double() < 0.5) child.slots[e] = pb_.slots[e];
+        xm.assign_all(child.slots.data(), child.rooms.data());
+      }
+      if (rng.next_double() < g.p_mutation) {
+        MoveCtx c{p, greedy, rng, g.p1, g.p2, g.p3};
+        random_move(c, child.slots, child.rooms);
+      }
+      evaluate(p, child, scr);
+      ls.run(child, rng, max_steps, ls_limit);
+#pragma omp critical(ttpop)
+      {
+        // child UNCONDITIONALLY overwrites the worst, then re-sort
+        // (steady-state replacement, ga.cpp:580-585)
+        pop[P - 1] = child;
+        std::sort(pop.begin(), pop.end(), by_pen);
+        const long long rep = reported(pop[0]);
+        if (sink && rep < best_seen) {
+          best_seen = rep;
+          sink->log_entry(proc_id, tid, rep, now_sec() - t0);
+        }
+      }
+    }
+  }
+  return pop[0];
+}
+
 }  // namespace tt
 
 // =====================================================================
@@ -434,6 +840,12 @@ void *tt_problem_create(int E, int R, int F, int S, int days, int spd,
                         const int *room_size, const int8_t *attends,
                         const int8_t *room_features,
                         const int8_t *event_features) {
+  // Mirror ops/rooms.py's key-packing bounds: Matcher::choose packs
+  // unsuitable/occupancy/cap_rank into one long key, so occupancy (<= E)
+  // must stay below 1<<12 and cap_rank (< R) inside its field, or the
+  // preference order silently inverts and desynchronizes from the JAX
+  // kernel it cross-checks.
+  if (E >= (1 << 12) || R >= (1 << 12)) return nullptr;
   auto *p = new tt::Problem();
   p->E = E; p->R = R; p->F = F; p->S = S; p->days = days; p->spd = spd;
   p->room_size.assign(room_size, room_size + R);
@@ -495,6 +907,9 @@ int main(int argc, char **argv) {
   int problem_type = 1;
   bool max_steps_set = false;
   int max_steps = 200;
+  double ls_limit = 99999.0;  // -l (Control.cpp:93-99); honored by --algo
+                              // reference's sweep LS (Solution.cpp:499)
+  std::string algo = "memetic";
 
   for (int i = 1; i + 1 < argc + 1; ++i) {
     std::string a = argv[i] ? argv[i] : "";
@@ -506,6 +921,8 @@ int main(int argc, char **argv) {
     else if (a == "-t") { const char *v = val(); if (v) g.time_limit = std::atof(v); }
     else if (a == "-p") { const char *v = val(); if (v) problem_type = std::atoi(v); }
     else if (a == "-m") { const char *v = val(); if (v) { max_steps = std::atoi(v); max_steps_set = true; } }
+    else if (a == "-l") { const char *v = val(); if (v) ls_limit = std::atof(v); }
+    else if (a == "--algo") { const char *v = val(); if (v) algo = v; }
     else if (a == "-p1") { const char *v = val(); if (v) g.p1 = std::atof(v); }
     else if (a == "-p2") { const char *v = val(); if (v) g.p2 = std::atof(v); }
     else if (a == "-p3") { const char *v = val(); if (v) g.p3 = std::atof(v); }
@@ -531,8 +948,15 @@ int main(int argc, char **argv) {
     if (!sink.os) { std::fprintf(stderr, "cannot open %s\n", output); return 1; }
   }
 
+  if (algo != "memetic" && algo != "reference") {
+    std::fprintf(stderr, "unknown --algo: %s\n", algo.c_str());
+    return 2;
+  }
   const double t0 = tt::now_sec();
-  tt::Individual best = tt::run_ga(p, g, &sink, 0);
+  tt::Individual best =
+      algo == "reference"
+          ? tt::run_ga_reference(p, g, &sink, 0, max_steps, ls_limit)
+          : tt::run_ga(p, g, &sink, 0);
   const double dt = tt::now_sec() - t0;
   const long long rep = tt::reported(best);
   const bool feas = best.hcv == 0;

@@ -98,7 +98,14 @@ def test_island_run_and_global_best(island_setup, mesh):
     runner = islands.make_island_runner(mesh, cfg, n_epochs=3,
                                         gens_per_epoch=5)
     out, trace, global_best = runner(pa, jax.random.key(1), state)
-    assert np.asarray(trace).shape == (N_ISLANDS, 3)
+    # per-generation (hcv, scv) best trace: (islands, epochs, gens, 2)
+    trace = np.asarray(trace)
+    assert trace.shape == (N_ISLANDS, 3, 5, 2)
+    # the final trace entry must equal the final population's best row
+    hcv = np.asarray(out.hcv).reshape(N_ISLANDS, POP)
+    # (migration after the last generation may have imported a better row,
+    # so the final best is <= the last pre-migration trace entry)
+    assert (hcv[:, 0] <= trace[:, -1, -1, 0]).all()
     # global best == min over islands of local best
     pen = np.asarray(out.penalty).reshape(N_ISLANDS, POP)
     assert int(global_best) == int(pen[:, 0].min())

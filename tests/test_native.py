@@ -81,3 +81,30 @@ def test_tt_cpu_end_to_end(tmp_path):
         rooms = sol["rooms"]
         assert oracle_hcv(problem, slots, rooms) == 0
         assert oracle_scv(problem, slots) == sol["totalBest"]
+
+
+@pytest.mark.skipif(not os.path.exists(TT_CPU), reason="tt_cpu not built")
+def test_tt_cpu_reference_algo(tmp_path):
+    """The reference-faithful baseline (--algo reference: steady-state
+    pop-10, exhaustive first-improvement sweep LS, exact per-slot
+    matching) runs, reaches feasibility on an easy instance, and its
+    reported solution is exact under the Python oracle."""
+    problem = random_instance(78, n_events=20, n_rooms=5, n_features=2,
+                              n_students=12, attend_prob=0.1)
+    inst = tmp_path / "inst.tim"
+    inst.write_text(dump_tim(problem))
+    out = subprocess.run(
+        [TT_CPU, "-i", str(inst), "-s", "3", "-c", "2", "-t", "20",
+         "--algo", "reference", "--generations", "200"],
+        capture_output=True, text=True, timeout=120, check=True)
+    lines = [json.loads(x) for x in out.stdout.splitlines()]
+    run = [x["runEntry"] for x in lines if "runEntry" in x]
+    assert len(run) == 2
+    sol = next(x["solution"] for x in lines if "solution" in x)
+    assert sol["feasible"]
+    from timetabling_ga_tpu.oracle import oracle_hcv, oracle_scv
+    assert oracle_hcv(problem, sol["timeslots"], sol["rooms"]) == 0
+    assert oracle_scv(problem, sol["timeslots"]) == sol["totalBest"]
+    # logEntry stream is monotone decreasing
+    bests = [x["logEntry"]["best"] for x in lines if "logEntry" in x]
+    assert bests == sorted(bests, reverse=True)

@@ -144,12 +144,14 @@ def test_checkpoint_roundtrip(tmp_path, small_problem):
     pa = small_problem.device_arrays()
     st = ga.init_population(pa, jax.random.key(0), 8)
     gacfg = ga.GAConfig(pop_size=8)
-    fp = ckpt.config_fingerprint(small_problem, gacfg)
+    fp = ckpt.config_fingerprint(small_problem, gacfg, n_islands=2)
     path = str(tmp_path / "ck.npz")
     key = jax.random.key(7)
-    ckpt.save(path, st, key, 120, fp)
-    st2, key2, gen2 = ckpt.load(path, fp)
+    ckpt.save(path, st, key, 120, fp, best_seen=[42, 99], seed=7)
+    st2, key2, gen2, best2, seed2 = ckpt.load(path, fp)
     assert gen2 == 120
+    assert best2 == [42, 99]
+    assert seed2 == 7
     np.testing.assert_array_equal(np.asarray(st.slots),
                                   np.asarray(st2.slots))
     np.testing.assert_array_equal(
@@ -158,6 +160,84 @@ def test_checkpoint_roundtrip(tmp_path, small_problem):
     # fingerprint mismatch refuses to load
     with pytest.raises(ValueError):
         ckpt.load(path, fp + "X")
+    # a different island count is a different fingerprint, so a
+    # mismatched --islands resume is refused cleanly (not a reshape error)
+    fp4 = ckpt.config_fingerprint(small_problem, gacfg, n_islands=4)
+    with pytest.raises(ValueError):
+        ckpt.load(path, fp4)
+
+
+def test_engine_resume_seed_conflict(tim_file, tmp_path):
+    """Resuming with an EXPLICIT conflicting -s is refused; resuming
+    without -s adopts the checkpoint's seed (default time() seeds must
+    not break resume)."""
+    ck = str(tmp_path / "seedck.npz")
+    cfg = RunConfig(input=tim_file, seed=5, pop_size=8, islands=2,
+                    generations=10, migration_period=10,
+                    max_steps=8, time_limit=300, backend="cpu",
+                    checkpoint=ck, checkpoint_every=1)
+    run(cfg, out=io.StringIO())
+    bad = RunConfig(input=tim_file, seed=6, pop_size=8, islands=2,
+                    generations=20, migration_period=10,
+                    max_steps=8, time_limit=300, backend="cpu",
+                    checkpoint=ck, checkpoint_every=1, resume=True)
+    with pytest.raises(ValueError):
+        run(bad, out=io.StringIO())
+    noseed = RunConfig(input=tim_file, seed=None, pop_size=8, islands=2,
+                       generations=20, migration_period=10,
+                       max_steps=8, time_limit=300, backend="cpu",
+                       checkpoint=ck, checkpoint_every=1, resume=True)
+    run(noseed, out=io.StringIO())
+    with np.load(ck, allow_pickle=False) as z:
+        assert int(z["seed"]) == 5
+        assert int(z["generation"]) == 20
+
+
+def test_engine_exact_generation_budget(tim_file):
+    """A budget not divisible by migration_period must be honored exactly
+    (clamped final dispatch), not overshot."""
+    buf = io.StringIO()
+    cfg = RunConfig(input=tim_file, seed=9, pop_size=8, islands=2,
+                    generations=25, migration_period=10,
+                    max_steps=8, time_limit=300, backend="cpu",
+                    trace=True)
+    run(cfg, out=buf)
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    gens = sum(x["phase"].get("gens", 0) for x in lines if "phase" in x)
+    assert gens == 25
+
+
+def test_engine_trace_phases(tim_file):
+    buf = io.StringIO()
+    cfg = RunConfig(input=tim_file, seed=2, pop_size=8, islands=2,
+                    generations=20, migration_period=10,
+                    max_steps=8, time_limit=300, backend="cpu",
+                    trace=True)
+    run(cfg, out=buf)
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    names = [x["phase"]["name"] for x in lines if "phase" in x]
+    for expect in ("load", "init", "dispatch", "fetch"):
+        assert expect in names
+    for x in lines:
+        if "phase" in x:
+            assert x["phase"]["seconds"] >= 0
+
+
+def test_engine_multi_epoch_dispatch(tim_file):
+    """epochs_per_dispatch > 1 fuses epochs into one device call but
+    must produce the identical generation count and protocol shape."""
+    buf = io.StringIO()
+    cfg = RunConfig(input=tim_file, seed=4, pop_size=8, islands=2,
+                    generations=40, migration_period=10,
+                    max_steps=8, time_limit=300, backend="cpu",
+                    epochs_per_dispatch=4, trace=True)
+    run(cfg, out=buf)
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    dispatches = [x["phase"] for x in lines
+                  if "phase" in x and x["phase"]["name"] == "dispatch"]
+    assert len(dispatches) == 1 and dispatches[0]["gens"] == 40
+    kinds = [next(iter(x)) for x in lines]
+    assert kinds.count("solution") == 2 and kinds.count("runEntry") == 2
 
 
 def test_engine_resume(tim_file, tmp_path):
@@ -179,3 +259,17 @@ def test_engine_resume(tim_file, tmp_path):
     run(cfg2, out=buf)
     with np.load(ck, allow_pickle=False) as z:
         assert int(z["generation"]) == 40
+        best_saved = np.array(z["best_seen"]).tolist()
+    # the resumed stream stays monotone: every post-resume logEntry beats
+    # the best already reported before the interruption (persisted
+    # best_seen), so no pre-crash bests are re-emitted
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    per_island = {}
+    for x in lines:
+        if "logEntry" in x:
+            e = x["logEntry"]
+            per_island.setdefault(e["procID"], []).append(e["best"])
+    for i, bests in per_island.items():
+        assert bests == sorted(bests, reverse=True)
+        assert len(set(bests)) == len(bests)
+        assert bests[-1] <= best_saved[i]

@@ -1,0 +1,107 @@
+"""Sweep local-search tests (ops/sweep.py): Move1 sweep delta exactness
+against full re-evaluation, maintained-state invariants after passes, and
+search-power comparison against the K-random-candidate search.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from timetabling_ga_tpu.ops import fitness, sweep
+from timetabling_ga_tpu.ops.delta import init_state
+from timetabling_ga_tpu.ops.local_search import batch_local_search
+from timetabling_ga_tpu.ops.rooms import batch_assign_rooms, capacity_rank
+from timetabling_ga_tpu.problem import random_instance
+
+
+@pytest.fixture(scope="module")
+def inst():
+    problem = random_instance(77, n_events=24, n_rooms=6, n_features=3,
+                              n_students=15, attend_prob=0.12)
+    return problem, problem.device_arrays()
+
+
+def _rand_pop(pa, key, P):
+    slots = jax.random.randint(key, (P, pa.n_events), 0, pa.n_slots,
+                               dtype=jnp.int32)
+    rooms = batch_assign_rooms(pa, slots)
+    return slots, rooms
+
+
+def test_move1_sweep_deltas_exact(inst):
+    """Every (event, target-slot) delta must equal full re-evaluation of
+    the moved-and-re-roomed solution."""
+    problem, pa = inst
+    E, T = pa.n_events, pa.n_slots
+    slots, rooms = _rand_pop(pa, jax.random.key(0), 1)
+    s, r = slots[0], rooms[0]
+    st = init_state(pa, slots, rooms)
+    att, occ = st.att[0], st.occ[0]
+    hcv0, scv0 = int(st.hcv[0]), int(st.scv[0])
+    cap_rank = capacity_rank(pa)
+
+    for e in [0, 3, 11, E - 1]:
+        d_hcv, d_scv, new_rooms = sweep._move1_sweep(
+            pa, s, r, att, occ, jnp.int32(e), cap_rank)
+        d_hcv, d_scv = np.asarray(d_hcv), np.asarray(d_scv)
+        new_rooms = np.asarray(new_rooms)
+        for t in range(T):
+            s2 = s.at[e].set(t)
+            r2 = r.at[e].set(int(new_rooms[t]))
+            _, hcv2, scv2 = fitness.compute_penalty(pa, s2, r2)
+            assert int(hcv2) - hcv0 == d_hcv[t], (e, t)
+            assert int(scv2) - scv0 == d_scv[t], (e, t)
+
+
+def test_sweep_pass_state_consistent(inst):
+    """After a pass, the maintained (pen, hcv, scv, att, occ) must match
+    recomputation from the genotypes."""
+    problem, pa = inst
+    slots, rooms = _rand_pop(pa, jax.random.key(1), 8)
+    st = init_state(pa, slots, rooms)
+    st = sweep.sweep_pass(pa, jax.random.key(2), st, swap_block=4)
+    pen, hcv, scv = fitness.batch_penalty(pa, st.slots, st.rooms)
+    np.testing.assert_array_equal(np.asarray(st.hcv), np.asarray(hcv))
+    np.testing.assert_array_equal(np.asarray(st.scv), np.asarray(scv))
+    np.testing.assert_array_equal(np.asarray(st.pen), np.asarray(pen))
+    st2 = init_state(pa, st.slots, st.rooms)
+    np.testing.assert_array_equal(np.asarray(st.att), np.asarray(st2.att))
+    np.testing.assert_array_equal(np.asarray(st.occ), np.asarray(st2.occ))
+
+
+def test_sweep_monotone_improvement(inst):
+    """Penalties never worsen, and a pass strictly improves a random
+    population (it examines every event x 45 targets)."""
+    problem, pa = inst
+    slots, rooms = _rand_pop(pa, jax.random.key(3), 8)
+    pen0, _, _ = fitness.batch_penalty(pa, slots, rooms)
+    s1, r1 = sweep.sweep_local_search(pa, jax.random.key(4), slots, rooms,
+                                      n_sweeps=1, swap_block=4)
+    pen1, _, _ = fitness.batch_penalty(pa, s1, r1)
+    assert (np.asarray(pen1) <= np.asarray(pen0)).all()
+    assert (np.asarray(pen1) < np.asarray(pen0)).any()
+    # invariant: each event still has exactly one slot/room assignment
+    assert s1.shape == slots.shape and r1.shape == rooms.shape
+    assert (np.asarray(s1) >= 0).all() and (np.asarray(s1) < pa.n_slots).all()
+
+
+def test_sweep_beats_random_candidates_at_equal_depth(inst):
+    """At equal SERIAL DEPTH — the TPU-relevant cost model: a sweep step
+    evaluates P*(T+B) candidates in one wide fused step, while a K-random
+    round evaluates P*K; both are one dependent step in the scan chain —
+    the systematic sweep must reach better-or-equal mean penalty (VERDICT
+    round-1 item 2). Wall-clock superiority on real hardware is measured
+    separately by bench.py's LS-mode shootout."""
+    problem, pa = inst
+    P = 16
+    slots, rooms = _rand_pop(pa, jax.random.key(5), P)
+    E = pa.n_events
+    # sweep: 1 pass = E dependent steps; K-random: E rounds = E steps
+    s_r, r_r = batch_local_search(pa, jax.random.key(6), slots, rooms,
+                                  n_rounds=E, n_candidates=8)
+    pen_r, _, _ = fitness.batch_penalty(pa, s_r, r_r)
+    s_s, r_s = sweep.sweep_local_search(pa, jax.random.key(6), slots,
+                                        rooms, n_sweeps=1, swap_block=4)
+    pen_s, _, _ = fitness.batch_penalty(pa, s_s, r_s)
+    assert np.asarray(pen_s).mean() <= np.asarray(pen_r).mean()

@@ -28,8 +28,10 @@ from timetabling_ga_tpu.ops.fitness import (
     batch_penalty,
 )
 from timetabling_ga_tpu.ops.ga import GAConfig, PopState, init_population
-from timetabling_ga_tpu.ops.rooms import assign_rooms, batch_assign_rooms
+from timetabling_ga_tpu.ops.rooms import (
+    assign_rooms, batch_assign_rooms, batch_parallel_assign_rooms)
 from timetabling_ga_tpu.ops.local_search import batch_local_search
+from timetabling_ga_tpu.ops.sweep import sweep_local_search
 from timetabling_ga_tpu.parallel import (
     make_mesh, init_island_population, make_island_runner)
 from timetabling_ga_tpu.runtime import RunConfig, parse_args, run

@@ -94,6 +94,12 @@ def _handle(problem) -> int:
         np.ascontiguousarray(problem.attends, np.int8),
         np.ascontiguousarray(problem.room_features, np.int8),
         np.ascontiguousarray(problem.event_features, np.int8))
+    if not h:
+        # same bound the JAX matcher asserts (ops/rooms.py): the packed
+        # room-preference key holds occupancy/cap_rank in 12-bit fields
+        raise ValueError(
+            f"native matcher requires E < 4096 and R < 4096, got "
+            f"E={problem.n_events} R={problem.n_rooms}")
     _handles[key] = h
     import weakref
     weakref.finalize(problem, _free_handle, key, h)

@@ -33,7 +33,8 @@ from jax import lax
 
 from timetabling_ga_tpu.ops import fitness
 from timetabling_ga_tpu.ops.moves import random_move
-from timetabling_ga_tpu.ops.rooms import assign_rooms, batch_assign_rooms
+from timetabling_ga_tpu.ops.rooms import (
+    assign_rooms, batch_assign_rooms, parallel_assign_rooms)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,6 +53,12 @@ class GAConfig:
     ls_steps: int = 0             # local-search rounds per child (C8); 0=off
     ls_candidates: int = 8        # candidate moves per LS round
     ls_delta: bool = True         # delta-eval LS (C6) vs full re-eval
+    ls_mode: str = "random"       # "random" K-candidate | "sweep"
+    ls_sweeps: int = 1            # full sweep passes when ls_mode="sweep"
+    ls_swap_block: int = 8        # Move2 partners per event per sweep pass
+    rooms_mode: str = "scan"      # crossover rematch: "scan" E-deep
+    #                               cost-greedy | "parallel" O(1)-depth
+    #                               (rooms.parallel_assign_rooms)
     multi_objective: bool = False  # NSGA-II (hcv, scv) replacement
 
 
@@ -118,7 +125,13 @@ def _make_child(pa, key, state: PopState, cfg: GAConfig):
     # (ga.cpp:565-566)
     mask = jax.random.bernoulli(k_mask, 0.5, (s_a.shape[0],))
     x_slots = jnp.where(mask, s_a, s_b)
-    x_rooms = assign_rooms(pa, x_slots)
+    if cfg.rooms_mode == "parallel":
+        # O(1)-depth matcher: removes the E-deep scan from the breeding
+        # critical path at a small matching-quality cost (see
+        # rooms.parallel_assign_rooms; default decided by bench.py)
+        x_rooms = parallel_assign_rooms(pa, x_slots)
+    else:
+        x_rooms = assign_rooms(pa, x_slots)
     do_x = jax.random.bernoulli(k_x, cfg.p_crossover)
     slots = jnp.where(do_x, x_slots, s_a)
     rooms_arr = jnp.where(do_x, x_rooms, r_a)
@@ -139,7 +152,14 @@ def generation(pa, key, state: PopState, cfg: GAConfig) -> PopState:
     ch_slots, ch_rooms = jax.vmap(
         lambda k: _make_child(pa, k, state, cfg))(keys)
 
-    if cfg.ls_steps > 0:
+    if cfg.ls_mode == "sweep" and cfg.ls_sweeps > 0:
+        # systematic Move1+Move2 sweep (Solution.cpp:508-561 analogue)
+        from timetabling_ga_tpu.ops.sweep import sweep_local_search
+        k_ls = jax.random.fold_in(key, 0x15)
+        ch_slots, ch_rooms = sweep_local_search(
+            pa, k_ls, ch_slots, ch_rooms,
+            n_sweeps=cfg.ls_sweeps, swap_block=cfg.ls_swap_block)
+    elif cfg.ls_steps > 0:
         if cfg.ls_delta:
             from timetabling_ga_tpu.ops.delta import (
                 batch_local_search_delta as ls_fn)

@@ -1,0 +1,263 @@
+"""Systematic batched sweep local search — the fixed-shape analogue of the
+reference's exhaustive first-improvement sweeps.
+
+The reference's `Solution::localSearch` (Solution.cpp:471-769) walks events
+in shuffled order and, for each, tries ALL 45 target slots (Move1,
+Solution.cpp:508-534) and all swap partners (Move2, 535-561), accepting the
+first improving candidate and resetting its pass counter — effectively
+running to a local optimum. The round-1 K-random-candidate search
+(ops/local_search.py) samples a far sparser neighborhood; this module
+closes that power gap with fixed shapes:
+
+  one PASS = `lax.scan` over event positions (shuffled per individual per
+  pass, Solution.cpp:476-484). At each position, for every individual in
+  the population simultaneously:
+    - Move1: delta-evaluate relocating the event to ALL T slots at once
+      (each target also re-rooms the event greedily in its new slot);
+    - Move2: delta-evaluate swapping with a block of `swap_block` partner
+      events (the next B events in the permutation, so successive passes
+      rotate coverage across all partners);
+    - accept the BEST strictly improving candidate (best-improvement per
+      event vs the reference's first-improvement — a documented
+      divergence that only strengthens the per-event step).
+
+Delta costs are neighborhood-local: the Move1 sweep computes all T slot
+deltas in O(S*T + E + T*R) per event by expressing the scv change of
+adding/removing one attendance as a function of the 4-slot window around
+the target (a run-of-3 can only be created through the inserted slot, and
+single-day counts shift by one) instead of re-scoring whole days per
+candidate. The two phases (hcv repair, then scv polish that never breaks
+feasibility) need no explicit gate: acceptance compares the scalar penalty
+`scv if feasible else 1e6+hcv` (Solution.cpp:162-170), under whose
+ordering any hcv reduction dominates while infeasible and any
+feasibility-breaking move is unacceptable once feasible.
+
+Move3 (3-cycles) is off by default in the reference (p3=0, Control.cpp:
+115-125) and is served by the random-candidate search (ops/local_search.py
+/ ops/delta.py); the sweep covers Move1+Move2, the moves the reference
+actually sweeps.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from timetabling_ga_tpu.ops import fitness
+from timetabling_ga_tpu.ops.delta import (
+    LSState, _apply_move, _day_scv, _delta_one, init_state)
+from timetabling_ga_tpu.ops.rooms import _W_COST, _W_UNSUIT, capacity_rank
+
+
+def _move1_sweep(pa, slots, rooms_arr, att, occ, e, cap_rank):
+    """Delta-evaluate Move1(e, t) for EVERY target slot t of one
+    individual. Returns (d_hcv (T,), d_scv (T,), new_rooms (T,)).
+
+    The t == current-slot column is a re-rooming candidate (delta 0 when
+    the greedy choice is the current room). Semantics per candidate match
+    ops/delta.py's `_delta_one` for a single-event relocation exactly.
+    """
+    T = pa.n_slots
+    spd = pa.slots_per_day
+    D = pa.n_days
+    S = pa.attends.shape[0]
+    s_old = slots[e]
+    r_old = rooms_arr[e]
+
+    # ---- room-pair clashes + greedy re-rooming for every target slot
+    occ32 = occ.astype(jnp.int32).at[s_old, r_old].add(-1)
+    remove_d = -(occ.astype(jnp.int32)[s_old, r_old] - 1)
+    suit = pa.possible[e]                                  # (R,)
+    # marginal-hcv-cost key — MUST stay in lockstep with rooms._room_key
+    unsuit = (~suit).astype(jnp.int32)[None, :]
+    key = ((occ32 + unsuit) * _W_COST
+           + unsuit * _W_UNSUIT
+           + cap_rank[None, :])                            # (T, R)
+    new_rooms = jnp.argmin(key, axis=1).astype(jnp.int32)  # (T,)
+    add_d = occ32[jnp.arange(T), new_rooms]
+    pair_d = remove_d + add_d
+
+    unsuit_d = ((~pa.possible[e, new_rooms]).astype(jnp.int32)
+                - (~pa.possible[e, r_old]).astype(jnp.int32))
+
+    # ---- correlated-pair delta: conflicting events per slot (one
+    # segment-sum over E), minus the current slot's count
+    conf = pa.conflict[e].at[e].set(0.0)                   # (E,)
+    per_slot = jnp.zeros((T,), jnp.float32).at[slots].add(conf)
+    corr_d = (per_slot - per_slot[s_old]).astype(jnp.int32)
+
+    d_hcv = pair_d + unsuit_d + corr_d
+
+    # ---- scv: last-slot-of-day term
+    sc = pa.student_count[e]
+    t_idx = jnp.arange(T)
+    last_d = (jnp.where(t_idx % spd == spd - 1, sc, 0)
+              - jnp.where(s_old % spd == spd - 1, sc, 0))
+
+    # ---- scv: day terms. Removing e from s_old re-scores one day
+    # window; adding e at target t is neighborhood-local on the
+    # binarized post-removal attendance b1:
+    #   consec: a new run-of-3 through an empty slot j needs two
+    #           attended neighbors on one side or both sides;
+    #   single: day count 0 -> 1 creates a single, 1 -> 2 removes one.
+    col = pa.attends[:, e].astype(jnp.int32)               # (S,)
+    att1 = att.astype(jnp.int32).at[:, s_old].add(-col)
+
+    d0 = s_old // spd
+    before = lax.dynamic_slice(att.astype(jnp.int32),
+                               (0, d0 * spd), (S, spd))
+    after = lax.dynamic_slice(att1, (0, d0 * spd), (S, spd))
+    rm_d = _day_scv(after > 0) - _day_scv(before > 0)
+
+    b1 = (att1 > 0).reshape(S, D, spd)                     # (S, D, spd)
+    z = jnp.zeros((S, D, 1), jnp.bool_)
+    bp = jnp.concatenate([z, z, b1, z, z], axis=2)         # pad 2 each side
+    # neighbors at distance 1/2 left/right of each in-day position
+    l1, l2 = bp[:, :, 1:-3], bp[:, :, :-4]
+    r1, r2 = bp[:, :, 3:-1], bp[:, :, 4:]
+    free = ~b1
+    # COUNT of new runs-of-3 through slot j (0..3), so each pair term
+    # must be cast before summing (bool + bool is OR, not count)
+    dconsec = free * ((l2 & l1).astype(jnp.int32)
+                      + (l1 & r1).astype(jnp.int32)
+                      + (r1 & r2).astype(jnp.int32))
+    cnt = b1.sum(axis=2, dtype=jnp.int32)                  # (S, D)
+    dsingle = free * ((cnt == 0).astype(jnp.int32)
+                      - (cnt == 1).astype(jnp.int32))[:, :, None]
+    add_per_target = jnp.einsum(
+        "s,sdj->dj", col.astype(jnp.float32),
+        (dconsec + dsingle).astype(jnp.float32)).reshape(T)
+
+    d_scv = last_d + rm_d + add_per_target.astype(jnp.int32)
+    return d_hcv, d_scv, new_rooms
+
+
+def _distinct_pad(e1, e2, E: int):
+    """An event index distinct from e1 and e2 (needs E >= 3)."""
+    pad = (e1 + 1) % E
+    return jnp.where(pad == e2, (e1 + 2) % E, pad)
+
+
+def sweep_pass(pa, key, state: LSState, swap_block: int = 8) -> LSState:
+    """One full sweep pass over all events (shuffled per individual)."""
+    cap_rank = capacity_rank(pa)
+    P, E = state.slots.shape
+    T = pa.n_slots
+    assert E >= 3, "padded 3-relocation form needs E >= 3"
+    # partner offsets must stay within the permutation; clamp for tiny E
+    swap_block = min(max(swap_block, 0), E - 1)
+
+    perm_keys = jax.random.split(key, P)
+    perms = jax.vmap(
+        lambda k: jax.random.permutation(k, E).astype(jnp.int32))(perm_keys)
+
+    def step(st, pos):
+        e = lax.dynamic_index_in_dim(perms, pos, axis=1,
+                                     keepdims=False)      # (P,)
+
+        def per_ind(e_i, s, r, att, occ):
+            # Move1: all T targets
+            dh1, ds1, rooms1 = _move1_sweep(pa, s, r, att, occ, e_i,
+                                            cap_rank)
+            # pad events: distinct from e (and each other) so the padded
+            # 3-relocation form's correlation terms stay exact
+            p1 = _distinct_pad(e_i, e_i, E)
+            p2 = _distinct_pad(e_i, p1, E)
+            evs1 = jnp.broadcast_to(jnp.stack([e_i, p1, p2]), (T, 3))
+            ns1 = jnp.stack([jnp.arange(T, dtype=jnp.int32),
+                             jnp.broadcast_to(s[p1], (T,)),
+                             jnp.broadcast_to(s[p2], (T,))], axis=1)
+            nr1 = jnp.stack([rooms1,
+                             jnp.broadcast_to(r[p1], (T,)),
+                             jnp.broadcast_to(r[p2], (T,))], axis=1)
+            return dh1, ds1, evs1, ns1, nr1
+
+        # Move1 sweep for every individual
+        dh1, ds1, evs1, ns1, nr1 = jax.vmap(per_ind)(
+            e, st.slots, st.rooms, st.att, st.occ)
+
+        cand_dh, cand_ds = dh1, ds1                        # (P, T)
+        cand_evs, cand_ns, cand_nr = evs1, ns1, nr1        # (P, T, 3)
+
+        if swap_block > 0:
+            offs = (pos + 1 + jnp.arange(swap_block)) % E   # (B,)
+            partners = perms[:, offs]                       # (P, B)
+
+            def swap_one(e_i, q, s, r, att, occ):
+                pad = _distinct_pad(e_i, q, E)
+                evs = jnp.stack([e_i, q, pad])
+                ns = jnp.stack([s[q], s[e_i], s[pad]])
+                active = jnp.array([True, True, False])
+                dh, ds, nr = _delta_one(pa, s, r, att, occ, evs, ns,
+                                        active, cap_rank)
+                return dh, ds, evs, ns, nr
+
+            def swaps_per_ind(e_i, qs, s, r, att, occ):
+                return jax.vmap(
+                    lambda q: swap_one(e_i, q, s, r, att, occ))(qs)
+
+            dh2, ds2, evs2, ns2, nr2 = jax.vmap(swaps_per_ind)(
+                e, partners, st.slots, st.rooms, st.att, st.occ)
+            cand_dh = jnp.concatenate([cand_dh, dh2], axis=1)
+            cand_ds = jnp.concatenate([cand_ds, ds2], axis=1)
+            cand_evs = jnp.concatenate([cand_evs, evs2], axis=1)
+            cand_ns = jnp.concatenate([cand_ns, ns2], axis=1)
+            cand_nr = jnp.concatenate([cand_nr, nr2], axis=1)
+
+        new_hcv = st.hcv[:, None] + cand_dh                # (P, C)
+        new_scv = st.scv[:, None] + cand_ds
+        new_pen = jnp.where(new_hcv == 0, new_scv,
+                            fitness.INFEASIBLE_OFFSET + new_hcv)
+        best = jnp.argmin(new_pen, axis=1)                 # (P,)
+        ar = jnp.arange(P)
+        best_pen = new_pen[ar, best]
+        better = best_pen < st.pen
+
+        def apply_or_keep(b, s, r, att, occ, e3, ns3, nr3):
+            s2, r2, att2, occ2 = _apply_move(pa, (s, r, att, occ),
+                                             e3, ns3, nr3)
+            return (jnp.where(b, s2, s), jnp.where(b, r2, r),
+                    jnp.where(b, att2, att), jnp.where(b, occ2, occ))
+
+        s2, r2, att2, occ2 = jax.vmap(apply_or_keep)(
+            better, st.slots, st.rooms, st.att, st.occ,
+            cand_evs[ar, best], cand_ns[ar, best], cand_nr[ar, best])
+
+        st = LSState(
+            slots=s2, rooms=r2, att=att2, occ=occ2,
+            pen=jnp.where(better, best_pen, st.pen),
+            hcv=jnp.where(better, new_hcv[ar, best], st.hcv),
+            scv=jnp.where(better, new_scv[ar, best], st.scv))
+        return st, None
+
+    state, _ = lax.scan(step, state, jnp.arange(E))
+    return state
+
+
+def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
+                       swap_block: int = 8):
+    """Run `n_sweeps` full sweep passes over a (P, E) population.
+
+    Candidate budget per pass per individual: E * (T + swap_block)
+    delta evaluations — the full Move1 neighborhood plus a rotating
+    Move2 block, vs the reference's identical per-pass Move1 coverage
+    (Solution.cpp:508-534) and full Move2 coverage (535-561).
+    """
+    state = init_state(pa, slots, rooms_arr)
+
+    def one(st, k):
+        return sweep_pass(pa, k, st, swap_block), None
+
+    keys = jax.random.split(key, n_sweeps)
+    state, _ = lax.scan(one, state, keys)
+    return state.slots, state.rooms
+
+
+@functools.partial(jax.jit, static_argnames=("n_sweeps", "swap_block"))
+def jit_sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
+                           swap_block: int = 8):
+    return sweep_local_search(pa, key, slots, rooms_arr, n_sweeps,
+                              swap_block)

@@ -111,7 +111,12 @@ def make_island_runner(mesh: Mesh, cfg: ga.GAConfig, n_epochs: int,
 
     Returns `run(pa, key, state) -> (state, best_trace, global_best)`:
       - state: global PopState sharded over the mesh
-      - best_trace: (n_islands, n_epochs) best penalty per island per epoch
+      - best_trace: (n_islands, n_epochs, gens_per_epoch, 2) int32 —
+        per-GENERATION (hcv, scv) of each island's best individual,
+        tracked on-device inside the scan so mid-epoch improvements are
+        visible to the JSONL logEntry protocol (ga.cpp:203-228) without
+        any per-epoch host fetch; the host reads the whole trace once per
+        dispatch
       - global_best: scalar = pmin over islands of the final best penalty
         (the reference's MPI_Allreduce MIN, ga.cpp:237)
     One dispatch runs n_epochs x gens_per_epoch generations on all islands
@@ -133,15 +138,17 @@ def make_island_runner(mesh: Mesh, cfg: ga.GAConfig, n_epochs: int,
 
         def epoch(st, k):
             def gen_step(s, kk):
-                return ga.generation(pa, kk, s, cfg), None
+                s = ga.generation(pa, kk, s, cfg)
+                # population is penalty-sorted, so row 0 is the best
+                return s, jnp.stack([s.hcv[0], s.scv[0]])
             gen_keys = jax.random.split(k, gens_per_epoch)
-            st, _ = lax.scan(gen_step, st, gen_keys)
+            st, tr = lax.scan(gen_step, st, gen_keys)     # (gens, 2)
             st = _migrate(st, n_islands)
-            return st, st.penalty[0]
+            return st, tr
 
         epoch_keys = jax.random.split(my_key, n_epochs)
         state, trace = lax.scan(epoch, state, epoch_keys)
         global_best = lax.pmin(state.penalty[0], AXIS)
-        return state, trace[None, :], global_best
+        return state, trace[None], global_best
 
     return jax.jit(_run)

@@ -261,3 +261,57 @@ def random_instance(key_or_seed, n_events: int, n_rooms: int,
     return derive(n_events, n_rooms, n_features, n_students, room_size,
                   attends, room_features, event_features,
                   n_days=n_days, slots_per_day=slots_per_day)
+
+
+def room_tight_instance(key_or_seed, n_events: int, n_rooms: int,
+                        n_features: int, n_students: int,
+                        attend_prob: float = 0.05,
+                        feature_prob: float = 0.4,
+                        n_days: int = DAYS_DEFAULT,
+                        slots_per_day: int = SLOTS_PER_DAY_DEFAULT
+                        ) -> Problem:
+    """Room-TIGHT synthetic instance: the regime `random_instance` never
+    reaches (VERDICT round-1 weakness 8).
+
+    No universal fallback room, capacities hugging the student-count
+    distribution, sparse feature coverage — so per-slot `possible[]` rows
+    are small and unevenly overlapping, which is exactly where greedy
+    matching can lose to the reference's exact per-slot max matching
+    (Solution.cpp:836-891). Events with an empty possible[] row are
+    repaired minimally (their cheapest room is upgraded), keeping every
+    event placeable somewhere but nothing placeable everywhere — the
+    character of the ITC-2002 comp instances (each event has >= 1
+    suitable room, median 2-5)."""
+    rng = np.random.default_rng(key_or_seed)
+    attends = (rng.random((n_students, n_events))
+               < attend_prob).astype(np.int8)
+    event_features = (rng.random((n_events, n_features))
+                      < feature_prob).astype(np.int8)
+    # sparse room features: ~40% coverage, NO universal room
+    room_features = (rng.random((n_rooms, n_features)) < 0.4).astype(np.int8)
+    student_count = attends.astype(np.int64).sum(axis=0).astype(np.int32)
+    # capacities drawn FROM the event-size distribution: ~half the rooms
+    # cannot host the larger half of events
+    sizes = np.sort(student_count)
+    picks = rng.integers(0, max(n_events, 1), size=n_rooms)
+    room_size = np.maximum(sizes[picks], 1).astype(np.int32)
+
+    # minimal repair: every event must have >= 1 suitable room (the
+    # reference assumes this too — an event with no possible room makes
+    # every solution infeasible)
+    for _ in range(n_features + 1):
+        p = derive(n_events, n_rooms, n_features, n_students, room_size,
+                   attends, room_features, event_features,
+                   n_days=n_days, slots_per_day=slots_per_day)
+        orphan = np.nonzero(~p.possible.any(axis=1))[0]
+        if orphan.size == 0:
+            return p
+        for e in orphan:
+            # upgrade the room needing the fewest changes for this event
+            need = event_features[e].astype(bool)
+            deficit = ((need & ~room_features.astype(bool)).sum(axis=1)
+                       + (room_size < student_count[e]) * 1)
+            r = int(np.argmin(deficit))
+            room_features[r][need] = 1
+            room_size[r] = max(room_size[r], student_count[e])
+    return p

@@ -18,23 +18,37 @@ import numpy as np
 
 from timetabling_ga_tpu.ops import ga
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
 
 
-def config_fingerprint(problem, cfg) -> str:
-    """Cheap compatibility stamp: shapes + breeding params. A checkpoint
-    from a different instance or GA config refuses to load."""
+def config_fingerprint(problem, cfg, n_islands: int) -> str:
+    """Cheap compatibility stamp: shapes + breeding params + island
+    layout. A checkpoint from a different instance, GA config, or island
+    count refuses to load — the saved PopState's global shape is
+    n_islands * pop_size, so a mismatched --islands resume would
+    otherwise mis-assign rows to islands deep inside jit.
+
+    The SEED is deliberately not part of the fingerprint (the default
+    seed is time()-derived, so it would make every default resume fail);
+    it is stored as checkpoint metadata instead, and the engine refuses
+    only an EXPLICIT conflicting -s."""
     return (f"v{FORMAT_VERSION}"
             f"|E{problem.n_events}R{problem.n_rooms}S{problem.n_students}"
             f"T{problem.n_days * problem.slots_per_day}"
             f"|P{cfg.pop_size}k{cfg.tournament_k}"
             f"x{cfg.p_crossover}m{cfg.p_mutation}"
-            f"|ls{cfg.ls_steps}c{cfg.ls_candidates}")
+            f"|ls{cfg.ls_steps}c{cfg.ls_candidates}o{cfg.ls_mode}"
+            f"|I{n_islands}")
 
 
 def save(path: str, state: ga.PopState, key, generation: int,
-         fingerprint: str) -> None:
-    """Atomic snapshot (write temp + rename, like any sane checkpointer)."""
+         fingerprint: str, best_seen=None, seed: int = None) -> None:
+    """Atomic snapshot (write temp + rename, like any sane checkpointer).
+
+    `best_seen` is the per-island best reported value already emitted to
+    the JSONL stream; persisting it keeps the logEntry stream monotone
+    across a resume (a fresh INT_MAX would re-emit pre-crash bests).
+    `seed` is metadata for the engine's explicit-mismatch check."""
     arrays = {
         "slots": np.asarray(state.slots),
         "rooms": np.asarray(state.rooms),
@@ -45,6 +59,10 @@ def save(path: str, state: ga.PopState, key, generation: int,
         "generation": np.asarray(generation),
         "fingerprint": np.asarray(fingerprint),
     }
+    if best_seen is not None:
+        arrays["best_seen"] = np.asarray(best_seen, dtype=np.int64)
+    if seed is not None:
+        arrays["seed"] = np.asarray(seed, dtype=np.int64)
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
@@ -59,13 +77,15 @@ def save(path: str, state: ga.PopState, key, generation: int,
 
 
 def load(path: str, fingerprint: str):
-    """Restore (state, key, generation); raises on fingerprint mismatch."""
+    """Restore (state, key, generation, best_seen); raises on fingerprint
+    mismatch. best_seen is None for pre-v2 checkpoints."""
     with np.load(path, allow_pickle=False) as z:
         found = str(z["fingerprint"])
         if found != fingerprint:
             raise ValueError(
                 f"checkpoint fingerprint mismatch: {found!r} != "
-                f"{fingerprint!r} — different instance or GA config")
+                f"{fingerprint!r} — different instance, GA config, "
+                f"island count, or seed")
         state = ga.PopState(
             slots=np.array(z["slots"]),
             rooms=np.array(z["rooms"]),
@@ -75,4 +95,7 @@ def load(path: str, fingerprint: str):
         )
         key = jax.random.wrap_key_data(np.array(z["key"]))
         generation = int(z["generation"])
-    return state, key, generation
+        best_seen = (np.array(z["best_seen"]).tolist()
+                     if "best_seen" in z else None)
+        seed = int(z["seed"]) if "seed" in z else None
+    return state, key, generation, best_seen, seed

@@ -15,8 +15,10 @@ warn-on-default behavior:
     -p <int>    problem type 1/2/3, default 1 (Control.cpp:72-78); sets
                 the local-search budget 200/1000/2000 (ga.cpp:389-397)
     -m <int>    explicit LS maxSteps override (Control.cpp:83-89)
-    -l <secs>   LS time limit (Control.cpp:93-99) — accepted, unused
-                (fixed-shape search has no data-dependent timeout)
+    -l <secs>   LS time limit (Control.cpp:93-99) — RETIRED with a
+                warning: the fixed-shape batched LS is bounded by -m
+                (candidate-evaluation count) deterministically, where the
+                reference's bound was temporal (Solution.cpp:499)
     -p1/-p2/-p3 move-type probabilities, default 1.0/1.0/0.0
                 (Control.cpp:103-125)
     -s <int>    seed, default time() (Control.cpp:129-136)
@@ -30,10 +32,20 @@ TPU-specific extensions (SURVEY section 7.6):
                           ga.cpp:510)
     --migration-period <int>  generations between migrations (reference:
                           every 100 local periods, ga.cpp:514)
-    --ls-candidates <int> candidate moves per LS round
+    --ls-candidates <int> candidate moves per LS round (random mode)
+    --ls-mode {random,sweep}  K-random candidates per round, or the
+                          systematic all-slots Move1 + Move2-block sweep
+                          (ops/sweep.py, Solution.cpp:508-561 analogue)
+    --ls-sweeps <int>     full sweep passes per generation (sweep mode)
+    --ls-swap-block <int> Move2 partners per event per pass (sweep mode)
     --checkpoint <path>   checkpoint file (npz); enables save/resume
     --checkpoint-every <int>  epochs between checkpoints
     --resume              resume from --checkpoint if it exists
+    --epochs-per-dispatch <int>  epochs fused into one device dispatch
+                          (amortizes dispatch latency; time-limit checks
+                          happen between dispatches)
+    --trace               emit {"phase": ...} timing records (extension;
+                          the reference's 3 record types are unchanged)
 """
 
 from __future__ import annotations
@@ -64,11 +76,17 @@ class RunConfig:
     generations: int = 2001
     migration_period: int = 100
     ls_candidates: int = 8
+    ls_mode: str = "random"   # "random" K-candidate | "sweep" systematic
+    ls_sweeps: int = 1
+    ls_swap_block: int = 8
+    rooms_mode: str = "scan"  # "scan" E-deep | "parallel" O(1)-depth
     checkpoint: Optional[str] = None
     checkpoint_every: int = 1
     resume: bool = False
     nsga2: bool = False       # NSGA-II (hcv, scv) replacement stage
     ls_full_eval: bool = False  # disable delta evaluation (debugging)
+    epochs_per_dispatch: int = 1  # epochs fused into one device dispatch
+    trace: bool = False       # emit {"phase": ...} timing JSONL records
 
     def resolved_seed(self) -> int:
         # reference default: time(NULL) (Control.cpp:129-136)
@@ -100,12 +118,17 @@ _FLAG_MAP = {
     "--generations": ("generations", int),
     "--migration-period": ("migration_period", int),
     "--ls-candidates": ("ls_candidates", int),
+    "--ls-mode": ("ls_mode", str),
+    "--ls-sweeps": ("ls_sweeps", int),
+    "--ls-swap-block": ("ls_swap_block", int),
+    "--rooms-mode": ("rooms_mode", str),
     "--checkpoint": ("checkpoint", str),
     "--checkpoint-every": ("checkpoint_every", int),
+    "--epochs-per-dispatch": ("epochs_per_dispatch", int),
 }
 
 _BOOL_FLAGS = {"--resume": "resume", "--nsga2": "nsga2",
-               "--ls-full-eval": "ls_full_eval"}
+               "--ls-full-eval": "ls_full_eval", "--trace": "trace"}
 
 
 def parse_args(argv) -> RunConfig:
@@ -132,4 +155,8 @@ def parse_args(argv) -> RunConfig:
         raise SystemExit("No instance file specified, use -i <file>")
     if cfg.backend not in ("tpu", "cpu"):
         raise SystemExit(f"unknown backend: {cfg.backend}")
+    if cfg.ls_mode not in ("random", "sweep"):
+        raise SystemExit(f"unknown ls-mode: {cfg.ls_mode}")
+    if cfg.rooms_mode not in ("scan", "parallel"):
+        raise SystemExit(f"unknown rooms-mode: {cfg.rooms_mode}")
     return cfg

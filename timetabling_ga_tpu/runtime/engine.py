@@ -2,11 +2,30 @@
 
 The TPU-native re-design of ga.cpp main() (ga.cpp:370-613). Where the
 reference interleaves MPI bootstrap, OpenMP breeding loops and ad-hoc
-logging in one function, the engine is a host loop over *epochs*: each
-epoch is one fully on-device dispatch (migration_period generations on
-every island + ring migration, see parallel/islands.py), after which the
-host reads back per-island bests to drive the JSONL protocol, the wall
-clock bound (-t, Control.cpp:62-68), and checkpointing.
+logging in one function, the engine is a host loop over *dispatches*: each
+dispatch is one fully on-device jit call covering one or more epochs
+(migration_period generations per island + ring migration each, see
+parallel/islands.py). The runner returns a per-GENERATION (hcv, scv) best
+trace per island, so the JSONL logEntry protocol sees every mid-epoch
+improvement (ga.cpp:203-228 granularity) while the host reads back exactly
+one array per dispatch — no per-epoch scalar fetches (they cost seconds on
+tunneled devices; BASELINE.md methodology note).
+
+Timing semantics (Control/Timer parity):
+  - the wall-clock bound -t applies per try, reset at the top of each
+    trial (beginTry/resetTime, ga.cpp:163-167; Control.cpp:62-68);
+  - the generation budget is exact: the final dispatch is clamped to the
+    remaining generations instead of overshooting to a multiple of
+    migration_period;
+  - logEntry times are interpolated linearly across a dispatch's wall
+    time (generations inside one dispatch are not individually host-
+    timestampable; the interpolation error is bounded by one dispatch).
+
+Observability (--trace, SURVEY section 5): per-phase host timings
+(init / dispatch / fetch / checkpoint) bracketed by block_until_ready are
+emitted as {"phase": ...} JSONL records — an extension record type; the
+reference protocol's three record types are unchanged and remain
+byte-compatible.
 """
 
 from __future__ import annotations
@@ -41,6 +60,9 @@ def build_ga_config(cfg: RunConfig) -> ga.GAConfig:
         p1=cfg.p1, p2=cfg.p2, p3=cfg.p3,
         ls_steps=ls_rounds, ls_candidates=cfg.ls_candidates,
         ls_delta=not cfg.ls_full_eval,
+        ls_mode=cfg.ls_mode, ls_sweeps=cfg.ls_sweeps,
+        ls_swap_block=cfg.ls_swap_block,
+        rooms_mode=cfg.rooms_mode,
         multi_objective=cfg.nsga2,
     )
 
@@ -51,9 +73,16 @@ def run(cfg: RunConfig, out=None) -> int:
     Returns the global best reported evaluation (scv if feasible else
     hcv*1e6+scv), the quantity the reference's runEntry reports.
     """
-    t_start = time.monotonic()
     if cfg.backend == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    if cfg.ls_time_limit != 99999.0:
+        # -l is formally retired on this path: the fixed-shape batched LS
+        # is bounded by candidate count (-m maxSteps), not wall clock —
+        # a deterministic budget where the reference's was temporal
+        # (Solution.cpp:499). Warn instead of silently ignoring.
+        print("warning: -l (LS time limit) is retired on the TPU path; "
+              "the local search is bounded by -m (maxSteps) candidate "
+              "evaluations instead", file=sys.stderr)
 
     close_out = False
     if out is None:
@@ -64,13 +93,20 @@ def run(cfg: RunConfig, out=None) -> int:
             out = sys.stdout
 
     try:
-        return _run_tries(cfg, out, t_start)
+        return _run_tries(cfg, out)
     finally:
         if close_out:
             out.close()
 
 
-def _run_tries(cfg: RunConfig, out, t_start: float) -> int:
+def _phase(out, enabled: bool, name: str, trial: int, seconds: float,
+           **extra) -> None:
+    if enabled:
+        jsonl.phase_record(out, name, trial, seconds, **extra)
+
+
+def _run_tries(cfg: RunConfig, out) -> int:
+    t0 = time.monotonic()
     problem = load_tim_file(cfg.input)
     pa = problem.device_arrays()
 
@@ -85,60 +121,107 @@ def _run_tries(cfg: RunConfig, out, t_start: float) -> int:
 
     gacfg = build_ga_config(cfg)
     seed = cfg.resolved_seed()
-    fingerprint = ckpt.config_fingerprint(problem, gacfg)
+    fingerprint = ckpt.config_fingerprint(problem, gacfg, n_islands)
+    _phase(out, cfg.trace, "load", 0, time.monotonic() - t0)
 
-    runner = islands.make_island_runner(
-        mesh, gacfg, n_epochs=1, gens_per_epoch=cfg.migration_period)
+    # Runners are cached per (n_epochs, gens) shape; the clamped final
+    # dispatch compiles its own (1, remainder) program only when the
+    # budget is not a multiple of migration_period.
+    runners = {}
+
+    def get_runner(n_epochs: int, gens: int):
+        k = (n_epochs, gens)
+        if k not in runners:
+            runners[k] = islands.make_island_runner(
+                mesh, gacfg, n_epochs=n_epochs, gens_per_epoch=gens)
+        return runners[k]
 
     global_best = INT_MAX
     # The reference's try loop is legacy Control behavior (Control.cpp:
     # 188-246) unused by the MPI binary; we honor -n but default it to 1.
     for trial in range(cfg.tries):
+        t_try = time.monotonic()   # per-try clock (beginTry, ga.cpp:163)
         key = jax.random.key(seed + trial)
         k_init, key = jax.random.split(key)
 
         gens_done = 0
+        best_seen = None
         state = None
         if cfg.resume and cfg.checkpoint:
             try:
-                state, key, gens_done = ckpt.load(cfg.checkpoint,
-                                                  fingerprint)
+                state, key, gens_done, best_seen, saved_seed = ckpt.load(
+                    cfg.checkpoint, fingerprint)
+                if saved_seed is not None:
+                    if cfg.seed is not None and cfg.seed != saved_seed:
+                        raise ValueError(
+                            f"checkpoint was written with seed "
+                            f"{saved_seed}, but -s {cfg.seed} given — "
+                            f"refusing to mix RNG streams")
+                    seed = saved_seed   # default seed adopts the saved one
             except FileNotFoundError:
                 state = None
         if state is None:
+            t = time.monotonic()
             state = islands.init_island_population(
                 pa, k_init, mesh, cfg.pop_size)
+            jax.block_until_ready(state)
+            _phase(out, cfg.trace, "init", trial, time.monotonic() - t)
+        if best_seen is None:
+            best_seen = [INT_MAX] * n_islands
 
-        best_seen = [INT_MAX] * n_islands
-        epoch = 0
+        epochs_done = 0
+        epochs_at_ckpt = 0
         while gens_done < cfg.generations:
-            if time.monotonic() - t_start > cfg.time_limit:
+            if time.monotonic() - t_try > cfg.time_limit:
                 break
+            remaining = cfg.generations - gens_done
+            if remaining >= cfg.migration_period:
+                n_ep = max(1, min(cfg.epochs_per_dispatch,
+                                  remaining // cfg.migration_period))
+                gens = cfg.migration_period
+            else:
+                n_ep, gens = 1, remaining      # clamped final dispatch
+            runner = get_runner(n_ep, gens)
+
             key, k_epoch = jax.random.split(key)
-            state, _trace, _gbest = runner(pa, k_epoch, state)
-            gens_done += cfg.migration_period
-            epoch += 1
+            td0 = time.monotonic()
+            state, trace, _gbest = runner(pa, k_epoch, state)
+            trace = np.asarray(trace)          # blocks on the dispatch
+            td1 = time.monotonic()
+            _phase(out, cfg.trace, "dispatch", trial, td1 - td0,
+                   epochs=n_ep, gens=n_ep * gens)
+            gens_done += n_ep * gens
+            epochs_done += n_ep
 
-            hcv = np.asarray(state.hcv).reshape(n_islands, -1)[:, 0]
-            scv = np.asarray(state.scv).reshape(n_islands, -1)[:, 0]
-            now = time.monotonic() - t_start
+            # per-generation logEntry emission from the device-side trace
+            flat = trace.reshape(n_islands, n_ep * gens, 2)
+            total = n_ep * gens
             for i in range(n_islands):
-                rep = jsonl.reported_best(hcv[i], scv[i])
-                if rep < best_seen[i]:
-                    best_seen[i] = rep
-                    jsonl.log_entry(out, i, 0, rep, now)
+                for g in range(total):
+                    rep = jsonl.reported_best(flat[i, g, 0], flat[i, g, 1])
+                    if rep < best_seen[i]:
+                        best_seen[i] = rep
+                        tg = (td0 - t_try) + (g + 1) / total * (td1 - td0)
+                        jsonl.log_entry(out, i, 0, rep, tg)
 
-            if cfg.checkpoint and epoch % cfg.checkpoint_every == 0:
+            if (cfg.checkpoint
+                    and epochs_done - epochs_at_ckpt >= cfg.checkpoint_every):
+                t = time.monotonic()
                 ckpt.save(cfg.checkpoint, state, key, gens_done,
-                          fingerprint)
+                          fingerprint, best_seen, seed)
+                epochs_at_ckpt = epochs_done
+                _phase(out, cfg.trace, "checkpoint", trial,
+                       time.monotonic() - t)
 
         # final per-island solution records (endTry, ga.cpp:169-197)
+        t = time.monotonic()
         P = cfg.pop_size
         slots = np.asarray(state.slots).reshape(n_islands, P, -1)
         rooms = np.asarray(state.rooms).reshape(n_islands, P, -1)
         hcv = np.asarray(state.hcv).reshape(n_islands, P)[:, 0]
         scv = np.asarray(state.scv).reshape(n_islands, P)[:, 0]
-        total_time = time.monotonic() - t_start
+        _phase(out, cfg.trace, "fetch", trial, time.monotonic() - t)
+        total_time = time.monotonic() - t_try
         for i in range(n_islands):
             feas = hcv[i] == 0
             rep = jsonl.reported_best(hcv[i], scv[i])

@@ -64,6 +64,20 @@ def solution_record(stream: IO, proc_id: int, thread_id: int,
     _write(stream, {"solution": rec})
 
 
+def phase_record(stream: IO, name: str, trial: int, seconds: float,
+                 **extra) -> None:
+    """Observability EXTENSION record (not in the reference protocol;
+    emitted only under --trace): per-phase host timing bracketed by
+    block_until_ready — the TPU-native stand-in for the reference's
+    Timer instrumentation (Timer.C:36-49) and the MPE trace hook it
+    never enabled (Makefile:3)."""
+    rec = {"name": name, "trial": int(trial),
+           "seconds": float(seconds)}
+    for k, v in extra.items():
+        rec[k] = v
+    _write(stream, {"phase": rec})
+
+
 def run_entry(stream: IO, total_best: int, feasible: bool,
               procs_num: Optional[int] = None,
               threads_num: Optional[int] = None,

@@ -1,0 +1,142 @@
+"""Head-to-head solution-quality race: TPU engine vs the
+reference-faithful CPU baseline at fixed wall clock (VERDICT round-1
+item 1 — the capability claim).
+
+Baseline: `tt_cpu --algo reference` (native/timetabling_native.cpp) —
+steady-state pop-10 GA with the reference's exhaustive first-improvement
+sweep LS and exact per-slot maximum matching, at full host cores.
+
+Contender: the TPU engine (runtime/engine.py) with the batched sweep LS.
+
+Both sides get the same instances (ITC-2002-scale synthetics, regular
+AND room-tight) and the same wall-clock budget; jit compilation is
+warmed out of the budget first (the reference binary is also "compiled"
+ahead of time). Output: one result JSON per race on stdout plus a
+markdown table on stderr, for BASELINE.md.
+
+Usage: python tools/quality_race.py [--budget SECONDS] [--quick]
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+TT_CPU = os.path.join(REPO, "native", "tt_cpu")
+
+
+def make_instances(quick: bool):
+    from timetabling_ga_tpu.problem import (
+        random_instance, room_tight_instance)
+    specs = [
+        # name, generator, E, R, S, attend_prob
+        ("small", random_instance, 100, 5, 80, 0.05),
+        ("small-tight", room_tight_instance, 100, 5, 80, 0.05),
+        ("medium", random_instance, 400, 10, 200, 0.02),
+        ("medium-tight", room_tight_instance, 400, 10, 200, 0.02),
+    ]
+    if quick:
+        specs = specs[:2]
+    out = []
+    for name, gen, E, R, S, ap in specs:
+        out.append((name, gen(101, n_events=E, n_rooms=R, n_features=5,
+                              n_students=S, attend_prob=ap)))
+    return out
+
+
+def run_cpu_baseline(tim_path: str, budget: float, seed: int) -> dict:
+    threads = os.cpu_count() or 1
+    t0 = time.perf_counter()
+    out = subprocess.run(
+        [TT_CPU, "-i", tim_path, "-s", str(seed), "-c", str(threads),
+         "-t", str(budget), "--algo", "reference",
+         "--generations", "1000000"],
+        capture_output=True, text=True, timeout=budget * 3 + 120,
+        check=True)
+    dt = time.perf_counter() - t0
+    lines = [json.loads(x) for x in out.stdout.splitlines()]
+    run_entries = [x["runEntry"] for x in lines if "runEntry" in x]
+    feas_time = None
+    for x in lines:
+        if "logEntry" in x and x["logEntry"]["best"] < 1_000_000:
+            feas_time = x["logEntry"]["time"]
+            break
+    return {"best": run_entries[-1]["totalBest"],
+            "feasible": run_entries[-1]["feasible"],
+            "time_to_feasible_s": feas_time,
+            "wall_s": round(dt, 1), "threads": threads}
+
+
+def run_tpu(problem, tim_path: str, budget: float, seed: int,
+            pop: int, ls_mode: str) -> dict:
+    import jax
+    from timetabling_ga_tpu.runtime.config import RunConfig
+    from timetabling_ga_tpu.runtime import engine
+
+    cfg = RunConfig(input=tim_path, seed=seed, pop_size=pop, islands=1,
+                    generations=10 ** 9, migration_period=10,
+                    time_limit=budget, ls_mode=ls_mode, ls_sweeps=1,
+                    max_steps=200, epochs_per_dispatch=1)
+    # warm the jit cache outside the budget (one epoch on same shapes)
+    warm_cfg = RunConfig(**{**cfg.__dict__, "generations": 10,
+                            "time_limit": 10 ** 6})
+    engine.run(warm_cfg, out=io.StringIO())
+
+    buf = io.StringIO()
+    t0 = time.perf_counter()
+    best = engine.run(cfg, out=buf)
+    dt = time.perf_counter() - t0
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    feas_time = None
+    for x in lines:
+        if "logEntry" in x and x["logEntry"]["best"] < 1_000_000:
+            feas_time = x["logEntry"]["time"]
+            break
+    return {"best": best, "feasible": best < 1_000_000,
+            "time_to_feasible_s": feas_time, "wall_s": round(dt, 1),
+            "pop": pop, "ls_mode": ls_mode}
+
+
+def main():
+    from timetabling_ga_tpu.problem import dump_tim
+    budget = 60.0
+    quick = "--quick" in sys.argv
+    if "--budget" in sys.argv:
+        budget = float(sys.argv[sys.argv.index("--budget") + 1])
+
+    rows = []
+    for name, problem in make_instances(quick):
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".tim", delete=False) as fh:
+            fh.write(dump_tim(problem))
+            tim_path = fh.name
+        cpu = run_cpu_baseline(tim_path, budget, seed=42)
+        tpu = run_tpu(problem, tim_path, budget, seed=42,
+                      pop=2048, ls_mode="sweep")
+        row = {"instance": name, "budget_s": budget, "cpu": cpu,
+               "tpu": tpu,
+               "tpu_wins": tpu["best"] <= cpu["best"]}
+        rows.append(row)
+        print(json.dumps(row))
+        os.unlink(tim_path)
+
+    print("\n| instance | budget | CPU ref best | TPU best | "
+          "CPU t-to-feas | TPU t-to-feas | winner |", file=sys.stderr)
+    print("|---|---|---|---|---|---|---|", file=sys.stderr)
+    for r in rows:
+        print(f"| {r['instance']} | {r['budget_s']:.0f}s | "
+              f"{r['cpu']['best']} | {r['tpu']['best']} | "
+              f"{r['cpu']['time_to_feasible_s']} | "
+              f"{r['tpu']['time_to_feasible_s']} | "
+              f"{'TPU' if r['tpu_wins'] else 'CPU'} |", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
