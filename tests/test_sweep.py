@@ -60,7 +60,9 @@ def test_sweep_pass_state_consistent(inst):
     problem, pa = inst
     slots, rooms = _rand_pop(pa, jax.random.key(1), 8)
     st = init_state(pa, slots, rooms)
-    st = sweep.sweep_pass(pa, jax.random.key(2), st, swap_block=4)
+    st, improved = sweep.sweep_pass(pa, jax.random.key(2), st,
+                                    swap_block=4)
+    assert bool(improved)   # a random population always has a move
     pen, hcv, scv = fitness.batch_penalty(pa, st.slots, st.rooms)
     np.testing.assert_array_equal(np.asarray(st.hcv), np.asarray(hcv))
     np.testing.assert_array_equal(np.asarray(st.scv), np.asarray(scv))
@@ -84,6 +86,27 @@ def test_sweep_monotone_improvement(inst):
     # invariant: each event still has exactly one slot/room assignment
     assert s1.shape == slots.shape and r1.shape == rooms.shape
     assert (np.asarray(s1) >= 0).all() and (np.asarray(s1) < pa.n_slots).all()
+
+
+def test_sweep_converge_reaches_local_optimum(inst):
+    """converge=True must run passes until the WHOLE population is at a
+    Move1+Move2-block local optimum (the reference's stopping rule): one
+    more pass on the result accepts nothing."""
+    problem, pa = inst
+    slots, rooms = _rand_pop(pa, jax.random.key(7), 6)
+    s_c, r_c = sweep.sweep_local_search(pa, jax.random.key(8), slots,
+                                        rooms, n_sweeps=50, swap_block=4,
+                                        converge=True)
+    st = init_state(pa, s_c, r_c)
+    # the post-convergence pass must find nothing, under ANY shuffle key
+    _, improved = sweep.sweep_pass(pa, jax.random.key(9), st, swap_block=0)
+    assert not bool(improved)
+    # and it must be at least as good as a fixed 3-pass budget
+    pen_c, _, _ = fitness.batch_penalty(pa, s_c, r_c)
+    s_f, r_f = sweep.sweep_local_search(pa, jax.random.key(8), slots,
+                                        rooms, n_sweeps=3, swap_block=4)
+    pen_f, _, _ = fitness.batch_penalty(pa, s_f, r_f)
+    assert np.asarray(pen_c).mean() <= np.asarray(pen_f).mean()
 
 
 def test_sweep_beats_random_candidates_at_equal_depth(inst):

@@ -151,6 +151,10 @@ def augment_rooms(pa, slots: jnp.ndarray, rooms_arr: jnp.ndarray,
     """
     E, R = pa.possible.shape
     T = pa.n_slots
+    # Same key-packing bounds as assign_rooms: the parking keys below
+    # pack (occupancy, unsuit flag, cap_rank) into one int32, so R must
+    # stay under the unsuit bit field or preference order inverts.
+    assert E < 4096 and R < _W_UNSUIT, (E, R)
     if cap_rank is None:
         cap_rank = capacity_rank(pa)
     ev = jnp.arange(E, dtype=jnp.int32)

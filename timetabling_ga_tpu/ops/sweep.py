@@ -141,8 +141,15 @@ def _distinct_pad(e1, e2, E: int):
     return jnp.where(pad == e2, (e1 + 2) % E, pad)
 
 
-def sweep_pass(pa, key, state: LSState, swap_block: int = 8) -> LSState:
-    """One full sweep pass over all events (shuffled per individual)."""
+def sweep_pass(pa, key, state: LSState, swap_block: int = 8):
+    """One full sweep pass over all events (shuffled per individual).
+
+    Returns (state, improved) where `improved` is a scalar bool: did ANY
+    individual accept ANY move this pass. A False means the entire
+    population is at a Move1+Move2-block local optimum, the same
+    fixed-point condition that ends the reference's localSearch (a full
+    improving-free pass over all events, Solution.cpp:497-618 counter
+    semantics)."""
     cap_rank = capacity_rank(pa)
     P, E = state.slots.shape
     T = pa.n_slots
@@ -231,33 +238,60 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8) -> LSState:
             pen=jnp.where(better, best_pen, st.pen),
             hcv=jnp.where(better, new_hcv[ar, best], st.hcv),
             scv=jnp.where(better, new_scv[ar, best], st.scv))
-        return st, None
+        return st, better.any()
 
-    state, _ = lax.scan(step, state, jnp.arange(E))
-    return state
+    state, accepted = lax.scan(step, state, jnp.arange(E))
+    return state, accepted.any()
 
 
 def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
-                       swap_block: int = 8):
-    """Run `n_sweeps` full sweep passes over a (P, E) population.
+                       swap_block: int = 8, converge: bool = False):
+    """Run up to `n_sweeps` full sweep passes over a (P, E) population.
 
     Candidate budget per pass per individual: E * (T + swap_block)
     delta evaluations — the full Move1 neighborhood plus a rotating
     Move2 block, vs the reference's identical per-pass Move1 coverage
     (Solution.cpp:508-534) and full Move2 coverage (535-561).
+
+    converge=True runs passes under a bounded `lax.while_loop` that
+    exits early once a whole pass accepts no move anywhere in the
+    population — the reference's run-to-local-optimum stopping rule
+    (its pass counter resets on every improvement and the search ends
+    after one improving-free pass, Solution.cpp:524, 653), with
+    `n_sweeps` as the hard pass bound standing in for maxSteps.
     """
     state = init_state(pa, slots, rooms_arr)
 
-    def one(st, k):
-        return sweep_pass(pa, k, st, swap_block), None
+    # Both modes draw pass i's shuffle key as fold_in(key, i), so a
+    # converge=True run and a fixed-pass run with the same key follow
+    # IDENTICAL trajectories for their shared prefix of passes — the
+    # converged result is then provably <= any fixed-budget result.
+    if converge:
+        def cond(carry):
+            _, i, improved = carry
+            return (i < n_sweeps) & improved
 
-    keys = jax.random.split(key, n_sweeps)
-    state, _ = lax.scan(one, state, keys)
+        def body(carry):
+            st, i, _ = carry
+            st, improved = sweep_pass(pa, jax.random.fold_in(key, i), st,
+                                      swap_block)
+            return st, i + 1, improved
+
+        state, _, _ = lax.while_loop(
+            cond, body, (state, jnp.int32(0), jnp.bool_(True)))
+    else:
+        def one(st, i):
+            st, _ = sweep_pass(pa, jax.random.fold_in(key, i), st,
+                               swap_block)
+            return st, None
+
+        state, _ = lax.scan(one, state, jnp.arange(n_sweeps))
     return state.slots, state.rooms
 
 
-@functools.partial(jax.jit, static_argnames=("n_sweeps", "swap_block"))
+@functools.partial(jax.jit,
+                   static_argnames=("n_sweeps", "swap_block", "converge"))
 def jit_sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
-                           swap_block: int = 8):
+                           swap_block: int = 8, converge: bool = False):
     return sweep_local_search(pa, key, slots, rooms_arr, n_sweeps,
-                              swap_block)
+                              swap_block, converge)

@@ -56,14 +56,16 @@ def make_mesh(n_islands: int = None, devices=None) -> Mesh:
     return Mesh(np.array(devices), (AXIS,))
 
 
-def init_island_population(pa, key, mesh: Mesh,
-                           pop_size: int) -> ga.PopState:
+def init_island_population(pa, key, mesh: Mesh, pop_size: int,
+                           cfg: ga.GAConfig = None) -> ga.PopState:
     """Initialize every island's population directly on its own device.
 
     Global state shape is (n_islands * pop_size, E) sharded along axis 0;
     each island draws from `fold_in(key, island_index)` so populations are
     independent (divergence from the reference's broadcast-identical
-    initial populations, ga.cpp:429-444; SURVEY C17)."""
+    initial populations, ga.cpp:429-444; SURVEY C17). When
+    `cfg.init_sweeps > 0` the initial populations are sweep-LS-polished
+    on-device (the reference's initial localSearch, ga.cpp:429-434)."""
     n_islands = mesh.devices.size
 
     @functools.partial(
@@ -77,7 +79,7 @@ def init_island_population(pa, key, mesh: Mesh,
         check_vma=False)
     def _init(pa_, key_):
         k = jax.random.fold_in(key_, lax.axis_index(AXIS))
-        return ga.init_population(pa_, k, pop_size)
+        return ga.init_population(pa_, k, pop_size, cfg)
 
     return _init(pa, key)
 

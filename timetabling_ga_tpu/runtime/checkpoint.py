@@ -38,6 +38,9 @@ def config_fingerprint(problem, cfg, n_islands: int) -> str:
             f"|P{cfg.pop_size}k{cfg.tournament_k}"
             f"x{cfg.p_crossover}m{cfg.p_mutation}"
             f"|ls{cfg.ls_steps}c{cfg.ls_candidates}o{cfg.ls_mode}"
+            f"w{cfg.ls_sweeps}b{cfg.ls_swap_block}"
+            f"g{int(cfg.ls_converge)}i{cfg.init_sweeps}"
+            f"r{cfg.rooms_mode}"
             f"|I{n_islands}")
 
 

@@ -79,6 +79,11 @@ class RunConfig:
     ls_mode: str = "random"   # "random" K-candidate | "sweep" systematic
     ls_sweeps: int = 1
     ls_swap_block: int = 8
+    ls_converge: bool = False  # sweep LS early-exits at the population-
+    #                            wide local optimum (reference stopping
+    #                            rule); ls_sweeps becomes the hard bound
+    init_sweeps: int = 0      # sweep-to-convergence passes on the initial
+    #                           population (ga.cpp:429-434 analogue)
     rooms_mode: str = "scan"  # "scan" E-deep | "parallel" O(1)-depth
     checkpoint: Optional[str] = None
     checkpoint_every: int = 1
@@ -121,6 +126,7 @@ _FLAG_MAP = {
     "--ls-mode": ("ls_mode", str),
     "--ls-sweeps": ("ls_sweeps", int),
     "--ls-swap-block": ("ls_swap_block", int),
+    "--init-sweeps": ("init_sweeps", int),
     "--rooms-mode": ("rooms_mode", str),
     "--checkpoint": ("checkpoint", str),
     "--checkpoint-every": ("checkpoint_every", int),
@@ -128,7 +134,8 @@ _FLAG_MAP = {
 }
 
 _BOOL_FLAGS = {"--resume": "resume", "--nsga2": "nsga2",
-               "--ls-full-eval": "ls_full_eval", "--trace": "trace"}
+               "--ls-full-eval": "ls_full_eval", "--trace": "trace",
+               "--ls-converge": "ls_converge"}
 
 
 def parse_args(argv) -> RunConfig:
