@@ -112,3 +112,47 @@ def test_island_run_and_global_best(island_setup, mesh):
     # evolution improved or held the best penalty on every island
     pen0 = np.asarray(state.penalty).reshape(N_ISLANDS, POP)
     assert (pen[:, 0] <= pen0[:, 0]).all()
+
+
+def test_dynamic_runner_gen_count_and_sentinels(island_setup, mesh):
+    """The dynamic tail runner (islands.make_island_runner_dynamic) must
+    honor its runtime n_gens: trace rows < n_gens are real (hcv, scv)
+    pairs, rows >= n_gens stay INT_MAX sentinels, and one compiled
+    program serves different n_gens values (no recompilation)."""
+    problem, pa, state = island_setup
+    cfg = ga.GAConfig(pop_size=POP)
+    runner = islands.make_island_runner_dynamic(mesh, cfg, max_gens=10)
+    INT_MAX = 2 ** 31 - 1
+
+    st3, tr3, gb3 = runner(pa, jax.random.key(5), state, 3)
+    tr3 = np.asarray(tr3).reshape(N_ISLANDS, 10, 2)
+    assert (tr3[:, :3] < INT_MAX).all()
+    assert (tr3[:, 3:] == INT_MAX).all()
+
+    st10, tr10, gb10 = runner(pa, jax.random.key(5), state, 10)
+    tr10 = np.asarray(tr10).reshape(N_ISLANDS, 10, 2)
+    assert (tr10 < INT_MAX).all()
+    # same key, shared prefix: the first 3 generations of the n_gens=10
+    # call follow the identical trajectory as the n_gens=3 call
+    assert (tr10[:, :3] == tr3[:, :3]).all()
+    # global best is a pmin over islands of final best penalty
+    assert int(gb10) <= int(gb3)
+
+
+def test_dynamic_runner_migrates(island_setup, mesh):
+    """The tail dispatch still closes its epoch with ring migration:
+    after running it, each island's population contains a row matching
+    its neighbor's emigrant (same provenance semantics as the static
+    runner's epoch)."""
+    problem, pa, state = island_setup
+    cfg = ga.GAConfig(pop_size=POP)
+    runner = islands.make_island_runner_dynamic(mesh, cfg, max_gens=4)
+    st, _, _ = runner(pa, jax.random.key(9), state, 0)
+    # n_gens=0: no generations, only migration — state rows must be a
+    # permutation of the input rows plus immigrant copies (every row of
+    # the output exists somewhere in the input global population)
+    inp = np.asarray(state.slots).reshape(-1, problem.n_events)
+    outp = np.asarray(st.slots).reshape(-1, problem.n_events)
+    inp_set = {r.tobytes() for r in inp}
+    for r in outp:
+        assert r.tobytes() in inp_set

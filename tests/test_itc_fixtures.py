@@ -1,0 +1,76 @@
+"""ITC-2002-style fixture instances (VERDICT round-2 item 3).
+
+The repo vendors two characterized stand-ins for the competition set
+(`fixtures/comp01s.tim`, `fixtures/comp05s.tim`) built by
+`problem.itc_like_instance`, which plants a perfect solution the way the
+competition generator did (every real comp instance admits a feasible,
+scv=0 timetable). These tests pin (a) the loader parses the committed
+files (Problem.cpp:7-31 format), (b) the generator's planted witness is
+exactly zero-penalty, (c) the fixture stats stay in the published
+competition band (events 350-440, rooms 10-11, features 5-10, students
+200-350, 45 slots).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from timetabling_ga_tpu.oracle.reference_oracle import (
+    oracle_hcv, oracle_scv)
+from timetabling_ga_tpu.problem import (
+    ITC_PRESETS, itc_like_instance, load_tim_file)
+
+FIXTURES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "fixtures")
+
+
+@pytest.mark.parametrize("name", sorted(ITC_PRESETS))
+def test_fixture_parses_and_matches_preset(name):
+    p = load_tim_file(os.path.join(FIXTURES, f"{name}s.tim"))
+    want = ITC_PRESETS[name]
+    assert p.n_events == want["n_events"]
+    assert p.n_rooms == want["n_rooms"]
+    assert p.n_features == want["n_features"]
+    assert p.n_students == want["n_students"]
+    assert p.n_slots == 45
+    # competition character: every event placeable, suitability scarce
+    suit = p.possible.sum(axis=1)
+    assert suit.min() >= 1
+    assert np.median(suit) <= 6
+
+
+@pytest.mark.parametrize("name", sorted(ITC_PRESETS))
+def test_planted_solution_is_perfect(name):
+    p, slots, rooms = itc_like_instance(
+        2002 + int(name[-2:]), **ITC_PRESETS[name], return_planted=True)
+    assert oracle_hcv(p, slots, rooms) == 0
+    assert oracle_scv(p, slots, rooms) == 0
+
+
+@pytest.mark.parametrize("name", sorted(ITC_PRESETS))
+def test_planted_witness_in_committed_fixture(name):
+    """The committed fixture BYTES admit a perfect solution: the planted
+    witness is committed alongside each .tim (fixtures/*.witness.json)
+    and must evaluate to exactly zero under the reference-semantics
+    oracle on the loaded file. (Deliberately not a byte-identity check
+    against the generator: NumPy Generator streams may change across
+    feature releases, NEP 19 — the committed witness keeps the guarantee
+    pinned to the committed bytes.)"""
+    import json
+    p = load_tim_file(os.path.join(FIXTURES, f"{name}s.tim"))
+    with open(os.path.join(FIXTURES, f"{name}s.witness.json")) as fh:
+        w = json.load(fh)
+    assert oracle_hcv(p, w["slots"], w["rooms"]) == 0
+    assert oracle_scv(p, w["slots"], w["rooms"]) == 0
+
+
+def test_planted_witness_survives_sparse_cells():
+    """With far fewer events than (slot, room) cells, many usable slots
+    host no event; student patterns must still avoid single-class days
+    (the empty-slot silent-skip bug found in round-3 review)."""
+    p, slots, rooms = itc_like_instance(
+        9, n_events=100, n_rooms=10, n_features=5, n_students=50,
+        return_planted=True)
+    assert oracle_hcv(p, slots, rooms) == 0
+    assert oracle_scv(p, slots, rooms) == 0

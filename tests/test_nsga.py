@@ -168,3 +168,30 @@ def test_multi_objective_run_reaches_feasibility():
     st = ga.init_population(pa, jax.random.key(0), 32)
     st, _ = ga.run(pa, jax.random.key(1), st, cfg, 60)
     assert int(st.hcv[0]) == 0
+
+
+def test_generation_uses_crowded_parent_selection(monkeypatch):
+    """--nsga2 must wire BOTH halves of NSGA-II: front-based replacement
+    AND crowded-comparison parent selection (VERDICT round-2 item 5 —
+    crowded_tournament was dead code in round 2). Sentinel-patch the
+    parent selector: the multi-objective generation must reach it, the
+    scalar generation must not."""
+    problem = random_instance(7, n_events=12, n_rooms=4, n_features=2,
+                              n_students=8, attend_prob=0.1)
+    pa = problem.device_arrays()
+    st = ga.init_population(pa, jax.random.key(0), 8)
+
+    calls = []
+    real = nsga.crowded_tournament
+
+    def spy(key, ranks, crowd, k):
+        calls.append(1)
+        return real(key, ranks, crowd, k)
+
+    monkeypatch.setattr(nsga, "crowded_tournament", spy)
+    ga.generation(pa, jax.random.key(1), st,
+                  ga.GAConfig(pop_size=8, multi_objective=True))
+    assert calls, "multi-objective generation skipped crowded_tournament"
+    n = len(calls)
+    ga.generation(pa, jax.random.key(1), st, ga.GAConfig(pop_size=8))
+    assert len(calls) == n, "scalar generation used crowded_tournament"

@@ -132,15 +132,26 @@ def tournament(key, penalty: jnp.ndarray, k: int) -> jnp.ndarray:
     return draws[jnp.argmin(penalty[draws])]
 
 
-def _make_child(pa, key, state: PopState, cfg: GAConfig):
+def _make_child(pa, key, state: PopState, cfg: GAConfig, mo_stats=None):
     """Breed one child: 2x tournament -> crossover(p) -> mutation(p).
 
     (ga.cpp:543-571 minus the wasteful throwaway Solution allocs at
     543-548.) Returns (slots, rooms) of the child; evaluation happens
-    batched in `generation`."""
+    batched in `generation`.
+
+    `mo_stats` is None (scalar-penalty tournament, ga.cpp:129-145) or a
+    (ranks, crowding) pair: then parents are drawn by the NSGA-II
+    crowded-comparison tournament (Deb et al. 2002 pair selection with
+    front-based replacement — both halves, not just the survivor half)."""
     k_a, k_b, k_x, k_mask, k_m, k_mv = jax.random.split(key, 6)
-    ia = tournament(k_a, state.penalty, cfg.tournament_k)
-    ib = tournament(k_b, state.penalty, cfg.tournament_k)
+    if mo_stats is not None:
+        from timetabling_ga_tpu.ops import nsga
+        ranks, crowd = mo_stats
+        ia = nsga.crowded_tournament(k_a, ranks, crowd, cfg.tournament_k)
+        ib = nsga.crowded_tournament(k_b, ranks, crowd, cfg.tournament_k)
+    else:
+        ia = tournament(k_a, state.penalty, cfg.tournament_k)
+        ib = tournament(k_b, state.penalty, cfg.tournament_k)
     s_a, r_a = state.slots[ia], state.rooms[ia]
     s_b = state.slots[ib]
 
@@ -173,8 +184,16 @@ def generation(pa, key, state: PopState, cfg: GAConfig) -> PopState:
     """One generation: breed P children in a single vmapped batch, then
     mu+lambda truncation over parents+children."""
     keys = jax.random.split(key, cfg.pop_size)
+    mo_stats = None
+    if cfg.multi_objective:
+        # ranks/crowding computed ONCE per generation, shared by all
+        # parent draws (the population is immutable within a generation)
+        from timetabling_ga_tpu.ops import nsga
+        ranks = nsga.nondominated_ranks(state.hcv, state.scv)
+        crowd = nsga.crowding_distance(state.hcv, state.scv, ranks)
+        mo_stats = (ranks, crowd)
     ch_slots, ch_rooms = jax.vmap(
-        lambda k: _make_child(pa, k, state, cfg))(keys)
+        lambda k: _make_child(pa, k, state, cfg, mo_stats))(keys)
 
     if cfg.ls_mode == "sweep" and cfg.ls_sweeps > 0:
         # systematic Move1+Move2 sweep (Solution.cpp:508-561 analogue)

@@ -154,3 +154,52 @@ def make_island_runner(mesh: Mesh, cfg: ga.GAConfig, n_epochs: int,
         return state, trace[None], global_best
 
     return jax.jit(_run)
+
+
+# Python int, NOT a jnp scalar: a module-level device array would
+# initialize the default backend at import time, silently defeating the
+# engine's later jax_platforms switch (backend="cpu")
+_SENTINEL = 2 ** 31 - 1
+
+
+def make_island_runner_dynamic(mesh: Mesh, cfg: ga.GAConfig,
+                               max_gens: int):
+    """Like `make_island_runner(n_epochs=1)` but the generation count is
+    a RUNTIME argument `n_gens <= max_gens`: `run(pa, key, state, n_gens)`.
+
+    One compilation serves every tail size, so the engine can spend the
+    last fraction of a wall-clock budget (-t, Control.cpp:62-68) on a
+    right-sized dispatch instead of idling — the reference wastes nothing
+    there because it checks its clock before every LS candidate
+    (Solution.cpp:499); our granularity is one generation. Trace rows at
+    index >= n_gens hold INT_MAX sentinels (the host slices them off).
+    Migration still closes the epoch (ga.cpp:522-535 cadence).
+    """
+    n_islands = mesh.devices.size
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P(),
+                  ga.PopState(slots=P(AXIS), rooms=P(AXIS), penalty=P(AXIS),
+                              hcv=P(AXIS), scv=P(AXIS)), P()),
+        out_specs=(ga.PopState(slots=P(AXIS), rooms=P(AXIS),
+                               penalty=P(AXIS), hcv=P(AXIS), scv=P(AXIS)),
+                   P(AXIS), P()),
+        check_vma=False)
+    def _run(pa, key, state, n_gens):
+        my_key = jax.random.fold_in(key, lax.axis_index(AXIS))
+        tr0 = jnp.full((max_gens, 2), _SENTINEL, jnp.int32)
+
+        def body(i, carry):
+            st, tr = carry
+            st = ga.generation(pa, jax.random.fold_in(my_key, i), st, cfg)
+            tr = lax.dynamic_update_index_in_dim(
+                tr, jnp.stack([st.hcv[0], st.scv[0]]), i, 0)
+            return st, tr
+
+        state, trace = lax.fori_loop(0, n_gens, body, (state, tr0))
+        state = _migrate(state, n_islands)
+        global_best = lax.pmin(state.penalty[0], AXIS)
+        return state, trace[None, None], global_best
+
+    return jax.jit(_run)

@@ -263,6 +263,123 @@ def random_instance(key_or_seed, n_events: int, n_rooms: int,
                   n_days=n_days, slots_per_day=slots_per_day)
 
 
+#: Header stats for ITC-2002-style fixtures. The real competition set
+#: (20 instances, Metaheuristics Network / IDSIA generator) spans
+#: events 350-440, rooms 10-11, features 5-10, students 200-350, always
+#: on the fixed 45-slot grid, and every instance is guaranteed to admit
+#: a perfect solution (feasible AND scv == 0) because the generator
+#: plants one. The reference consumes exactly this format
+#: (Problem.cpp:7-31) but ships no instances; these presets characterize
+#: the two BASELINE.md anchor instances. Instances cannot be fetched in
+#: this environment (zero egress), so the fixtures are *characterized
+#: stand-ins*: same header shape, same construction principle (planted
+#: perfect solution), not byte-copies of the competition files.
+ITC_PRESETS = {
+    "comp01": dict(n_events=400, n_rooms=10, n_features=10, n_students=200),
+    "comp05": dict(n_events=350, n_rooms=10, n_features=10, n_students=300),
+}
+
+
+def itc_like_instance(key_or_seed, n_events: int = 400, n_rooms: int = 10,
+                      n_features: int = 10, n_students: int = 200,
+                      n_days: int = DAYS_DEFAULT,
+                      slots_per_day: int = SLOTS_PER_DAY_DEFAULT,
+                      return_planted: bool = False):
+    """ITC-2002-style instance with a PLANTED perfect solution.
+
+    Construction (mirrors the competition generator's guarantee, not its
+    code): first build a zero-penalty timetable, then derive the instance
+    around it so that timetable stays a witness:
+
+    1. events -> injective (slot, room) pairs, avoiding the last slot of
+       every day (so the planted solution's last-slot scv term is 0);
+    2. each student gets a slot pattern with, per day, 0 or 2-4 attended
+       slots (never exactly 1), no 3 consecutive, never the day's last
+       slot — then attends ONE event per chosen slot (so no student
+       clash and every soft term is 0 in the planted timetable);
+    3. each event requires a random subset of its planted room's
+       features, and each room's capacity covers its largest planted
+       event — so every planted room is suitable, while suitability
+       elsewhere stays scarce like the competition set's (median 2-5
+       suitable rooms per event).
+
+    Returns the Problem, or (Problem, planted_slots, planted_rooms) when
+    `return_planted` (for the zero-penalty witness test).
+    """
+    rng = np.random.default_rng(key_or_seed)
+    spd, D = slots_per_day, n_days
+    T = D * spd
+    usable = [t for t in range(T) if t % spd != spd - 1]
+    cells = [(t, r) for t in usable for r in range(n_rooms)]
+    if n_events > len(cells):
+        raise ValueError(
+            f"{n_events} events do not fit {len(usable)} usable slots x "
+            f"{n_rooms} rooms")
+    rng.shuffle(cells)
+    planted = cells[:n_events]
+    p_slots = np.array([t for t, _ in planted], dtype=np.int32)
+    p_rooms = np.array([r for _, r in planted], dtype=np.int32)
+    # events available per slot (for student schedule sampling)
+    by_slot = {t: np.nonzero(p_slots == t)[0] for t in usable}
+    by_slot = {t: ev for t, ev in by_slot.items() if ev.size}
+
+    # valid per-day slot patterns: subsets of the day's slots that
+    # actually HOST an event (an empty pattern slot would silently drop
+    # to a 1-class day and break the zero-scv witness), size 2-4, no 3
+    # consecutive slots
+    from itertools import combinations
+
+    def pattern_choices(av):
+        out = []
+        for k in (2, 3, 4):
+            for c in combinations(av, k):
+                if not any(c[i + 2] - c[i] == 2
+                           for i in range(len(c) - 2)):
+                    out.append(c)
+        return out
+
+    day_choices = [pattern_choices(
+        [j for j in range(spd - 1) if (d * spd + j) in by_slot])
+        for d in range(D)]
+
+    attends = np.zeros((n_students, n_events), dtype=np.int8)
+    for s in range(n_students):
+        active_days = set(rng.permutation(D)[: rng.integers(3, D + 1)]
+                          .tolist())
+        for d in range(D):
+            if d not in active_days or not day_choices[d]:
+                continue
+            pat = day_choices[d][rng.integers(len(day_choices[d]))]
+            for j in pat:
+                ev = by_slot[d * spd + j]
+                attends[s, ev[rng.integers(ev.size)]] = 1
+
+    # features: rooms get 3..F-2 features; events require a subset of
+    # their planted room's features (so the planted room is suitable)
+    room_features = np.zeros((n_rooms, n_features), dtype=np.int8)
+    for r in range(n_rooms):
+        k = rng.integers(3, max(4, n_features - 1))
+        room_features[r, rng.permutation(n_features)[:k]] = 1
+    event_features = np.zeros((n_events, n_features), dtype=np.int8)
+    for e in range(n_events):
+        has = np.nonzero(room_features[p_rooms[e]])[0]
+        k = rng.integers(1, min(4, has.size) + 1)
+        event_features[e, rng.permutation(has)[:k]] = 1
+
+    student_count = attends.astype(np.int64).sum(axis=0).astype(np.int32)
+    room_size = np.ones((n_rooms,), dtype=np.int32)
+    for e in range(n_events):
+        r = p_rooms[e]
+        room_size[r] = max(room_size[r], int(student_count[e]))
+
+    p = derive(n_events, n_rooms, n_features, n_students, room_size,
+               attends, room_features, event_features,
+               n_days=n_days, slots_per_day=slots_per_day)
+    if return_planted:
+        return p, p_slots, p_rooms
+    return p
+
+
 def room_tight_instance(key_or_seed, n_events: int, n_rooms: int,
                         n_features: int, n_students: int,
                         attend_prob: float = 0.05,
