@@ -149,6 +149,41 @@ def measure_generation(problem, rooms_mode: str) -> dict:
             "candidate_evals_per_sec": round(gps * evals_per_gen, 1)}
 
 
+def measure_generation_sweep(problem, pop: int) -> dict:
+    """VERDICT round-2 item 2: the sweep-LS generation pipeline (the
+    config the quality race actually ships) measured BEFORE racing it —
+    ms/gen is the number the engine's budget-aware dispatch sizing
+    consumes, candidate-evals/s the throughput comparison point.
+
+    One generation with ls_sweeps=1 evaluates P * E * (T + swap_block)
+    Move1+Move2 delta candidates (ops/sweep.py docstring)."""
+    import jax
+    from timetabling_ga_tpu.ops import ga
+
+    pa = problem.device_arrays()
+    gens = 4
+    cfg = ga.GAConfig(pop_size=pop, ls_mode="sweep", ls_sweeps=1,
+                      ls_swap_block=8)
+    state = ga.init_population(pa, jax.random.key(0), pop)
+    jax.block_until_ready(state)
+
+    run = jax.jit(lambda k, s: ga.run(pa, k, s, cfg, gens)[0])
+    warm = run(jax.random.key(1), state)
+    jax.block_until_ready(warm)
+    t0 = time.perf_counter()
+    out = run(jax.random.key(2), warm)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    T = problem.n_slots
+    evals_per_gen = pop * problem.n_events * (T + cfg.ls_swap_block)
+    gps = gens / dt
+    print(f"# sweep generation (pop {pop}): {dt / gens * 1e3:.0f} ms/gen, "
+          f"{gps * evals_per_gen:,.0f} sweep-candidate evals/s",
+          file=sys.stderr)
+    return {"pop": pop, "ms_per_gen": round(dt / gens * 1e3, 1),
+            "candidate_evals_per_sec": round(gps * evals_per_gen, 1)}
+
+
 def measure_scale() -> dict:
     """VERDICT item 6: synthetic E=2000 / R=80, pop=32768, single chip —
     exercises the memory plan (SURVEY hard part 3)."""
@@ -217,13 +252,19 @@ def measure_ls_shootout(problem) -> dict:
         pen, _, _ = fitness.batch_penalty(pa, *out)
         return float(np.asarray(pen).mean()), dt
 
-    # one sweep pass vs a K-random budget tuned to similar wall clock
+    # one sweep pass vs a K-random budget tuned to EQUAL wall clock:
+    # size rounds from a probe, then correct once from the measured run
+    # so the two sides land within ~5% (VERDICT round-2 weak 4: the
+    # round-2 shootout gave K-random 23% less time)
     sweep_pen, sweep_dt = timed(sweep.jit_sweep_local_search, 1, 8)
-    # K-random rounds sized to the sweep's measured wall clock
     probe_rounds = 50
     _, probe_dt = timed(delta.jit_batch_local_search_delta, probe_rounds, 8)
     rounds = max(1, int(probe_rounds * sweep_dt / probe_dt))
     rand_pen, rand_dt = timed(delta.jit_batch_local_search_delta, rounds, 8)
+    if abs(rand_dt - sweep_dt) / sweep_dt > 0.05:
+        rounds = max(1, int(rounds * sweep_dt / rand_dt))
+        rand_pen, rand_dt = timed(delta.jit_batch_local_search_delta,
+                                  rounds, 8)
     print(f"# LS shootout (equal wall clock): sweep {sweep_pen:,.1f} in "
           f"{sweep_dt:.2f}s vs K-random {rand_pen:,.1f} in {rand_dt:.2f}s "
           f"({rounds} rounds)", file=sys.stderr)
@@ -246,6 +287,10 @@ def main() -> None:
             ("generation_scan", lambda: measure_generation(problem, "scan")),
             ("generation_parallel",
              lambda: measure_generation(problem, "parallel")),
+            ("generation_sweep_128",
+             lambda: measure_generation_sweep(problem, 128)),
+            ("generation_sweep_1024",
+             lambda: measure_generation_sweep(problem, 1024)),
             ("scale_2000ev", measure_scale),
             ("ls_shootout", lambda: measure_ls_shootout(problem))):
         try:
@@ -254,6 +299,15 @@ def main() -> None:
             print(f"# {name} failed: {e}", file=sys.stderr)
             extra[name] = {"error": str(e)[:200]}
     extra["cpu_native_evals_per_sec"] = round(cpu, 1)
+    extra["cpu_threads"] = os.cpu_count() or 1
+    # honesty note (VERDICT round-2 weak 5): the denominator runs on
+    # THIS host's cores; the north star names a 32-core box. Scale
+    # linearly for an estimate vs that target.
+    extra["vs_baseline_note"] = (
+        f"vs_baseline is measured against the native C++ evaluator at "
+        f"{os.cpu_count() or 1} host core(s) — this box's hardware "
+        f"limit; against the north star's 32-core reference it "
+        f"extrapolates linearly to vs_baseline*{os.cpu_count() or 1}/32")
 
     print(json.dumps({
         "metric": "fitness_evals_per_sec_per_chip",

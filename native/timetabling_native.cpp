@@ -643,6 +643,42 @@ static long long reported(const Individual &i) {  // ga.cpp:191
                     : (long long)i.hcv * 1000000LL + i.scv;
 }
 
+// Tournament-select two parents and breed one child: selection5 +
+// uniform crossover + one-move mutation (ga.cpp:543-571). Shared by
+// run_ga and both run_islands branches so breeding semantics cannot
+// diverge; run_ga_reference keeps its own copy because its steady-state
+// threads must snapshot parents inside a critical section. `xmatch`
+// performs the crossover's full room rematch (greedy Matcher in the
+// memetic path, ExactMatcher in the reference path); `greedy` serves
+// the mutation's single-event re-room. NOT thread-safe against
+// concurrent writers of `pop`.
+template <class XMatcher>
+static void breed_child(const Problem &p, const GaParams &g,
+                        const std::vector<Individual> &pop, Rng &rng,
+                        const XMatcher &xmatch, const Matcher &greedy,
+                        Individual &child) {
+  const int P = (int)pop.size();
+  auto pick = [&]() {
+    int best = rng.next_int(P);
+    for (int k = 1; k < g.tournament_k; ++k) {
+      int c = rng.next_int(P);
+      if (pop[c].pen < pop[best].pen) best = c;
+    }
+    return best;
+  };
+  child = pop[pick()];
+  const Individual &pb_ = pop[pick()];
+  if (rng.next_double() < g.p_crossover) {   // uniform crossover (C11)
+    for (int e = 0; e < p.E; ++e)
+      if (rng.next_double() < 0.5) child.slots[e] = pb_.slots[e];
+    xmatch.assign_all(child.slots.data(), child.rooms.data());
+  }
+  if (rng.next_double() < g.p_mutation) {    // one random move (C12)
+    MoveCtx c{p, greedy, rng, g.p1, g.p2, g.p3};
+    random_move(c, child.slots, child.rooms);
+  }
+}
+
 // Generational mu+lambda GA, one island (the per-device program of the
 // TPU path, ops/ga.py, in native form).
 static Individual run_ga(const Problem &p, const GaParams &g,
@@ -687,28 +723,8 @@ static Individual run_ga(const Problem &p, const GaParams &g,
 #pragma omp for
       for (int i = 0; i < P; ++i) {
         Rng &rng = rngs[P + i];
-        // tournament-5 x2 (ga.cpp:129-145)
-        auto pick = [&]() {
-          int best = rng.next_int(P);
-          for (int k = 1; k < g.tournament_k; ++k) {
-            int c = rng.next_int(P);
-            if (pop[c].pen < pop[best].pen) best = c;
-          }
-          return best;
-        };
-        const Individual &pa_ = pop[pick()];
-        const Individual &pb_ = pop[pick()];
         Individual &ch = children[i];
-        ch = pa_;
-        if (rng.next_double() < g.p_crossover) {   // uniform (C11)
-          for (int e = 0; e < p.E; ++e)
-            if (rng.next_double() < 0.5) ch.slots[e] = pb_.slots[e];
-          m.assign_all(ch.slots.data(), ch.rooms.data());  // full rematch
-        }
-        if (rng.next_double() < g.p_mutation) {    // one move (C12)
-          MoveCtx c{p, m, rng, g.p1, g.p2, g.p3};
-          random_move(c, ch.slots, ch.rooms);
-        }
+        breed_child(p, g, pop, rng, m, m, ch);
         evaluate(p, ch, scratch);
         local_search(p, m, rng, ch, g, scratch);
       }
@@ -827,6 +843,143 @@ static Individual run_ga_reference(const Problem &p, const GaParams &g,
   return pop[0];
 }
 
+// Multi-island mode: N islands in ONE process, threads parallelizing
+// ACROSS islands, bidirectional ring migration every `migration_period`
+// generations — the reference binary's flagship parallel axis
+// (one island per MPI rank, ga.cpp:479-541) without MPI, with the same
+// exchange semantics as the TPU path (parallel/islands.py _migrate):
+// best solution forward, second-best backward, immigrants overwrite the
+// two worst rows, then re-sort.
+struct IslandCtx {
+  std::vector<Individual> pop, children;
+  std::vector<Rng> rngs;
+  long long best_seen = LLONG_MAX;
+};
+
+static std::vector<Individual> run_islands(
+    const Problem &p, const GaParams &g, const LogSink *sink,
+    int n_islands, int migration_period, const std::string &algo,
+    int max_steps, double ls_limit) {
+  const int P = g.pop_size;
+  const int N = n_islands;
+  const double t0 = now_sec();
+  const bool ref = (algo == "reference");
+  std::vector<IslandCtx> isl(N);
+  const int nthreads = g.threads > 0 ? g.threads : 1;
+
+  // init: every island from its own seed stream (fold_in(key, island),
+  // parallel/islands.py:59-82 — NOT the reference's broadcast-identical
+  // populations, ga.cpp:429-444; documented divergence SURVEY C17)
+#pragma omp parallel for num_threads(nthreads) schedule(dynamic)
+  for (int is = 0; is < N; ++is) {
+    IslandCtx &I = isl[is];
+    I.pop.resize(P);
+    I.children.resize(P);
+    for (int i = 0; i < 2 * P; ++i)
+      I.rngs.emplace_back(g.seed * 0x5851f42d4c957f2dULL + is * 77777 + i);
+    Matcher m(p);
+    ExactMatcher xm(p);
+    RefLS ls(p, xm);
+    std::vector<uint8_t> scratch;
+    for (int i = 0; i < P; ++i) {
+      Individual &ind = I.pop[i];
+      ind.slots.resize(p.E);
+      ind.rooms.resize(p.E);
+      for (int e = 0; e < p.E; ++e)
+        ind.slots[e] = I.rngs[i].next_int(p.n_slots());
+      if (ref) xm.assign_all(ind.slots.data(), ind.rooms.data());
+      else m.assign_all(ind.slots.data(), ind.rooms.data());
+      evaluate(p, ind, scratch);
+      if (now_sec() - t0 <= g.time_limit) {
+        if (ref) ls.run(ind, I.rngs[i], max_steps, ls_limit);
+        else local_search(p, m, I.rngs[i], ind, g, scratch);
+      }
+    }
+    std::sort(I.pop.begin(), I.pop.end(),
+              [](const Individual &a, const Individual &b) {
+                return a.pen < b.pen;
+              });
+  }
+
+  auto by_pen = [](const Individual &a, const Individual &b) {
+    return a.pen < b.pen;
+  };
+  int gens_done = 0;
+  while (gens_done < g.generations && now_sec() - t0 <= g.time_limit) {
+    const int gens = std::min(migration_period, g.generations - gens_done);
+#pragma omp parallel for num_threads(nthreads) schedule(dynamic)
+    for (int is = 0; is < N; ++is) {
+      IslandCtx &I = isl[is];
+      Matcher m(p);
+      ExactMatcher xm(p);
+      RefLS ls(p, xm);
+      std::vector<uint8_t> scratch;
+      for (int gen = 0; gen < gens; ++gen) {
+        if (now_sec() - t0 > g.time_limit) break;
+        if (ref) {
+          // steady-state: one child per generation (ga.cpp:543-585)
+          Rng &rng = I.rngs[P];
+          Individual child;
+          breed_child(p, g, I.pop, rng, xm, m, child);
+          evaluate(p, child, scratch);
+          ls.run(child, rng, max_steps, ls_limit);
+          I.pop[P - 1] = std::move(child);
+          std::sort(I.pop.begin(), I.pop.end(),
+                    [](const Individual &a, const Individual &b) {
+                      return a.pen < b.pen;
+                    });
+        } else {
+          // generational mu+lambda (run_ga's loop body, serial within
+          // the island — threads are spent across islands here)
+          for (int i = 0; i < P; ++i) {
+            Rng &rng = I.rngs[P + i];
+            Individual &ch = I.children[i];
+            breed_child(p, g, I.pop, rng, m, m, ch);
+            evaluate(p, ch, scratch);
+            local_search(p, m, rng, ch, g, scratch);
+          }
+          std::vector<Individual> all;
+          all.reserve(2 * P);
+          for (auto &x : I.pop) all.push_back(std::move(x));
+          for (auto &x : I.children) all.push_back(std::move(x));
+          std::sort(all.begin(), all.end(),
+                    [](const Individual &a, const Individual &b) {
+                      return a.pen < b.pen;
+                    });
+          for (int i = 0; i < P; ++i) I.pop[i] = std::move(all[i]);
+        }
+        const long long rep = reported(I.pop[0]);
+        if (sink && rep < I.best_seen) {
+          I.best_seen = rep;
+#pragma omp critical(ttlog)
+          sink->log_entry(is, 0, rep, now_sec() - t0);
+        }
+      }
+    }
+    gens_done += gens;
+
+    // ring migration (serial; the collectives' barrier semantics):
+    // snapshot emigrants first so the exchange reads pre-migration
+    // populations, like lax.ppermute of row 0 fwd / row 1 bwd
+    if (N > 1) {
+      std::vector<Individual> fwd(N), bwd(N);
+      for (int is = 0; is < N; ++is) {
+        fwd[is] = isl[is].pop[0];
+        bwd[is] = isl[is].pop[1];
+      }
+      for (int is = 0; is < N; ++is) {
+        isl[is].pop[P - 1] = fwd[(is - 1 + N) % N];
+        if (P >= 2) isl[is].pop[P - 2] = bwd[(is + 1) % N];
+        std::sort(isl[is].pop.begin(), isl[is].pop.end(), by_pen);
+      }
+    }
+  }
+
+  std::vector<Individual> bests(N);
+  for (int is = 0; is < N; ++is) bests[is] = isl[is].pop[0];
+  return bests;
+}
+
 }  // namespace tt
 
 // =====================================================================
@@ -910,6 +1063,10 @@ int main(int argc, char **argv) {
   double ls_limit = 99999.0;  // -l (Control.cpp:93-99); honored by --algo
                               // reference's sweep LS (Solution.cpp:499)
   std::string algo = "memetic";
+  int n_islands = 1;          // --islands (the reference's MPI world
+                              // size, ga.cpp:379) in one process
+  int migration_period = 100; // generations between ring exchanges
+                              // (ga.cpp:514 cadence, made explicit)
 
   for (int i = 1; i + 1 < argc + 1; ++i) {
     std::string a = argv[i] ? argv[i] : "";
@@ -929,9 +1086,15 @@ int main(int argc, char **argv) {
     else if (a == "--pop-size") { const char *v = val(); if (v) g.pop_size = std::atoi(v); }
     else if (a == "--generations") { const char *v = val(); if (v) g.generations = std::atoi(v); }
     else if (a == "--ls-candidates") { const char *v = val(); if (v) g.ls_candidates = std::atoi(v); }
+    else if (a == "--islands") { const char *v = val(); if (v) n_islands = std::atoi(v); }
+    else if (a == "--migration-period") { const char *v = val(); if (v) migration_period = std::atoi(v); }
     else if (!a.empty()) { std::fprintf(stderr, "unknown flag: %s\n", a.c_str()); return 2; }
   }
   if (!input) { std::fprintf(stderr, "No instance file specified, use -i <file>\n"); return 2; }
+  if (n_islands < 1) n_islands = 1;
+  // <1 (incl. atoi's 0 for junk) would make run_islands spin on
+  // zero-generation epochs until the time limit
+  if (migration_period < 1) migration_period = 1;
   if (!max_steps_set)
     max_steps = problem_type == 1 ? 200 : problem_type == 2 ? 1000 : 2000;
   g.ls_rounds = std::max(1, max_steps / g.ls_candidates);
@@ -953,36 +1116,51 @@ int main(int argc, char **argv) {
     return 2;
   }
   const double t0 = tt::now_sec();
-  tt::Individual best =
-      algo == "reference"
-          ? tt::run_ga_reference(p, g, &sink, 0, max_steps, ls_limit)
-          : tt::run_ga(p, g, &sink, 0);
-  const double dt = tt::now_sec() - t0;
-  const long long rep = tt::reported(best);
-  const bool feas = best.hcv == 0;
-
-  // solution record (endTry, ga.cpp:169-197)
-  std::fprintf(sink.os,
-               "{\"solution\":{\"procID\":0,\"threadID\":0,\"totalTime\":%.6f,"
-               "\"totalBest\":%lld,\"feasible\":%s", dt, rep,
-               feas ? "true" : "false");
-  if (feas) {
-    std::fprintf(sink.os, ",\"timeslots\":[");
-    for (int e = 0; e < p.E; ++e)
-      std::fprintf(sink.os, "%s%d", e ? "," : "", best.slots[e]);
-    std::fprintf(sink.os, "],\"rooms\":[");
-    for (int e = 0; e < p.E; ++e)
-      std::fprintf(sink.os, "%s%d", e ? "," : "", best.rooms[e]);
-    std::fprintf(sink.os, "]");
+  std::vector<tt::Individual> bests;
+  if (n_islands > 1) {
+    bests = tt::run_islands(p, g, &sink, n_islands, migration_period,
+                            algo, max_steps, ls_limit);
+  } else {
+    bests.push_back(algo == "reference"
+                        ? tt::run_ga_reference(p, g, &sink, 0, max_steps,
+                                               ls_limit)
+                        : tt::run_ga(p, g, &sink, 0));
   }
-  std::fprintf(sink.os, "}}\n");
-  // runEntry pair (setGlobalCost + final, ga.cpp:234-257, 603-609)
+  const double dt = tt::now_sec() - t0;
+
+  // per-island solution records (endTry, ga.cpp:169-197)
+  long long global = LLONG_MAX;
+  bool global_feas = false;
+  for (int is = 0; is < (int)bests.size(); ++is) {
+    const tt::Individual &best = bests[is];
+    const long long rep = tt::reported(best);
+    const bool feas = best.hcv == 0;
+    global = std::min(global, rep);
+    global_feas = global_feas || feas;
+    std::fprintf(sink.os,
+                 "{\"solution\":{\"procID\":%d,\"threadID\":0,"
+                 "\"totalTime\":%.6f,\"totalBest\":%lld,\"feasible\":%s",
+                 is, dt, rep, feas ? "true" : "false");
+    if (feas) {
+      std::fprintf(sink.os, ",\"timeslots\":[");
+      for (int e = 0; e < p.E; ++e)
+        std::fprintf(sink.os, "%s%d", e ? "," : "", best.slots[e]);
+      std::fprintf(sink.os, "],\"rooms\":[");
+      for (int e = 0; e < p.E; ++e)
+        std::fprintf(sink.os, "%s%d", e ? "," : "", best.rooms[e]);
+      std::fprintf(sink.os, "]");
+    }
+    std::fprintf(sink.os, "}}\n");
+  }
+  // runEntry pair: global best = min over islands (the Allreduce MIN,
+  // ga.cpp:234-257, 603-609)
   std::fprintf(sink.os, "{\"runEntry\":{\"totalBest\":%lld,\"feasible\":%s}}\n",
-               rep, feas ? "true" : "false");
+               global, global_feas ? "true" : "false");
   std::fprintf(sink.os,
                "{\"runEntry\":{\"totalBest\":%lld,\"feasible\":%s,"
-               "\"procsNum\":1,\"threadsNum\":%d,\"totalTime\":%.6f}}\n",
-               rep, feas ? "true" : "false", g.threads, dt);
+               "\"procsNum\":%d,\"threadsNum\":%d,\"totalTime\":%.6f}}\n",
+               global, global_feas ? "true" : "false", n_islands,
+               g.threads, dt);
   if (output) std::fclose(sink.os);
   return 0;
 }

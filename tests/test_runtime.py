@@ -4,6 +4,7 @@ checkpoint/resume (SURVEY C17-C19, section 5).
 
 import io
 import json
+import os
 
 import numpy as np
 import jax
@@ -328,3 +329,44 @@ def test_time_to_feasible_guard(tim_file):
                   if "logEntry" in x and x["logEntry"]["best"] < 10 ** 6]
     assert feas_times, "never reached feasibility on the easy instance"
     assert feas_times[0] < 120.0
+
+
+def test_distributed_flag_validation():
+    with pytest.raises(SystemExit):
+        parse_args(["-i", "x.tim", "--coordinator", "h:1"])  # no n/id
+    with pytest.raises(SystemExit):
+        parse_args(["-i", "x.tim", "--distributed",
+                    "--checkpoint", "c.npz"])  # unsupported combo
+    cfg = parse_args(["-i", "x.tim", "--coordinator", "h:1",
+                      "--num-processes", "2", "--process-id", "1"])
+    assert cfg.coordinator == "h:1"
+    assert cfg.num_processes == 2 and cfg.process_id == 1
+
+
+def test_distributed_single_process_smoke(tim_file):
+    """The multi-host entry point (VERDICT round-2 item 6, the
+    reference's MPI_Init role, ga.cpp:373-380) wires end-to-end with
+    num_processes=1: jax.distributed.initialize runs before the mesh is
+    built and a full engine.run completes. A subprocess is required
+    because initialize() must precede any backend use in the process."""
+    import subprocess
+    import sys as _sys
+    code = (
+        "import io, sys\n"
+        "from timetabling_ga_tpu.runtime.config import parse_args\n"
+        "from timetabling_ga_tpu.runtime import engine\n"
+        "cfg = parse_args(['-i', sys.argv[1],\n"
+        "    '--coordinator', 'localhost:38217',\n"
+        "    '--num-processes', '1', '--process-id', '0',\n"
+        "    '--backend', 'cpu', '--pop-size', '4', '-s', '1',\n"
+        "    '--generations', '5', '--migration-period', '5'])\n"
+        "best = engine.run(cfg, out=io.StringIO())\n"
+        "import jax\n"
+        "assert jax.process_count() == 1\n"
+        "assert engine._DISTRIBUTED_DONE\n"
+        "print('DIST_OK', best)\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([_sys.executable, "-c", code, tim_file],
+                         capture_output=True, text=True, timeout=300,
+                         env=env)
+    assert "DIST_OK" in out.stdout, out.stderr[-2000:]

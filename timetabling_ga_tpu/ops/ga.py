@@ -56,6 +56,10 @@ class GAConfig:
     ls_mode: str = "random"       # "random" K-candidate | "sweep"
     ls_sweeps: int = 1            # max sweep passes when ls_mode="sweep"
     ls_swap_block: int = 8        # Move2 partners per event per sweep pass
+    ls_block_events: int = 1      # events per sweep scan step (B>1: scan
+    #                               depth E/B, best single move of the
+    #                               block applied — throughput/density
+    #                               trade, ops/sweep.py sweep_pass)
     ls_converge: bool = False     # sweep passes early-exit at the whole-
     #                               population local optimum (the
     #                               reference's stopping rule,
@@ -118,7 +122,8 @@ def init_population(pa, key, pop_size: int,
         from timetabling_ga_tpu.ops.sweep import sweep_local_search
         slots, rooms_arr = sweep_local_search(
             pa, k_ls, slots, rooms_arr, n_sweeps=cfg.init_sweeps,
-            swap_block=cfg.ls_swap_block, converge=True)
+            swap_block=cfg.ls_swap_block, converge=True,
+            block_events=cfg.ls_block_events)
     return evaluate(pa, slots, rooms_arr)
 
 
@@ -202,7 +207,7 @@ def generation(pa, key, state: PopState, cfg: GAConfig) -> PopState:
         ch_slots, ch_rooms = sweep_local_search(
             pa, k_ls, ch_slots, ch_rooms,
             n_sweeps=cfg.ls_sweeps, swap_block=cfg.ls_swap_block,
-            converge=cfg.ls_converge)
+            converge=cfg.ls_converge, block_events=cfg.ls_block_events)
     elif cfg.ls_steps > 0:
         if cfg.ls_delta:
             from timetabling_ga_tpu.ops.delta import (

@@ -22,7 +22,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-INF = jnp.float32(jnp.inf)
+# Python float, not jnp.float32: a module-level device constant would
+# initialize the JAX backend at import time (see rooms._BIG note)
+INF = float("inf")
 
 
 def domination_matrix(hcv: jnp.ndarray, scv: jnp.ndarray) -> jnp.ndarray:

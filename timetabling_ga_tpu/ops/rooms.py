@@ -122,7 +122,11 @@ def batch_assign_rooms(pa, slots: jnp.ndarray) -> jnp.ndarray:
     return jax.vmap(lambda s: assign_rooms(pa, s))(slots)
 
 
-_BIG = jnp.int32(1 << 20)
+# Python int, not jnp.int32: a module-level device constant would
+# initialize the JAX backend at import time, breaking both the engine's
+# backend="cpu" switch and jax.distributed.initialize (which must run
+# before any backend use). Weak-typed int promotes to int32 in-trace.
+_BIG = 1 << 20
 
 
 def augment_rooms(pa, slots: jnp.ndarray, rooms_arr: jnp.ndarray,

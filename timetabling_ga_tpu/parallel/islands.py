@@ -162,6 +162,39 @@ def make_island_runner(mesh: Mesh, cfg: ga.GAConfig, n_epochs: int,
 _SENTINEL = 2 ** 31 - 1
 
 
+def make_polish_runner(mesh: Mesh, cfg: ga.GAConfig):
+    """Initial-population LS polish as its own dispatchable program:
+    `polish(pa, key, state, n_sweeps) -> state` runs up to `n_sweeps`
+    (a RUNTIME argument) convergence-bounded sweep passes on every
+    island's population and re-evaluates.
+
+    The reference LS-polishes its initial population before generation 0
+    (ga.cpp:429-434) with the clock checked inside the loop
+    (Solution.cpp:499); fusing that polish into one init dispatch made
+    it unboundable — a 30-pass converge polish at comp scale can eat a
+    whole 60 s budget in one dispatch. Chunked dispatches of a few
+    passes each give the engine clock checks between chunks, and the
+    runtime sweep count means one compile serves every chunk size."""
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P(),
+                  ga.PopState(slots=P(AXIS), rooms=P(AXIS), penalty=P(AXIS),
+                              hcv=P(AXIS), scv=P(AXIS)), P()),
+        out_specs=ga.PopState(slots=P(AXIS), rooms=P(AXIS), penalty=P(AXIS),
+                              hcv=P(AXIS), scv=P(AXIS)),
+        check_vma=False)
+    def _polish(pa, key, state, n_sweeps):
+        from timetabling_ga_tpu.ops.sweep import sweep_local_search
+        my_key = jax.random.fold_in(key, lax.axis_index(AXIS))
+        slots, rooms = sweep_local_search(
+            pa, my_key, state.slots, state.rooms, n_sweeps=n_sweeps,
+            swap_block=cfg.ls_swap_block, converge=True,
+            block_events=cfg.ls_block_events)
+        return ga.evaluate(pa, slots, rooms)
+
+    return jax.jit(_polish)
+
+
 def make_island_runner_dynamic(mesh: Mesh, cfg: ga.GAConfig,
                                max_gens: int):
     """Like `make_island_runner(n_epochs=1)` but the generation count is

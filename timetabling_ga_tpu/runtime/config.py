@@ -79,6 +79,7 @@ class RunConfig:
     ls_mode: str = "random"   # "random" K-candidate | "sweep" systematic
     ls_sweeps: int = 1
     ls_swap_block: int = 8
+    ls_block_events: int = 1  # events per sweep scan step (see GAConfig)
     ls_converge: bool = False  # sweep LS early-exits at the population-
     #                            wide local optimum (reference stopping
     #                            rule); ls_sweeps becomes the hard bound
@@ -92,6 +93,14 @@ class RunConfig:
     ls_full_eval: bool = False  # disable delta evaluation (debugging)
     epochs_per_dispatch: int = 1  # epochs fused into one device dispatch
     trace: bool = False       # emit {"phase": ...} timing JSONL records
+    # ---- multi-host (the reference's MPI_Init role, ga.cpp:373-380):
+    # jax.distributed.initialize is called before any device use when
+    # --distributed or --coordinator is given; the island mesh then spans
+    # every process's devices (ICI within a slice, DCN across hosts)
+    distributed: bool = False     # auto-detected initialize() (TPU pods)
+    coordinator: Optional[str] = None  # host:port of process 0
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
 
     def resolved_seed(self) -> int:
         # reference default: time(NULL) (Control.cpp:129-136)
@@ -126,16 +135,21 @@ _FLAG_MAP = {
     "--ls-mode": ("ls_mode", str),
     "--ls-sweeps": ("ls_sweeps", int),
     "--ls-swap-block": ("ls_swap_block", int),
+    "--ls-block-events": ("ls_block_events", int),
     "--init-sweeps": ("init_sweeps", int),
     "--rooms-mode": ("rooms_mode", str),
     "--checkpoint": ("checkpoint", str),
     "--checkpoint-every": ("checkpoint_every", int),
     "--epochs-per-dispatch": ("epochs_per_dispatch", int),
+    "--coordinator": ("coordinator", str),
+    "--num-processes": ("num_processes", int),
+    "--process-id": ("process_id", int),
 }
 
 _BOOL_FLAGS = {"--resume": "resume", "--nsga2": "nsga2",
                "--ls-full-eval": "ls_full_eval", "--trace": "trace",
-               "--ls-converge": "ls_converge"}
+               "--ls-converge": "ls_converge",
+               "--distributed": "distributed"}
 
 
 def parse_args(argv) -> RunConfig:
@@ -166,4 +180,12 @@ def parse_args(argv) -> RunConfig:
         raise SystemExit(f"unknown ls-mode: {cfg.ls_mode}")
     if cfg.rooms_mode not in ("scan", "parallel"):
         raise SystemExit(f"unknown rooms-mode: {cfg.rooms_mode}")
+    if cfg.coordinator is not None and (cfg.num_processes is None
+                                        or cfg.process_id is None):
+        raise SystemExit("--coordinator requires --num-processes and "
+                         "--process-id (the reference's mpirun provides "
+                         "these; here they are explicit)")
+    if (cfg.distributed or cfg.coordinator) and cfg.checkpoint:
+        raise SystemExit("--checkpoint is not supported in multi-host "
+                         "runs yet; drop one of the two flags")
     return cfg

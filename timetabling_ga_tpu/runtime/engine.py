@@ -30,6 +30,7 @@ byte-compatible.
 
 from __future__ import annotations
 
+import dataclasses
 import sys
 import time
 
@@ -67,10 +68,26 @@ def _pow2_floor(n: int) -> int:
     return 1 << (n.bit_length() - 1)
 
 
-def cached_runner(mesh, gacfg: ga.GAConfig, n_epochs: int, gens: int):
-    """Returns (runner, was_cached). was_cached=False means this runner
-    object is fresh, so its first call will pay an XLA compile."""
-    k = (_mesh_key(mesh), gacfg, n_epochs, gens)
+def _shape_sig(problem):
+    """Instance-shape signature for the compiled-program caches.
+
+    jax.jit compiles PER INPUT SHAPE, so a cache hit on (mesh, gacfg,
+    dispatch shape) alone does NOT mean 'no compile': the same runner
+    object retraces for a differently-shaped instance, and treating that
+    first call as warm would time the compile into the persisted sec/gen
+    and sec/sweep estimates (poisoning every later budget decision for
+    that instance — found in round-3 review). The shape signature makes
+    warmness per-instance-shape."""
+    return (problem.n_events, problem.n_rooms, problem.n_students,
+            problem.n_days, problem.slots_per_day)
+
+
+def cached_runner(mesh, gacfg: ga.GAConfig, n_epochs: int, gens: int,
+                  sig):
+    """Returns (runner, was_cached). was_cached=False means this
+    (program, instance shape) pair is fresh, so its first call will pay
+    an XLA compile."""
+    k = (_mesh_key(mesh), gacfg, n_epochs, gens, sig)
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
@@ -80,11 +97,11 @@ def cached_runner(mesh, gacfg: ga.GAConfig, n_epochs: int, gens: int):
     return r, False
 
 
-def cached_dynamic_runner(mesh, gacfg: ga.GAConfig, max_gens: int):
+def cached_dynamic_runner(mesh, gacfg: ga.GAConfig, max_gens: int, sig):
     """Tail-dispatch runner with a RUNTIME generation count (one compile
     serves every n_gens <= max_gens), used to spend the last slice of a
     wall-clock budget instead of idling through it."""
-    k = ("dyn", _mesh_key(mesh), gacfg, max_gens)
+    k = ("dyn", _mesh_key(mesh), gacfg, max_gens, sig)
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
@@ -107,6 +124,20 @@ def cached_init(mesh, pop_size: int, gacfg: ga.GAConfig):
 # the same (mesh, config, problem shape) so a warm-up run's measurement
 # bounds even the FIRST dispatch of a later timed run.
 _SPG_CACHE: dict = {}
+# Likewise for seconds-per-sweep-pass of the init polish runner.
+_SPS_CACHE: dict = {}
+
+
+def cached_polish_runner(mesh, gacfg: ga.GAConfig, sig):
+    """Init-polish runner with a RUNTIME sweep count (one compile serves
+    every chunk size); see islands.make_polish_runner."""
+    k = ("polish", _mesh_key(mesh), gacfg, sig)
+    r = _RUNNER_CACHE.get(k)
+    if r is not None:
+        return r, True
+    r = islands.make_polish_runner(mesh, gacfg)
+    _RUNNER_CACHE[k] = r
+    return r, False
 
 
 def build_ga_config(cfg: RunConfig) -> ga.GAConfig:
@@ -125,10 +156,53 @@ def build_ga_config(cfg: RunConfig) -> ga.GAConfig:
         ls_delta=not cfg.ls_full_eval,
         ls_mode=cfg.ls_mode, ls_sweeps=cfg.ls_sweeps,
         ls_swap_block=cfg.ls_swap_block,
+        ls_block_events=cfg.ls_block_events,
         ls_converge=cfg.ls_converge, init_sweeps=cfg.init_sweeps,
         rooms_mode=cfg.rooms_mode,
         multi_objective=cfg.nsga2,
     )
+
+
+_DISTRIBUTED_DONE = False
+
+
+def maybe_init_distributed(cfg: RunConfig) -> None:
+    """Multi-host entry point — the role MPI_Init plays for the
+    reference (ga.cpp:373-380). Called before any device use; the island
+    mesh then spans every process's devices, with migration riding ICI
+    within a slice and DCN across hosts (SURVEY section 5, distributed
+    comm backend).
+
+    Launch (one command per host, like mpirun's per-rank launch):
+        host0: tt -i x.tim --coordinator host0:1234 \
+                  --num-processes 2 --process-id 0
+        host1: tt -i x.tim --coordinator host0:1234 \
+                  --num-processes 2 --process-id 1
+    On TPU pods, `--distributed` alone auto-detects all three values
+    from the environment. Idempotent: repeated engine.run calls in one
+    process initialize once."""
+    global _DISTRIBUTED_DONE
+    if _DISTRIBUTED_DONE or not (cfg.distributed or cfg.coordinator):
+        return
+    kwargs = {}
+    if cfg.coordinator is not None:
+        kwargs = dict(coordinator_address=cfg.coordinator,
+                      num_processes=cfg.num_processes,
+                      process_id=cfg.process_id)
+    jax.distributed.initialize(**kwargs)
+    _DISTRIBUTED_DONE = True
+
+
+def _fetch(x) -> np.ndarray:
+    """Device->host fetch that also works for multi-host global arrays:
+    single-process it is a plain np.asarray; multi-process the shards
+    are allgathered so every process sees the global value (the
+    reference ships full solutions between ranks the same way,
+    ga.cpp:318-368)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
 
 
 def _setup(cfg: RunConfig):
@@ -167,11 +241,25 @@ def precompile(cfg: RunConfig) -> None:
     time (mpicxx does its compiling before the race too)."""
     if cfg.backend == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    maybe_init_distributed(cfg)
     problem, pa, mesh, n_islands, gacfg, fingerprint, spg_key = _setup(cfg)
+    sig = _shape_sig(problem)
 
     key = jax.random.key(0)
-    state = cached_init(mesh, cfg.pop_size, gacfg)(pa, key)
+    gacfg_init = dataclasses.replace(gacfg, init_sweeps=0)
+    state = cached_init(mesh, cfg.pop_size, gacfg_init)(pa, key)
     jax.block_until_ready(state)
+    if gacfg.init_sweeps > 0:
+        polish, pwarm = cached_polish_runner(mesh, gacfg, sig)
+        jax.block_until_ready(polish(pa, key, state, 1))
+        if not pwarm:
+            t0 = time.monotonic()
+            jax.block_until_ready(
+                polish(pa, jax.random.key(1), state, 1))
+            sps = time.monotonic() - t0
+            prev = _SPS_CACHE.get(spg_key)
+            _SPS_CACHE[spg_key] = (sps if prev is None
+                                   else 0.7 * sps + 0.3 * prev)
     # static dispatches always run gens = migration_period (shorter
     # remainders go through the dynamic runner), at pow2 n_ep; compile
     # exactly those
@@ -180,19 +268,25 @@ def precompile(cfg: RunConfig) -> None:
               if cfg.generations >= cfg.migration_period else 0)
     n_ep = 1
     while n_ep <= max_ep:
-        runner, warm = cached_runner(mesh, gacfg, n_ep, gens)
+        runner, warm = cached_runner(mesh, gacfg, n_ep, gens, sig)
         st2, _, _ = runner(pa, key, state)
         jax.block_until_ready(st2)
         if not warm:
+            # the timing call MUST differ from the compile call: tunneled
+            # devices deduplicate byte-identical repeat computations
+            # (BASELINE.md methodology note), which once made this
+            # measure ~2e-5 s/gen and let a 146 s dispatch through a
+            # 60 s budget — so re-run with a different key
             t0 = time.monotonic()
-            st2, _, _ = runner(pa, key, state)
+            st2, _, _ = runner(pa, jax.random.key(1), state)
             jax.block_until_ready(st2)
             spg = (time.monotonic() - t0) / (n_ep * gens)
             prev = _SPG_CACHE.get(spg_key)
             _SPG_CACHE[spg_key] = (spg if prev is None
                                    else 0.7 * spg + 0.3 * prev)
         n_ep *= 2
-    dyn, _ = cached_dynamic_runner(mesh, gacfg, cfg.migration_period)
+    dyn, _ = cached_dynamic_runner(mesh, gacfg, cfg.migration_period,
+                                   sig)
     jax.block_until_ready(dyn(pa, key, state, 1))
 
 
@@ -213,8 +307,19 @@ def run(cfg: RunConfig, out=None) -> int:
               "the local search is bounded by -m (maxSteps) candidate "
               "evaluations instead", file=sys.stderr)
 
+    maybe_init_distributed(cfg)
+
+    # single-controller reporting: process 0 has the global view (every
+    # island's solution records and the runEntry), so other processes
+    # stay silent instead of duplicating the protocol — and must not
+    # even OPEN -o (on a shared filesystem they would truncate the file
+    # process 0 is writing)
+    is_main = not (jax.process_count() > 1 and jax.process_index() != 0)
     close_out = False
-    if out is None:
+    if not is_main:
+        import io
+        out = io.StringIO()
+    elif out is None:
         if cfg.output:
             out = open(cfg.output, "w")
             close_out = True
@@ -244,6 +349,10 @@ def _run_tries(cfg: RunConfig, out) -> int:
     # params + island layout), so a measurement from one problem is never
     # trusted for a differently-shaped one.
     problem, pa, mesh, n_islands, gacfg, fingerprint, spg_key = _setup(cfg)
+    sig = _shape_sig(problem)
+    # init runs WITHOUT the fused polish (init_sweeps=0): the polish is
+    # dispatched in budget-aware chunks right after (see below)
+    gacfg_init = dataclasses.replace(gacfg, init_sweeps=0)
     seed = cfg.resolved_seed()
     _phase(out, cfg.trace, "load", 0, time.monotonic() - t0)
 
@@ -273,9 +382,53 @@ def _run_tries(cfg: RunConfig, out) -> int:
                 state = None
         if state is None:
             t = time.monotonic()
-            state = cached_init(mesh, cfg.pop_size, gacfg)(pa, k_init)
+            state = cached_init(mesh, cfg.pop_size, gacfg_init)(pa, k_init)
             jax.block_until_ready(state)
             _phase(out, cfg.trace, "init", trial, time.monotonic() - t)
+            # Initial-population LS polish (ga.cpp:429-434), CHUNKED so
+            # the wall clock is checked between dispatches — one fused
+            # 30-pass converge polish at comp scale can otherwise eat a
+            # whole budget in a single unboundable dispatch. The runner
+            # takes the sweep count at runtime (one compile, any chunk);
+            # the loop stops at the pass budget, at the population-wide
+            # fixed point (penalty sum stops dropping — convergence
+            # inside a chunk implies the next chunk is a no-op), or when
+            # the next chunk is predicted not to fit the time budget.
+            if gacfg.init_sweeps > 0:
+                polish, pwarm = cached_polish_runner(mesh, gacfg, sig)
+                sec_per_sweep = _SPS_CACHE.get(spg_key)
+                done = 0
+                prev_sum = None
+                while done < gacfg.init_sweeps:
+                    remaining_t = (cfg.time_limit
+                                   - (time.monotonic() - t_try))
+                    chunk = min(4, gacfg.init_sweeps - done)
+                    if sec_per_sweep is not None and sec_per_sweep > 0:
+                        fit = int(remaining_t / sec_per_sweep)
+                        if fit < 1:
+                            break
+                        chunk = min(chunk, fit)
+                    elif remaining_t <= 0:
+                        break
+                    tp0 = time.monotonic()
+                    state = polish(pa, jax.random.fold_in(k_init, done),
+                                   state, chunk)
+                    pen = _fetch(state.penalty)
+                    tp1 = time.monotonic()
+                    _phase(out, cfg.trace, "polish", trial, tp1 - tp0,
+                           sweeps=chunk)
+                    if pwarm:
+                        sps = (tp1 - tp0) / chunk
+                        sec_per_sweep = (
+                            sps if sec_per_sweep is None
+                            else 0.7 * sps + 0.3 * sec_per_sweep)
+                        _SPS_CACHE[spg_key] = sec_per_sweep
+                    pwarm = True
+                    done += chunk
+                    cur_sum = int(pen.astype(np.int64).sum())
+                    if prev_sum is not None and cur_sum >= prev_sum:
+                        break
+                    prev_sum = cur_sum
         if best_seen is None:
             best_seen = [INT_MAX] * n_islands
 
@@ -330,16 +483,17 @@ def _run_tries(cfg: RunConfig, out) -> int:
             key, k_epoch = jax.random.split(key)
             if dyn_gens is not None:
                 runner, warm = cached_dynamic_runner(
-                    mesh, gacfg, cfg.migration_period)
+                    mesh, gacfg, cfg.migration_period, sig)
                 td0 = time.monotonic()
                 state, trace, _gbest = runner(pa, k_epoch, state, dyn_gens)
-                trace = np.asarray(trace)[:, :, :dyn_gens]
+                trace = _fetch(trace)[:, :, :dyn_gens]
                 gens_run = dyn_gens
             else:
-                runner, warm = cached_runner(mesh, gacfg, n_ep, gens)
+                runner, warm = cached_runner(mesh, gacfg, n_ep, gens,
+                                              sig)
                 td0 = time.monotonic()
                 state, trace, _gbest = runner(pa, k_epoch, state)
-                trace = np.asarray(trace)      # blocks on the dispatch
+                trace = _fetch(trace)          # blocks on the dispatch
                 gens_run = n_ep * gens
             td1 = time.monotonic()
             _phase(out, cfg.trace, "dispatch", trial, td1 - td0,
@@ -381,10 +535,10 @@ def _run_tries(cfg: RunConfig, out) -> int:
         # final per-island solution records (endTry, ga.cpp:169-197)
         t = time.monotonic()
         P = cfg.pop_size
-        slots = np.asarray(state.slots).reshape(n_islands, P, -1)
-        rooms = np.asarray(state.rooms).reshape(n_islands, P, -1)
-        hcv = np.asarray(state.hcv).reshape(n_islands, P)[:, 0]
-        scv = np.asarray(state.scv).reshape(n_islands, P)[:, 0]
+        slots = _fetch(state.slots).reshape(n_islands, P, -1)
+        rooms = _fetch(state.rooms).reshape(n_islands, P, -1)
+        hcv = _fetch(state.hcv).reshape(n_islands, P)[:, 0]
+        scv = _fetch(state.scv).reshape(n_islands, P)[:, 0]
         _phase(out, cfg.trace, "fetch", trial, time.monotonic() - t)
         total_time = time.monotonic() - t_try
         for i in range(n_islands):

@@ -105,6 +105,7 @@ def tpu_config(tim_path: str, budget: float, seed: int, tune: dict):
         ls_sweeps=tune["sweeps"], ls_converge=True,
         init_sweeps=tune["init_sweeps"],
         ls_swap_block=tune["swap_block"],
+        ls_block_events=tune.get("block_events", 1),
         epochs_per_dispatch=tune["epochs_per_dispatch"])
 
 

@@ -1,0 +1,50 @@
+"""One-off tuning probe: TPU-only quality-at-budget on one instance for a
+grid of (pop, sweeps, swap_block, migration_period) configs, using the
+race harness's exact warm/timed flow. Emits one JSON line per config.
+
+Usage: python tools/tune_probe.py <instance> <budget> [seed]
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.quality_race import make_instances, run_tpu, warm_tpu  # noqa: E402
+
+
+GRID = [
+    # block_events > 1: E/B-depth sweep passes — many more passes per
+    # second at 1/B acceptance density per pass
+    dict(pop=1024, sweeps=4, init_sweeps=200, swap_block=8,
+         block_events=8, migration_period=2, epochs_per_dispatch=1),
+    dict(pop=512, sweeps=8, init_sweeps=400, swap_block=16,
+         block_events=16, migration_period=2, epochs_per_dispatch=1),
+    dict(pop=1024, sweeps=2, init_sweeps=100, swap_block=32,
+         block_events=8, migration_period=2, epochs_per_dispatch=1),
+    dict(pop=256, sweeps=16, init_sweeps=800, swap_block=16,
+         block_events=32, migration_period=2, epochs_per_dispatch=1),
+]
+
+
+def main():
+    name = sys.argv[1] if len(sys.argv) > 1 else "medium"
+    budget = float(sys.argv[2]) if len(sys.argv) > 2 else 60.0
+    seed = int(sys.argv[3]) if len(sys.argv) > 3 else 42
+    from timetabling_ga_tpu.problem import dump_tim
+    [(_name, problem)] = make_instances({name})
+    with tempfile.NamedTemporaryFile("w", suffix=".tim",
+                                     delete=False) as fh:
+        fh.write(dump_tim(problem))
+        path = fh.name
+    for tune in GRID:
+        warm_tpu(path, budget, seed, tune)
+        r = run_tpu(path, budget, seed, tune)
+        print(json.dumps({"instance": name, **r}), flush=True)
+    os.unlink(path)
+
+
+if __name__ == "__main__":
+    main()
