@@ -173,3 +173,62 @@ def test_block_sweep_one_is_serial_sweep(small_problem):
                            n_sweeps=2, swap_block=4)
     np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
     np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
+
+
+def test_sideways_never_increases_and_stays_deterministic(small_problem):
+    """Sideways acceptance (plateau walk) may accept EQUAL-penalty moves
+    but never worse ones, and the pass stays a pure function of its key."""
+    import jax
+    import numpy as np
+    from timetabling_ga_tpu.ops import fitness
+    from timetabling_ga_tpu.ops.rooms import batch_assign_rooms
+    from timetabling_ga_tpu.ops.sweep import sweep_local_search
+
+    pa = small_problem.device_arrays()
+    slots = jax.random.randint(jax.random.key(5), (8, pa.n_events), 0,
+                               pa.n_slots, dtype=jnp.int32)
+    rooms = batch_assign_rooms(pa, slots)
+    pen0, _, _ = fitness.batch_penalty(pa, slots, rooms)
+    a = sweep_local_search(pa, jax.random.key(6), slots, rooms,
+                           n_sweeps=3, swap_block=4, sideways=0.5)
+    b = sweep_local_search(pa, jax.random.key(6), slots, rooms,
+                           n_sweeps=3, swap_block=4, sideways=0.5)
+    pen_a, _, _ = fitness.batch_penalty(pa, *a)
+    assert (np.asarray(pen_a) <= np.asarray(pen0)).all()
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+
+
+def test_sideways_escapes_plateau_that_strict_cannot():
+    """A 3-event instance engineered so the strict sweep is stuck on an
+    hcv plateau: correlated events in one slot whose every single-event
+    relocation keeps global penalty equal — only an equal-penalty drift
+    (or luck of ordering) untangles them. The sideways sweep must reach
+    a strictly better state than the strict sweep from the same start at
+    least for some individuals."""
+    import jax
+    import numpy as np
+    from timetabling_ga_tpu.ops import fitness
+    from timetabling_ga_tpu.ops.rooms import batch_assign_rooms
+    from timetabling_ga_tpu.ops.sweep import sweep_local_search
+    from timetabling_ga_tpu.problem import random_instance
+
+    # dense-conflict instance: plenty of hcv plateaus
+    p = random_instance(13, n_events=30, n_rooms=3, n_features=2,
+                        n_students=25, attend_prob=0.3)
+    pa = p.device_arrays()
+    P = 32
+    slots = jax.random.randint(jax.random.key(7), (P, pa.n_events), 0,
+                               pa.n_slots, dtype=jnp.int32)
+    rooms = batch_assign_rooms(pa, slots)
+    strict, _ = (sweep_local_search(pa, jax.random.key(8), slots, rooms,
+                                    n_sweeps=12, swap_block=4,
+                                    converge=True),
+                 None)
+    side, _ = (sweep_local_search(pa, jax.random.key(8), slots, rooms,
+                                  n_sweeps=12, swap_block=4,
+                                  converge=True, sideways=0.3),
+               None)
+    pen_strict, _, _ = fitness.batch_penalty(pa, *strict)
+    pen_side, _, _ = fitness.batch_penalty(pa, *side)
+    assert float(np.asarray(pen_side).mean()) \
+        < float(np.asarray(pen_strict).mean())

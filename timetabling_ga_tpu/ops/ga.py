@@ -60,6 +60,11 @@ class GAConfig:
     #                               depth E/B, best single move of the
     #                               block applied — throughput/density
     #                               trade, ops/sweep.py sweep_pass)
+    ls_sideways: float = 0.0      # P(accept equal-penalty best) per
+    #                               individual per sweep step — plateau
+    #                               walk, the global analogue of the
+    #                               reference's event-local acceptance
+    #                               (Solution.cpp:519-527)
     ls_converge: bool = False     # sweep passes early-exit at the whole-
     #                               population local optimum (the
     #                               reference's stopping rule,
@@ -123,7 +128,7 @@ def init_population(pa, key, pop_size: int,
         slots, rooms_arr = sweep_local_search(
             pa, k_ls, slots, rooms_arr, n_sweeps=cfg.init_sweeps,
             swap_block=cfg.ls_swap_block, converge=True,
-            block_events=cfg.ls_block_events)
+            block_events=cfg.ls_block_events, sideways=cfg.ls_sideways)
     return evaluate(pa, slots, rooms_arr)
 
 
@@ -207,7 +212,8 @@ def generation(pa, key, state: PopState, cfg: GAConfig) -> PopState:
         ch_slots, ch_rooms = sweep_local_search(
             pa, k_ls, ch_slots, ch_rooms,
             n_sweeps=cfg.ls_sweeps, swap_block=cfg.ls_swap_block,
-            converge=cfg.ls_converge, block_events=cfg.ls_block_events)
+            converge=cfg.ls_converge, block_events=cfg.ls_block_events,
+            sideways=cfg.ls_sideways)
     elif cfg.ls_steps > 0:
         if cfg.ls_delta:
             from timetabling_ga_tpu.ops.delta import (

@@ -142,7 +142,7 @@ def _distinct_pad(e1, e2, E: int):
 
 
 def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
-               block_events: int = 1):
+               block_events: int = 1, sideways: float = 0.0):
     """One full sweep pass over all events (shuffled per individual).
 
     `block_events` = events examined per scan step. With 1 (default)
@@ -171,7 +171,8 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
     B = min(max(block_events, 1), E)
     n_steps = (E + B - 1) // B
 
-    perm_keys = jax.random.split(key, P)
+    k_perm, k_tie, k_side = jax.random.split(key, 3)
+    perm_keys = jax.random.split(k_perm, P)
     perms = jax.vmap(
         lambda k: jax.random.permutation(k, E).astype(jnp.int32))(perm_keys)
 
@@ -250,10 +251,34 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
         new_scv = st.scv[:, None] + cand_ds
         new_pen = jnp.where(new_hcv == 0, new_scv,
                             fitness.INFEASIBLE_OFFSET + new_hcv)
-        best = jnp.argmin(new_pen, axis=1)                 # (P,)
         ar = jnp.arange(P)
-        best_pen = new_pen[ar, best]
-        better = best_pen < st.pen
+        if sideways > 0.0:
+            # PLATEAU WALK: the reference's phase-1 acceptance is
+            # event-LOCAL (eventAffectedHcv, Solution.cpp:519-527), so
+            # it takes globally-neutral moves and drifts across hcv
+            # plateaus; strict global-improvement acceptance gets stuck
+            # there (measured: hcv stalls at ~3 pure correlation
+            # clashes on comp05s). Equivalent capability here: among the
+            # candidates achieving the row-minimum penalty, pick one at
+            # RANDOM (the min and the tie test stay in exact integer
+            # arithmetic — float noise added to the penalty itself would
+            # merge adjacent integers at the 1e6 infeasible offset,
+            # float32 ulp there is 0.0625), and accept an equal-penalty
+            # best with probability `sideways` per individual per step.
+            noise = jax.random.uniform(
+                jax.random.fold_in(k_tie, pos), new_pen.shape)
+            row_min = new_pen.min(axis=1, keepdims=True)
+            best = jnp.argmax(
+                jnp.where(new_pen == row_min, noise, -1.0), axis=1)
+            best_pen = new_pen[ar, best]
+            allow = jax.random.bernoulli(
+                jax.random.fold_in(k_side, pos), sideways, (P,))
+            strict = best_pen < st.pen
+            better = strict | (allow & (best_pen == st.pen))
+        else:
+            best = jnp.argmin(new_pen, axis=1)             # (P,)
+            best_pen = new_pen[ar, best]
+            better = strict = best_pen < st.pen
 
         def apply_or_keep(b, s, r, att, occ, e3, ns3, nr3):
             s2, r2, att2, occ2 = _apply_move(pa, (s, r, att, occ),
@@ -270,7 +295,9 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
             pen=jnp.where(better, best_pen, st.pen),
             hcv=jnp.where(better, new_hcv[ar, best], st.hcv),
             scv=jnp.where(better, new_scv[ar, best], st.scv))
-        return st, better.any()
+        # `improved` counts only STRICT improvements: sideways accepts
+        # must not keep the convergence loop alive forever
+        return st, strict.any()
 
     state, accepted = lax.scan(step, state, jnp.arange(n_steps))
     return state, accepted.any()
@@ -278,7 +305,7 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
 
 def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
                        swap_block: int = 8, converge: bool = False,
-                       block_events: int = 1):
+                       block_events: int = 1, sideways: float = 0.0):
     """Run up to `n_sweeps` full sweep passes over a (P, E) population.
 
     Candidate budget per pass per individual: E * (T + swap_block)
@@ -307,7 +334,7 @@ def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
         def body(carry):
             st, i, _ = carry
             st, improved = sweep_pass(pa, jax.random.fold_in(key, i), st,
-                                      swap_block, block_events)
+                                      swap_block, block_events, sideways)
             return st, i + 1, improved
 
         state, _, _ = lax.while_loop(
@@ -315,7 +342,7 @@ def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
     else:
         def one(st, i):
             st, _ = sweep_pass(pa, jax.random.fold_in(key, i), st,
-                               swap_block, block_events)
+                               swap_block, block_events, sideways)
             return st, None
 
         state, _ = lax.scan(one, state, jnp.arange(n_sweeps))
@@ -324,9 +351,9 @@ def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
 
 @functools.partial(jax.jit,
                    static_argnames=("n_sweeps", "swap_block", "converge",
-                                    "block_events"))
+                                    "block_events", "sideways"))
 def jit_sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
                            swap_block: int = 8, converge: bool = False,
-                           block_events: int = 1):
+                           block_events: int = 1, sideways: float = 0.0):
     return sweep_local_search(pa, key, slots, rooms_arr, n_sweeps,
-                              swap_block, converge, block_events)
+                              swap_block, converge, block_events, sideways)

@@ -189,7 +189,7 @@ def make_polish_runner(mesh: Mesh, cfg: ga.GAConfig):
         slots, rooms = sweep_local_search(
             pa, my_key, state.slots, state.rooms, n_sweeps=n_sweeps,
             swap_block=cfg.ls_swap_block, converge=True,
-            block_events=cfg.ls_block_events)
+            block_events=cfg.ls_block_events, sideways=cfg.ls_sideways)
         return ga.evaluate(pa, slots, rooms)
 
     return jax.jit(_polish)

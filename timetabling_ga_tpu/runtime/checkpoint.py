@@ -39,7 +39,7 @@ def config_fingerprint(problem, cfg, n_islands: int) -> str:
             f"x{cfg.p_crossover}m{cfg.p_mutation}"
             f"|ls{cfg.ls_steps}c{cfg.ls_candidates}o{cfg.ls_mode}"
             f"w{cfg.ls_sweeps}b{cfg.ls_swap_block}"
-            f"e{cfg.ls_block_events}"
+            f"e{cfg.ls_block_events}y{cfg.ls_sideways}"
             f"g{int(cfg.ls_converge)}i{cfg.init_sweeps}"
             f"r{cfg.rooms_mode}"
             f"|I{n_islands}")

@@ -80,6 +80,7 @@ class RunConfig:
     ls_sweeps: int = 1
     ls_swap_block: int = 8
     ls_block_events: int = 1  # events per sweep scan step (see GAConfig)
+    ls_sideways: float = 0.0  # P(accept equal-penalty move): plateau walk
     ls_converge: bool = False  # sweep LS early-exits at the population-
     #                            wide local optimum (reference stopping
     #                            rule); ls_sweeps becomes the hard bound
@@ -101,10 +102,47 @@ class RunConfig:
     coordinator: Optional[str] = None  # host:port of process 0
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
+    auto_tune: bool = True    # apply size-tuned solver defaults to any
+    #                           field the user left untouched (see
+    #                           apply_tuned_defaults); --no-auto-tune
+    #                           keeps the raw dataclass defaults
 
     def resolved_seed(self) -> int:
         # reference default: time(NULL) (Control.cpp:129-136)
         return int(time.time()) if self.seed is None else self.seed
+
+    def apply_tuned_defaults(self, n_events: int) -> "RunConfig":
+        """Size-tuned solver parameters (VERDICT round-2 item 8: defaults
+        decided by measured solver outcome, not kernel time).
+
+        The reference scales its LS budget with problem type the same
+        way (-p 1/2/3 -> maxSteps 200/1000/2000, ga.cpp:389-397); here
+        the knob set is (pop, LS depth, dispatch granularity), measured
+        in the round-3 quality races:
+          - small instances (E <= 200) win with a modest population and
+            DEEP per-child sweeps (pop 128, 6 convergence-bounded passes
+            per child);
+          - comp-scale instances (E > 200) win with a parallel
+            multistart (pop 256) polished toward its fixed point (the
+            long init_sweeps bound; the engine's stall detector ends the
+            polish when the penalty sum stops dropping), then evolved
+            with moderate per-child sweeps.
+        Returns self (mutated) for chaining; only fields the user left
+        at their dataclass defaults are touched."""
+        d = RunConfig()
+        tuned = (dict(pop_size=128, ls_sweeps=6, init_sweeps=30,
+                      ls_swap_block=8, migration_period=10)
+                 if n_events <= 200 else
+                 dict(pop_size=256, ls_sweeps=2, init_sweeps=200,
+                      ls_swap_block=8, migration_period=2))
+        # plateau-walking acceptance: measured to take comp05s from
+        # never-feasible (hcv stuck at 3 — pure correlation clashes) to
+        # feasible in ~24 s; see ops/sweep.py sweep_pass
+        tuned.update(ls_mode="sweep", ls_converge=True, ls_sideways=0.25)
+        for field, value in tuned.items():
+            if getattr(self, field) == getattr(d, field):
+                setattr(self, field, value)
+        return self
 
     def resolved_max_steps(self) -> int:
         """LS budget by problem type (ga.cpp:389-397) unless -m given."""
@@ -136,6 +174,7 @@ _FLAG_MAP = {
     "--ls-sweeps": ("ls_sweeps", int),
     "--ls-swap-block": ("ls_swap_block", int),
     "--ls-block-events": ("ls_block_events", int),
+    "--ls-sideways": ("ls_sideways", float),
     "--init-sweeps": ("init_sweeps", int),
     "--rooms-mode": ("rooms_mode", str),
     "--checkpoint": ("checkpoint", str),
@@ -150,6 +189,7 @@ _BOOL_FLAGS = {"--resume": "resume", "--nsga2": "nsga2",
                "--ls-full-eval": "ls_full_eval", "--trace": "trace",
                "--ls-converge": "ls_converge",
                "--distributed": "distributed"}
+_NEG_BOOL_FLAGS = {"--no-auto-tune": "auto_tune"}
 
 
 def parse_args(argv) -> RunConfig:
@@ -163,6 +203,10 @@ def parse_args(argv) -> RunConfig:
         a = argv[i]
         if a in _BOOL_FLAGS:
             setattr(cfg, _BOOL_FLAGS[a], True)
+            i += 1
+            continue
+        if a in _NEG_BOOL_FLAGS:
+            setattr(cfg, _NEG_BOOL_FLAGS[a], False)
             i += 1
             continue
         if a not in _FLAG_MAP:

@@ -157,6 +157,7 @@ def build_ga_config(cfg: RunConfig) -> ga.GAConfig:
         ls_mode=cfg.ls_mode, ls_sweeps=cfg.ls_sweeps,
         ls_swap_block=cfg.ls_swap_block,
         ls_block_events=cfg.ls_block_events,
+        ls_sideways=cfg.ls_sideways,
         ls_converge=cfg.ls_converge, init_sweeps=cfg.init_sweeps,
         rooms_mode=cfg.rooms_mode,
         multi_objective=cfg.nsga2,
@@ -211,6 +212,12 @@ def _setup(cfg: RunConfig):
     compiled-program and sec/gen caches are keyed on them), so both call
     this one helper."""
     problem = load_tim_file(cfg.input)
+    if cfg.auto_tune:
+        # production defaults are size-tuned (the reference scales its
+        # LS budget with problem type the same way, ga.cpp:389-397);
+        # explicit user flags and non-default fields are never touched,
+        # and a second call is a no-op (tuned values are non-default)
+        cfg.apply_tuned_defaults(problem.n_events)
     pa = problem.device_arrays()
     devices = jax.devices()
     n_islands = cfg.islands if cfg.islands is not None else len(devices)
@@ -394,17 +401,23 @@ def _run_tries(cfg: RunConfig, out) -> int:
             # fixed point (penalty sum stops dropping — convergence
             # inside a chunk implies the next chunk is a no-op), or when
             # the next chunk is predicted not to fit the time budget.
+            if best_seen is None:
+                best_seen = [INT_MAX] * n_islands
             if gacfg.init_sweeps > 0:
                 polish, pwarm = cached_polish_runner(mesh, gacfg, sig)
                 sec_per_sweep = _SPS_CACHE.get(spg_key)
                 done = 0
                 prev_sum = None
+                stalls = 0
                 while done < gacfg.init_sweeps:
                     remaining_t = (cfg.time_limit
                                    - (time.monotonic() - t_try))
                     chunk = min(4, gacfg.init_sweeps - done)
                     if sec_per_sweep is not None and sec_per_sweep > 0:
-                        fit = int(remaining_t / sec_per_sweep)
+                        # 1.25 safety factor: a converge chunk's cost
+                        # varies with how many passes actually run, and
+                        # an underestimate here is a budget overshoot
+                        fit = int(remaining_t / (1.25 * sec_per_sweep))
                         if fit < 1:
                             break
                         chunk = min(chunk, fit)
@@ -425,9 +438,29 @@ def _run_tries(cfg: RunConfig, out) -> int:
                         _SPS_CACHE[spg_key] = sec_per_sweep
                     pwarm = True
                     done += chunk
+                    # polish improvements feed the logEntry stream too:
+                    # reaching feasibility during the initial LS must be
+                    # visible to time-to-feasible measurement (the
+                    # reference logs its init LS bests the same way,
+                    # ga.cpp:203-228 fires on any new local best)
+                    hcv_a = _fetch(state.hcv).reshape(n_islands, -1)
+                    scv_a = _fetch(state.scv).reshape(n_islands, -1)
+                    for i in range(n_islands):
+                        rep = jsonl.reported_best(hcv_a[i, 0], scv_a[i, 0])
+                        if rep < best_seen[i]:
+                            best_seen[i] = rep
+                            jsonl.log_entry(out, i, 0, rep,
+                                            tp1 - t_try)
                     cur_sum = int(pen.astype(np.int64).sum())
                     if prev_sum is not None and cur_sum >= prev_sum:
-                        break
+                        # with sideways acceptance a flat chunk may be a
+                        # plateau walk, not the fixed point — allow one
+                        # more chunk before concluding convergence
+                        stalls += 1
+                        if stalls >= 2 or gacfg.ls_sideways == 0.0:
+                            break
+                    else:
+                        stalls = 0
                     prev_sum = cur_sum
         if best_seen is None:
             best_seen = [INT_MAX] * n_islands

@@ -95,40 +95,57 @@ def run_cpu_baseline(tim_path: str, budget: float, seed: int) -> dict:
             "wall_s": round(dt, 1), "threads": threads}
 
 
-def tpu_config(tim_path: str, budget: float, seed: int, tune: dict):
+_TUNE_FIELDS = {"pop": "pop_size", "sweeps": "ls_sweeps",
+                "init_sweeps": "init_sweeps",
+                "swap_block": "ls_swap_block",
+                "migration_period": "migration_period",
+                "block_events": "ls_block_events",
+                "sideways": "ls_sideways",
+                "epochs_per_dispatch": "epochs_per_dispatch"}
+
+
+def tpu_config(tim_path: str, budget: float, seed: int, tune: dict,
+               n_events: int):
+    """Explicit --pop/--sweeps/... flags win; anything left unset takes
+    the size-tuned solver defaults (RunConfig.apply_tuned_defaults, the
+    production rule — so the race measures the SHIPPED configuration
+    unless the operator overrides it)."""
     from timetabling_ga_tpu.runtime.config import RunConfig
-    return RunConfig(
-        input=tim_path, seed=seed, islands=1,
-        pop_size=tune["pop"], generations=10 ** 9,
-        migration_period=tune["migration_period"],
-        time_limit=budget, ls_mode="sweep",
-        ls_sweeps=tune["sweeps"], ls_converge=True,
-        init_sweeps=tune["init_sweeps"],
-        ls_swap_block=tune["swap_block"],
-        ls_block_events=tune.get("block_events", 1),
-        epochs_per_dispatch=tune["epochs_per_dispatch"])
+    cfg = RunConfig(input=tim_path, seed=seed, islands=1,
+                    generations=10 ** 9, time_limit=budget)
+    # tuned defaults FIRST, explicit flags after — the other order would
+    # drop an explicit flag whose value coincides with the dataclass
+    # default (apply_tuned_defaults cannot tell those apart)
+    cfg.apply_tuned_defaults(n_events)
+    for k, field in _TUNE_FIELDS.items():
+        if tune.get(k) is not None:
+            setattr(cfg, field, tune[k])
+    return cfg
 
 
-def warm_tpu(tim_path: str, budget: float, seed: int, tune: dict):
+def warm_tpu(tim_path: str, budget: float, seed: int, tune: dict,
+             n_events: int):
     """Compile + measure outside the budget via engine.precompile: every
     program a timed run can dispatch (init, epoch runner, dynamic tail
     runner) lands in the module-level caches, and the seconds-per-
     generation estimate is seeded from a clean post-compile dispatch."""
     from timetabling_ga_tpu.runtime import engine
-    engine.precompile(tpu_config(tim_path, budget, seed, tune))
+    engine.precompile(tpu_config(tim_path, budget, seed, tune, n_events))
 
 
-def run_tpu(tim_path: str, budget: float, seed: int, tune: dict) -> dict:
+def run_tpu(tim_path: str, budget: float, seed: int, tune: dict,
+            n_events: int) -> dict:
     from timetabling_ga_tpu.runtime import engine
-    cfg = tpu_config(tim_path, budget, seed, tune)
+    cfg = tpu_config(tim_path, budget, seed, tune, n_events)
     buf = io.StringIO()
     t0 = time.perf_counter()
     best = engine.run(cfg, out=buf)
     dt = time.perf_counter() - t0
     lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    used = {k: getattr(cfg, field) for k, field in _TUNE_FIELDS.items()}
     return {"best": best, "feasible": best < 1_000_000,
             "time_to_feasible_s": _first_feasible_time(lines),
-            "wall_s": round(dt, 1), **tune}
+            "wall_s": round(dt, 1), **used}
 
 
 def main():
@@ -152,12 +169,14 @@ def main():
     elif "--quick" in argv:
         names = {"small", "small-tight"}
     tune = {
-        "pop": opt("--pop", 128, int),
-        "sweeps": opt("--sweeps", 6, int),
-        "init_sweeps": opt("--init-sweeps", 30, int),
-        "swap_block": opt("--swap-block", 8, int),
-        "migration_period": opt("--migration-period", 10, int),
-        "epochs_per_dispatch": opt("--epochs-per-dispatch", 1, int),
+        "pop": opt("--pop", None, int),
+        "sweeps": opt("--sweeps", None, int),
+        "init_sweeps": opt("--init-sweeps", None, int),
+        "swap_block": opt("--swap-block", None, int),
+        "migration_period": opt("--migration-period", None, int),
+        "block_events": opt("--block-events", None, int),
+        "sideways": opt("--sideways", None, float),
+        "epochs_per_dispatch": opt("--epochs-per-dispatch", None, int),
     }
     do_cpu = "--no-cpu" not in argv
 
@@ -168,11 +187,11 @@ def main():
                 "w", suffix=".tim", delete=False) as fh:
             fh.write(dump_tim(problem))
             tim_path = fh.name
-        warm_tpu(tim_path, budget, seeds[0], tune)
+        warm_tpu(tim_path, budget, seeds[0], tune, problem.n_events)
         for seed in seeds:
             cpu = (run_cpu_baseline(tim_path, budget, seed)
                    if do_cpu else None)
-            tpu = run_tpu(tim_path, budget, seed, tune)
+            tpu = run_tpu(tim_path, budget, seed, tune, problem.n_events)
             row = {"instance": name, "budget_s": budget, "seed": seed,
                    "cpu": cpu, "tpu": tpu}
             if cpu is not None:

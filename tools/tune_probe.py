@@ -40,8 +40,8 @@ def main():
         fh.write(dump_tim(problem))
         path = fh.name
     for tune in GRID:
-        warm_tpu(path, budget, seed, tune)
-        r = run_tpu(path, budget, seed, tune)
+        warm_tpu(path, budget, seed, tune, problem.n_events)
+        r = run_tpu(path, budget, seed, tune, problem.n_events)
         print(json.dumps({"instance": name, **r}), flush=True)
     os.unlink(path)
 
