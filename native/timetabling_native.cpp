@@ -960,8 +960,13 @@ static std::vector<Individual> run_islands(
 
     // ring migration (serial; the collectives' barrier semantics):
     // snapshot emigrants first so the exchange reads pre-migration
-    // populations, like lax.ppermute of row 0 fwd / row 1 bwd
-    if (N > 1) {
+    // populations, like lax.ppermute of row 0 fwd / row 1 bwd.
+    // P < 3 skips migration entirely — a victim row would alias the
+    // BEST row (at P == 1 the island's only individual would be
+    // destroyed, and pop[1] does not even exist; at P == 2 the
+    // backward immigrant lands on pop[0]), matching the TPU path's
+    // guard (parallel/islands.py _migrate)
+    if (N > 1 && P >= 3) {
       std::vector<Individual> fwd(N), bwd(N);
       for (int is = 0; is < N; ++is) {
         fwd[is] = isl[is].pop[0];
@@ -969,7 +974,7 @@ static std::vector<Individual> run_islands(
       }
       for (int is = 0; is < N; ++is) {
         isl[is].pop[P - 1] = fwd[(is - 1 + N) % N];
-        if (P >= 2) isl[is].pop[P - 2] = bwd[(is + 1) % N];
+        isl[is].pop[P - 2] = bwd[(is + 1) % N];
         std::sort(isl[is].pop.begin(), isl[is].pop.end(), by_pen);
       }
     }

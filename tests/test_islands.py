@@ -21,6 +21,25 @@ N_ISLANDS = 8
 POP = 8
 
 
+def migrate_sharded(mesh, state):
+    """Run islands._migrate under shard_map with the canonical PopState
+    sharding (shared by every migration test in this file)."""
+    import functools
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    spec = ga.PopState(slots=P(islands.AXIS), rooms=P(islands.AXIS),
+                       penalty=P(islands.AXIS), hcv=P(islands.AXIS),
+                       scv=P(islands.AXIS))
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
+                       out_specs=spec)
+    def do_migrate(st):
+        return islands._migrate(st, N_ISLANDS)
+
+    return do_migrate(state)
+
+
 @pytest.fixture(scope="module")
 def mesh():
     assert len(jax.devices()) >= N_ISLANDS
@@ -54,14 +73,6 @@ def test_migration_topology(island_setup, mesh):
     island (i-1)'s best, its 2nd-worst receives island (i+1)'s 2nd best
     (ga.cpp:522-535 bidirectional ring)."""
     problem, pa, state = island_setup
-    from jax.sharding import PartitionSpec as P
-    from jax import shard_map
-    import functools
-
-    spec = ga.PopState(slots=P(islands.AXIS), rooms=P(islands.AXIS),
-                       penalty=P(islands.AXIS), hcv=P(islands.AXIS),
-                       scv=P(islands.AXIS))
-
     # Give island i best-penalty 1000+i and 2nd-best 2000+i so migrants
     # are identifiable after the exchange. (Penalties are only labels
     # here; _migrate moves rows by penalty order.)
@@ -73,12 +84,7 @@ def test_migration_topology(island_setup, mesh):
         pen[i, 2:] = 3_000_000 + np.arange(POP - 2)
     state = state._replace(penalty=jnp.asarray(pen.reshape(-1)))
 
-    @functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
-                       out_specs=spec)
-    def do_migrate(st):
-        return islands._migrate(st, N_ISLANDS)
-
-    out = do_migrate(state)
+    out = migrate_sharded(mesh, state)
     pen_out = np.asarray(out.penalty).reshape(N_ISLANDS, POP)
     for i in range(N_ISLANDS):
         got = set(pen_out[i].tolist())
@@ -156,3 +162,18 @@ def test_dynamic_runner_migrates(island_setup, mesh):
     inp_set = {r.tobytes() for r in inp}
     for r in outp:
         assert r.tobytes() in inp_set
+
+
+def test_migration_skipped_for_tiny_population(island_setup, mesh):
+    """P < 3 skips migration: a victim row would alias the island's
+    BEST row (at P == 1 both writes would destroy its only individual —
+    ADVICE round 3). The populations must come through unchanged."""
+    problem, pa, _ = island_setup
+    for tiny_pop in (1, 2):
+        state = islands.init_island_population(
+            pa, jax.random.key(5), mesh, tiny_pop)
+        out = migrate_sharded(mesh, state)
+        assert np.array_equal(np.asarray(out.slots),
+                              np.asarray(state.slots))
+        assert np.array_equal(np.asarray(out.penalty),
+                              np.asarray(state.penalty))

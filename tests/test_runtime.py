@@ -388,3 +388,38 @@ def test_apply_tuned_defaults_size_rule_and_overrides():
                      ls_sweeps=3).apply_tuned_defaults(400)
     assert mine.pop_size == 64 and mine.ls_sweeps == 3
     assert mine.init_sweeps == 200  # untouched field still tuned
+
+
+def test_explicit_flags_survive_auto_tune():
+    """A flag the user EXPLICITLY set to a value that happens to equal
+    the dataclass default must survive apply_tuned_defaults (ADVICE
+    round 3: value-vs-default comparison alone cannot distinguish
+    'unset' from 'explicitly default')."""
+    from timetabling_ga_tpu.runtime.config import parse_args
+    cfg = parse_args(["-i", "x.tim", "--ls-mode", "random",
+                      "--ls-sweeps", "1", "--ls-sideways", "0"])
+    cfg.apply_tuned_defaults(400)
+    assert cfg.ls_mode == "random"      # not overridden to "sweep"
+    assert cfg.ls_sweeps == 1           # not overridden to 2
+    assert cfg.ls_sideways == 0.0       # not overridden to 0.25
+    assert cfg.pop_size == 256          # untouched field still tuned
+
+
+def test_tpu_path_thread_id_is_zero(tim_file):
+    """threadID := 0 on the TPU path, by definition (runtime/jsonl.py
+    module docstring): island breeding is one fused vmap with no thread
+    identity. The protocol field stays (schema parity) pinned at 0."""
+    import io
+    from timetabling_ga_tpu.runtime import engine
+    from timetabling_ga_tpu.runtime.config import RunConfig
+    buf = io.StringIO()
+    cfg = RunConfig(input=tim_file, seed=7, generations=12, islands=2,
+                    pop_size=8, auto_tune=False, ls_mode="sweep",
+                    ls_sweeps=1, init_sweeps=2)
+    engine.run(cfg, out=buf)
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    entries = [x["logEntry"] for x in lines if "logEntry" in x]
+    assert entries, "expected at least one logEntry"
+    assert all(e["threadID"] == 0 for e in entries)
+    sols = [x["solution"] for x in lines if "solution" in x]
+    assert all(s["threadID"] == 0 for s in sols)

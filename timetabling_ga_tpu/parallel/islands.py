@@ -91,7 +91,20 @@ def _migrate(state: ga.PopState, n_islands: int) -> ga.PopState:
     (ga.cpp:522-535); immigrants overwrite the two worst rows
     (ga.cpp:528, 535, deserialize target ga.cpp:344-346). The population
     is penalty-sorted (best first), so rows 0/1 are the emigrants and
-    rows -1/-2 the victims."""
+    rows -1/-2 the victims.
+
+    Populations smaller than 3 skip migration entirely: with P <= 2 a
+    victim row aliases the BEST row (at P == 1 both writes land on the
+    island's only individual; at P == 2 the backward immigrant lands on
+    row 0), so migration would destroy the island's best (ADVICE round
+    3). At P == 3 row 1 is both an emigrant and a victim, but emigrants
+    are snapshotted before the writes and rows 1-2 really are the two
+    worst of three — the reference's own semantics for that size
+    (ga.cpp:344-346) — so P == 3 migrates normally. The reference
+    itself never goes below popSize 10 (ga.cpp:64). The native twin
+    (tt_cpu --islands) applies the same P >= 3 guard."""
+    if state.penalty.shape[0] < 3:
+        return state
     fwd = [(i, (i + 1) % n_islands) for i in range(n_islands)]
     bwd = [(i, (i - 1) % n_islands) for i in range(n_islands)]
 

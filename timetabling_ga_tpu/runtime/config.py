@@ -106,6 +106,14 @@ class RunConfig:
     #                           field the user left untouched (see
     #                           apply_tuned_defaults); --no-auto-tune
     #                           keeps the raw dataclass defaults
+    # Fields the user EXPLICITLY set (parse_args records every flag it
+    # consumes here). apply_tuned_defaults never touches these, so an
+    # explicit `--ls-sweeps 1` or `--ls-mode random` wins even when the
+    # chosen value coincides with the dataclass default (ADVICE round 3:
+    # comparing against defaults alone cannot tell those apart).
+    # Programmatic construction can pass explicit_fields too; absent
+    # that, the value-differs-from-default rule still applies.
+    explicit_fields: frozenset = frozenset()
 
     def resolved_seed(self) -> int:
         # reference default: time(NULL) (Control.cpp:129-136)
@@ -140,7 +148,8 @@ class RunConfig:
         # feasible in ~24 s; see ops/sweep.py sweep_pass
         tuned.update(ls_mode="sweep", ls_converge=True, ls_sideways=0.25)
         for field, value in tuned.items():
-            if getattr(self, field) == getattr(d, field):
+            if (field not in self.explicit_fields
+                    and getattr(self, field) == getattr(d, field)):
                 setattr(self, field, value)
         return self
 
@@ -198,15 +207,18 @@ def parse_args(argv) -> RunConfig:
     Unknown flags raise; a missing `-i` raises like the reference's
     exit-on-missing-input (Control.cpp:36-39)."""
     cfg = RunConfig()
+    seen = set()
     i = 0
     while i < len(argv):
         a = argv[i]
         if a in _BOOL_FLAGS:
             setattr(cfg, _BOOL_FLAGS[a], True)
+            seen.add(_BOOL_FLAGS[a])
             i += 1
             continue
         if a in _NEG_BOOL_FLAGS:
             setattr(cfg, _NEG_BOOL_FLAGS[a], False)
+            seen.add(_NEG_BOOL_FLAGS[a])
             i += 1
             continue
         if a not in _FLAG_MAP:
@@ -215,7 +227,9 @@ def parse_args(argv) -> RunConfig:
             raise SystemExit(f"flag {a} needs a value")
         field, typ = _FLAG_MAP[a]
         setattr(cfg, field, typ(argv[i + 1]))
+        seen.add(field)
         i += 2
+    cfg.explicit_fields = frozenset(seen)
     if cfg.input is None:
         raise SystemExit("No instance file specified, use -i <file>")
     if cfg.backend not in ("tpu", "cpu"):

@@ -18,6 +18,15 @@ record types (SURVEY C18); field names verified against ga.cpp:
 
 This protocol is the reference's de-facto external API, so the schema is
 kept verbatim (keys, nesting, and which records appear when).
+
+threadID semantics on the TPU path: DEFINED AS 0. The reference's
+threadID names the OpenMP thread that bred the improving child
+(ga.cpp:203-228); on the TPU path the whole island's breeding is one
+fused vmap with no thread identity, and the logEntry values come from
+the island's penalty-sorted row 0, so there is no meaningful lane to
+report. The field is kept (schema parity) with the constant value 0.
+`tt_cpu --algo reference` emits real thread ids (its breeding IS
+threaded); tests/test_runtime.py pins the TPU-path constant.
 """
 
 from __future__ import annotations
