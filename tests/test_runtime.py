@@ -307,10 +307,14 @@ def test_engine_time_budget_holds(tim_file):
                     generations=10 ** 9, migration_period=50,
                     max_steps=8, time_limit=6.0, backend="cpu")
     eng.precompile(cfg)
+    # 1.05x + the measured endTry fetch reserve (VERDICT round-3 next
+    # #4: the budget must hold to ~5%, with the fetch inside it)
+    fetch = max(eng._FETCH_CACHE.values()) if eng._FETCH_CACHE else 1.0
     t0 = _time.monotonic()
     eng.run(cfg, out=io.StringIO())
     wall = _time.monotonic() - t0
-    assert wall < 6.0 * 1.5 + 2.0, f"budget 6s, ran {wall:.1f}s"
+    assert wall < 6.0 * 1.05 + fetch + 0.5, \
+        f"budget 6s (+{fetch:.2f}s fetch reserve), ran {wall:.1f}s"
 
 
 def test_time_to_feasible_guard(tim_file):
@@ -423,3 +427,105 @@ def test_tpu_path_thread_id_is_zero(tim_file):
     assert all(e["threadID"] == 0 for e in entries)
     sols = [x["solution"] for x in lines if "solution" in x]
     assert all(s["threadID"] == 0 for s in sols)
+
+
+def test_post_feasibility_phase_switch(tim_file):
+    """With post_* flags set, the engine must switch breeding configs at
+    the first dispatch boundary after the global best reaches
+    feasibility (the reference's phase-2 scv polish, Solution.cpp:
+    619-768): a --trace run shows the phase-switch record, and the run
+    still completes with a monotone logEntry stream."""
+    from timetabling_ga_tpu.runtime import engine as eng
+    buf = io.StringIO()
+    cfg = RunConfig(input=tim_file, seed=3, pop_size=16, islands=1,
+                    generations=120, migration_period=10,
+                    ls_mode="sweep", ls_sweeps=1, init_sweeps=0,
+                    ls_hot_k=4, post_ls_sweeps=2, post_hot_k=0,
+                    time_limit=120, backend="cpu", trace=True)
+    eng.run(cfg, out=buf)
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    phases = [x["phase"]["name"] for x in lines if "phase" in x]
+    feas = [x for x in lines
+            if "logEntry" in x and x["logEntry"]["best"] < 10 ** 6]
+    if feas:   # easy instance: expected to go feasible -> must switch
+        assert "phase-switch" in phases
+    bests = [x["logEntry"]["best"] for x in lines if "logEntry" in x]
+    assert bests == sorted(bests, reverse=True)
+    assert any("runEntry" in x for x in lines)
+
+
+def test_build_post_config_mapping():
+    """build_post_config: None when no post field is set or when the
+    post config would equal the base config; otherwise only the named
+    fields change."""
+    from timetabling_ga_tpu.runtime.engine import (build_ga_config,
+                                                   build_post_config)
+    base_cfg = RunConfig(input="x.tim", ls_mode="sweep", ls_sweeps=2,
+                         ls_hot_k=48)
+    g = build_ga_config(base_cfg)
+    assert build_post_config(base_cfg, g) is None
+    cfg2 = RunConfig(input="x.tim", ls_mode="sweep", ls_sweeps=2,
+                     ls_hot_k=48, post_hot_k=48)   # equal -> no switch
+    assert build_post_config(cfg2, build_ga_config(cfg2)) is None
+    cfg3 = RunConfig(input="x.tim", ls_mode="sweep", ls_sweeps=2,
+                     ls_hot_k=48, post_hot_k=0, post_ls_sweeps=4,
+                     post_swap_block=16)
+    p = build_post_config(cfg3, build_ga_config(cfg3))
+    assert p is not None
+    assert (p.ls_hot_k, p.ls_sweeps, p.ls_swap_block) == (0, 4, 16)
+    # untouched fields inherit
+    assert p.ls_mode == "sweep" and p.pop_size == cfg3.pop_size
+
+
+def test_distributed_two_process_run(tim_file, tmp_path):
+    """A REAL 2-process jax.distributed run (VERDICT round-3 next #5 —
+    the reference's mpirun actually exercised >1 rank, ga.cpp:373-380):
+    two CPU processes x 4 virtual devices each form one 8-island mesh.
+    Asserts both processes exit cleanly, process 1 emits NOTHING
+    (single-controller reporting), and process 0's protocol covers all
+    8 islands with procsNum=8 in the runEntry."""
+    import socket
+    import subprocess
+    import sys as _sys
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    outfile = str(tmp_path / "dist0.jsonl")
+
+    def proc(pid):
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4")
+        args = [_sys.executable, "-m", "timetabling_ga_tpu.cli",
+                "-i", tim_file, "-s", "9", "--backend", "cpu",
+                "--coordinator", f"localhost:{port}",
+                "--num-processes", "2", "--process-id", str(pid),
+                "--pop-size", "4", "--generations", "10",
+                "--migration-period", "5", "--no-auto-tune",
+                "--ls-mode", "sweep", "--ls-sweeps", "1",
+                "-m", "8", "-t", "600"]
+        if pid == 0:
+            args += ["-o", outfile]
+        return subprocess.Popen(args, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+
+    p0, p1 = proc(0), proc(1)
+    out0, err0 = p0.communicate(timeout=600)
+    out1, err1 = p1.communicate(timeout=120)
+    assert p0.returncode == 0, err0[-3000:]
+    assert p1.returncode == 0, err1[-3000:]
+    # single-controller reporting: only process 0 writes the protocol
+    # (process 1's stdout may carry collective-backend chatter like
+    # "[Gloo] Rank ..." — what matters is zero JSONL records)
+    assert not [ln for ln in out1.splitlines()
+                if ln.strip().startswith("{")], out1[:500]
+    lines = [json.loads(x) for x in open(outfile)]
+    kinds = [next(iter(x)) for x in lines]
+    assert kinds.count("solution") == 8     # one per island, global view
+    assert kinds.count("runEntry") == 2
+    final = lines[-1]["runEntry"]
+    assert final["procsNum"] == 8
+    # global best consistency across the allgathered view
+    sol_bests = [x["solution"]["totalBest"] for x in lines
+                 if "solution" in x]
+    assert min(sol_bests) == final["totalBest"]

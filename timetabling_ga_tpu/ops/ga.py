@@ -70,6 +70,11 @@ class GAConfig:
     #                               reference's stopping rule,
     #                               Solution.cpp:524/653); ls_sweeps is
     #                               then the hard bound
+    ls_hot_k: int = 0             # violation-guided sweep: examine only
+    #                               the top-K events by violation
+    #                               involvement per pass (the reference's
+    #                               phase-1/2 skip rule, Solution.cpp:
+    #                               501-505/628-633); 0 = all events
     init_sweeps: int = 0          # sweep-to-convergence passes on the
     #                               INITIAL population (the reference LS-
     #                               polishes its initial pop, ga.cpp:
@@ -128,7 +133,8 @@ def init_population(pa, key, pop_size: int,
         slots, rooms_arr = sweep_local_search(
             pa, k_ls, slots, rooms_arr, n_sweeps=cfg.init_sweeps,
             swap_block=cfg.ls_swap_block, converge=True,
-            block_events=cfg.ls_block_events, sideways=cfg.ls_sideways)
+            block_events=cfg.ls_block_events, sideways=cfg.ls_sideways,
+            hot_k=cfg.ls_hot_k, p3=cfg.p3)
     return evaluate(pa, slots, rooms_arr)
 
 
@@ -213,7 +219,7 @@ def generation(pa, key, state: PopState, cfg: GAConfig) -> PopState:
             pa, k_ls, ch_slots, ch_rooms,
             n_sweeps=cfg.ls_sweeps, swap_block=cfg.ls_swap_block,
             converge=cfg.ls_converge, block_events=cfg.ls_block_events,
-            sideways=cfg.ls_sideways)
+            sideways=cfg.ls_sideways, hot_k=cfg.ls_hot_k, p3=cfg.p3)
     elif cfg.ls_steps > 0:
         if cfg.ls_delta:
             from timetabling_ga_tpu.ops.delta import (

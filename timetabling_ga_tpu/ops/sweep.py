@@ -33,9 +33,17 @@ ordering any hcv reduction dominates while infeasible and any
 feasibility-breaking move is unacceptable once feasible.
 
 Move3 (3-cycles) is off by default in the reference (p3=0, Control.cpp:
-115-125) and is served by the random-candidate search (ops/local_search.py
-/ ops/delta.py); the sweep covers Move1+Move2, the moves the reference
-actually sweeps.
+115-125); with p3 > 0 the sweep adds 3-cycle candidates over adjacent
+Move2-partner pairs in both orientations (Solution.cpp:562-615), so the
+full Move1/2/3 surface is swept.
+
+Violation-guided pivot selection (`hot_k`): the reference's sweep skips
+events not implicated in any violation (phase 1 skips eventHcv(e)==0,
+Solution.cpp:501-505; phase 2 skips eventScv(e)==0, 628-633), so near
+feasibility its effective pass is over a handful of hot events. `hot_k`
+reproduces that in fixed shapes: score every event's violation
+involvement (`event_heat`), sweep only the top-K as pivots. Partners
+still span all events.
 """
 
 from __future__ import annotations
@@ -50,6 +58,17 @@ from timetabling_ga_tpu.ops import fitness
 from timetabling_ga_tpu.ops.delta import (
     LSState, _apply_move, _day_scv, _delta_one, init_state)
 from timetabling_ga_tpu.ops.rooms import _W_COST, _W_UNSUIT, capacity_rank
+
+
+def _neighbor_masks(b):
+    """Distance-1/2 left/right neighbor masks of an (S, D, spd) boolean
+    attendance tensor (pad 2 empty slots on each side of every day).
+    Shared by the Move1 sweep's add-delta and event_heat's run-of-3
+    membership so the windowing semantics cannot diverge."""
+    S, D, _ = b.shape
+    z = jnp.zeros((S, D, 1), jnp.bool_)
+    bp = jnp.concatenate([z, z, b, z, z], axis=2)
+    return bp[:, :, :-4], bp[:, :, 1:-3], bp[:, :, 3:-1], bp[:, :, 4:]
 
 
 def _move1_sweep(pa, slots, rooms_arr, att, occ, e, cap_rank):
@@ -113,11 +132,7 @@ def _move1_sweep(pa, slots, rooms_arr, att, occ, e, cap_rank):
     rm_d = _day_scv(after > 0) - _day_scv(before > 0)
 
     b1 = (att1 > 0).reshape(S, D, spd)                     # (S, D, spd)
-    z = jnp.zeros((S, D, 1), jnp.bool_)
-    bp = jnp.concatenate([z, z, b1, z, z], axis=2)         # pad 2 each side
-    # neighbors at distance 1/2 left/right of each in-day position
-    l1, l2 = bp[:, :, 1:-3], bp[:, :, :-4]
-    r1, r2 = bp[:, :, 3:-1], bp[:, :, 4:]
+    l2, l1, r1, r2 = _neighbor_masks(b1)
     free = ~b1
     # COUNT of new runs-of-3 through slot j (0..3), so each pair term
     # must be cast before summing (bool + bool is OR, not count)
@@ -141,9 +156,64 @@ def _distinct_pad(e1, e2, E: int):
     return jnp.where(pad == e2, (e1 + 2) % E, pad)
 
 
+def event_heat(pa, slots, rooms_arr, att, occ, hcv):
+    """Per-event violation involvement of ONE individual — the tensor
+    form of the reference's sweep skip rule (phase 1 examines an event
+    only if eventHcv(e) > 0, Solution.cpp:501-505; phase 2 only if
+    eventScv(e) > 0, Solution.cpp:628-633). Near feasibility only a
+    handful of events are hot, so sweeping the top-K by heat recovers
+    the reference's effective O(k)-events pass without data-dependent
+    shapes (the full-permutation sweep spends ~E/k of its time
+    re-examining clean events — VERDICT round 3, missing #2).
+
+    Returns (E,) float32. While the individual is infeasible (hcv > 0):
+    an event's hcv involvement = room-pair clash count at its (slot,
+    room) cell + unsuitable-room flag + correlated events sharing its
+    slot. Once feasible: its scv involvement = last-slot-of-day cost +
+    over attending students, membership in a run-of-3 at its slot +
+    single-class-day flag. Heat 0 <=> the reference would skip the
+    event. The involvement values are selection weights, not exact
+    per-event scv attribution (the sweep's delta evaluation stays
+    exact; heat only orders the pivots)."""
+    E = pa.n_events
+    T = pa.n_slots
+    spd = pa.slots_per_day
+    D = pa.n_days
+    S = pa.attends.shape[0]
+    ar = jnp.arange(E)
+    occ32 = occ.astype(jnp.int32)
+
+    # ---- hcv involvement (eventHcv semantics, Solution.cpp:173-191)
+    pair = occ32[slots, rooms_arr] - 1                      # (E,)
+    unsuit = (~pa.possible[ar, rooms_arr]).astype(jnp.int32)
+    slot_oh = (slots[:, None] == jnp.arange(T)[None, :]).astype(
+        jnp.float32)                                        # (E, T)
+    per_slot_conf = pa.conflict @ slot_oh                   # (E, T) MXU
+    corr = (per_slot_conf[ar, slots]
+            - jnp.diagonal(pa.conflict))  # an event always shares its
+    #                                       own slot; drop the diagonal
+    hcv_heat = (pair + unsuit).astype(jnp.float32) + corr
+
+    # ---- scv involvement (eventScv semantics, Solution.cpp:248-355)
+    sc = pa.student_count.astype(jnp.float32)
+    last = jnp.where(slots % spd == spd - 1, sc, 0.0)
+    b = (att > 0).reshape(S, D, spd)
+    l2, l1, r1, r2 = _neighbor_masks(b)
+    in_run = b & ((l2 & l1) | (l1 & r1) | (r1 & r2))
+    cnt = b.sum(axis=2, dtype=jnp.int32)
+    single = b & (cnt == 1)[:, :, None]
+    heat_slot = (in_run.astype(jnp.float32)
+                 + single.astype(jnp.float32)).reshape(S, T)
+    H = pa.attends.astype(jnp.float32).T @ heat_slot        # (E, T) MXU
+    scv_heat = H[ar, slots] + last
+
+    return jnp.where(hcv > 0, hcv_heat, scv_heat)
+
+
 def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
-               block_events: int = 1, sideways: float = 0.0):
-    """One full sweep pass over all events (shuffled per individual).
+               block_events: int = 1, sideways: float = 0.0,
+               hot_k: int = 0, p3: float = 0.0):
+    """One sweep pass (shuffled per individual).
 
     `block_events` = events examined per scan step. With 1 (default)
     this is the serial sweep: each event's accepted move is visible to
@@ -156,12 +226,29 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
     throughput/density trade the caller tunes. All delta semantics are
     shared with the B=1 path.
 
+    `hot_k` > 0 switches pivot selection from a full permutation of all
+    E events to the top-`hot_k` events by violation involvement (see
+    `event_heat` — the reference's phase-1/phase-2 skip rule), with
+    sub-integer random noise breaking ties: hot events are visited in
+    random order, and when fewer than `hot_k` events are hot the rest
+    of the pivots are random cold events (exploration fill). Move2/3
+    PARTNERS still come from a full permutation, so hot x cold moves
+    stay reachable. Scan depth drops from ceil(E/B) to ceil(K/B).
+
+    `p3` > 0.0 adds 3-cycle candidates (the reference's Move3 sweep,
+    Solution.cpp:562-615, both cycle orientations) built from adjacent
+    Move2-partner pairs. The reference gates each pivot's Move3 block
+    on ran01 < p3 (Solution.cpp:562); here any p3 > 0 includes the
+    3-cycle block in every step — a coverage superset with identical
+    move semantics, chosen over per-step Bernoulli gating to keep the
+    compiled step static.
+
     Returns (state, improved) where `improved` is a scalar bool: did ANY
     individual accept ANY move this pass. A False means the entire
-    population is at a Move1+Move2-block local optimum, the same
-    fixed-point condition that ends the reference's localSearch (a full
-    improving-free pass over all events, Solution.cpp:497-618 counter
-    semantics)."""
+    population is at a local optimum of the examined neighborhood, the
+    same fixed-point condition that ends the reference's localSearch (a
+    full improving-free pass over all events, Solution.cpp:497-618
+    counter semantics)."""
     cap_rank = capacity_rank(pa)
     P, E = state.slots.shape
     T = pa.n_slots
@@ -169,18 +256,29 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
     # partner offsets must stay within the permutation; clamp for tiny E
     swap_block = min(max(swap_block, 0), E - 1)
     B = min(max(block_events, 1), E)
-    n_steps = (E + B - 1) // B
+    use_hot = 0 < hot_k < E
+    K = hot_k if use_hot else E
+    n_steps = (K + B - 1) // B
 
-    k_perm, k_tie, k_side = jax.random.split(key, 3)
+    k_perm, k_tie, k_side, k_hot = jax.random.split(key, 4)
     perm_keys = jax.random.split(k_perm, P)
     perms = jax.vmap(
         lambda k: jax.random.permutation(k, E).astype(jnp.int32))(perm_keys)
 
+    if use_hot:
+        heat = jax.vmap(lambda s, r, a, o, h: event_heat(
+            pa, s, r, a, o, h))(state.slots, state.rooms, state.att,
+                                state.occ, state.hcv)       # (P, E)
+        # noise < 1: any event with integer heat >= 1 outranks every
+        # zero-heat event; ties (and the cold fill) order randomly
+        noise = jax.random.uniform(k_hot, heat.shape, maxval=0.9)
+        hot_idx = lax.top_k(heat + noise, K)[1].astype(jnp.int32)
+
     def step(st, pos):
-        # block of B event positions (wraps at the tail when B ∤ E;
+        # block of B pivot positions (wraps at the tail when B ∤ K;
         # duplicate candidates are harmless — only one move is applied)
-        idx = (pos * B + jnp.arange(B)) % E                # (B,)
-        e_blk = perms[:, idx]                              # (P, B)
+        idx = (pos * B + jnp.arange(B)) % K                # (B,)
+        e_blk = (hot_idx if use_hot else perms)[:, idx]    # (P, B)
 
         def per_e(e_i, s, r, att, occ):
             # Move1: all T targets
@@ -217,10 +315,15 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
         if swap_block > 0:
             # Move2 partners per block event j: the next swap_block
             # positions after its own (rotates coverage across passes,
-            # as in the B=1 form)
+            # as in the B=1 form). In hot mode the pivot does not come
+            # from the permutation, so a partner CAN collide with it —
+            # those candidates are masked unacceptable (a self-swap's
+            # duplicate event indices would corrupt _apply_move's
+            # occupancy bookkeeping if ever accepted).
             offs = (pos * B + jnp.arange(B)[:, None] + 1
                     + jnp.arange(swap_block)[None, :]) % E  # (B, SB)
             partners = perms[:, offs]                       # (P, B, SB)
+            BIG = jnp.int32(1 << 20)
 
             def swap_one(e_i, q, s, r, att, occ):
                 pad = _distinct_pad(e_i, q, E)
@@ -229,6 +332,7 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
                 active = jnp.array([True, True, False])
                 dh, ds, nr = _delta_one(pa, s, r, att, occ, evs, ns,
                                         active, cap_rank)
+                dh = jnp.where(q == e_i, BIG, dh)
                 return dh, ds, evs, ns, nr
 
             def swaps_per_ind(es, qss, s, r, att, occ):
@@ -246,6 +350,52 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
             cand_evs = jnp.concatenate([cand_evs, evs2], axis=1)
             cand_ns = jnp.concatenate([cand_ns, ns2], axis=1)
             cand_nr = jnp.concatenate([cand_nr, nr2], axis=1)
+
+            if p3 > 0.0 and swap_block >= 2:
+                # Move3: 3-cycles over (pivot, q_j, q_j+1) adjacent
+                # partner pairs, both orientations (Solution.cpp:
+                # 562-615 tries t1->t2->t3->t1 and the reverse). All
+                # three relocations are active; _delta_one's padded
+                # 3-relocation evaluates them exactly.
+                orients = jnp.array([True, False])
+
+                def cyc_one(e_i, q1, q2, orient, s, r, att, occ):
+                    evs = jnp.stack([e_i, q1, q2])
+                    ns = jnp.where(
+                        orient,
+                        jnp.stack([s[q1], s[q2], s[e_i]]),
+                        jnp.stack([s[q2], s[e_i], s[q1]]))
+                    active = jnp.array([True, True, True])
+                    dh, ds, nr = _delta_one(pa, s, r, att, occ, evs,
+                                            ns, active, cap_rank)
+                    invalid = (q1 == e_i) | (q2 == e_i) | (q1 == q2)
+                    dh = jnp.where(invalid, BIG, dh)
+                    return dh, ds, evs, ns, nr
+
+                def cycs_per_ind(es, qss, s, r, att, occ):
+                    # (B, SB-1) adjacent pairs x 2 orientations
+                    q1 = qss[:, :-1]                        # (B, SB-1)
+                    q2 = qss[:, 1:]
+                    eb = jnp.broadcast_to(es[:, None], q1.shape)
+
+                    def for_orient(o):
+                        return jax.vmap(jax.vmap(
+                            lambda e_i, a, b2: cyc_one(
+                                e_i, a, b2, o, s, r, att, occ)))(
+                                    eb, q1, q2)
+
+                    dh, ds, evs, ns, nr = jax.vmap(for_orient)(orients)
+                    return (dh.reshape(-1), ds.reshape(-1),
+                            evs.reshape(-1, 3), ns.reshape(-1, 3),
+                            nr.reshape(-1, 3))
+
+                dh3, ds3, evs3, ns3, nr3 = jax.vmap(cycs_per_ind)(
+                    e_blk, partners, st.slots, st.rooms, st.att, st.occ)
+                cand_dh = jnp.concatenate([cand_dh, dh3], axis=1)
+                cand_ds = jnp.concatenate([cand_ds, ds3], axis=1)
+                cand_evs = jnp.concatenate([cand_evs, evs3], axis=1)
+                cand_ns = jnp.concatenate([cand_ns, ns3], axis=1)
+                cand_nr = jnp.concatenate([cand_nr, nr3], axis=1)
 
         new_hcv = st.hcv[:, None] + cand_dh                # (P, C)
         new_scv = st.scv[:, None] + cand_ds
@@ -305,13 +455,16 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
 
 def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
                        swap_block: int = 8, converge: bool = False,
-                       block_events: int = 1, sideways: float = 0.0):
-    """Run up to `n_sweeps` full sweep passes over a (P, E) population.
+                       block_events: int = 1, sideways: float = 0.0,
+                       hot_k: int = 0, p3: float = 0.0):
+    """Run up to `n_sweeps` sweep passes over a (P, E) population.
 
-    Candidate budget per pass per individual: E * (T + swap_block)
-    delta evaluations — the full Move1 neighborhood plus a rotating
-    Move2 block, vs the reference's identical per-pass Move1 coverage
-    (Solution.cpp:508-534) and full Move2 coverage (535-561).
+    Candidate budget per pass per individual: K * (T + swap_block
+    [+ 2*(swap_block-1) when p3 > 0]) delta evaluations, where K = E
+    (full sweep) or `hot_k` (violation-guided top-K pivots) — vs the
+    reference's per-pass Move1 coverage (Solution.cpp:508-534), Move2
+    coverage (535-561) and Move3 coverage (562-615) over its non-skipped
+    events.
 
     converge=True runs passes under a bounded `lax.while_loop` that
     exits early once a whole pass accepts no move anywhere in the
@@ -334,7 +487,8 @@ def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
         def body(carry):
             st, i, _ = carry
             st, improved = sweep_pass(pa, jax.random.fold_in(key, i), st,
-                                      swap_block, block_events, sideways)
+                                      swap_block, block_events, sideways,
+                                      hot_k, p3)
             return st, i + 1, improved
 
         state, _, _ = lax.while_loop(
@@ -342,7 +496,8 @@ def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
     else:
         def one(st, i):
             st, _ = sweep_pass(pa, jax.random.fold_in(key, i), st,
-                               swap_block, block_events, sideways)
+                               swap_block, block_events, sideways,
+                               hot_k, p3)
             return st, None
 
         state, _ = lax.scan(one, state, jnp.arange(n_sweeps))
@@ -351,9 +506,12 @@ def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
 
 @functools.partial(jax.jit,
                    static_argnames=("n_sweeps", "swap_block", "converge",
-                                    "block_events", "sideways"))
+                                    "block_events", "sideways", "hot_k",
+                                    "p3"))
 def jit_sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
                            swap_block: int = 8, converge: bool = False,
-                           block_events: int = 1, sideways: float = 0.0):
+                           block_events: int = 1, sideways: float = 0.0,
+                           hot_k: int = 0, p3: float = 0.0):
     return sweep_local_search(pa, key, slots, rooms_arr, n_sweeps,
-                              swap_block, converge, block_events, sideways)
+                              swap_block, converge, block_events, sideways,
+                              hot_k, p3)

@@ -45,6 +45,9 @@ from timetabling_ga_tpu.runtime import jsonl
 from timetabling_ga_tpu.runtime.config import RunConfig
 
 INT_MAX = 2 ** 31 - 1
+# a reported best below this is feasible (reported form = hcv*1e6 + scv,
+# jsonl.reported_best; ga.cpp:191)
+FEASIBLE_LIMIT = 1_000_000
 
 # Compiled-program caches, shared across engine.run calls. A jitted
 # island runner costs seconds to tens of seconds to compile at race
@@ -126,6 +129,47 @@ def cached_init(mesh, pop_size: int, gacfg: ga.GAConfig):
 _SPG_CACHE: dict = {}
 # Likewise for seconds-per-sweep-pass of the init polish runner.
 _SPS_CACHE: dict = {}
+# Measured final-fetch cost (slots/rooms/hcv/scv round trip), reserved
+# out of the dispatch budget so -t covers the whole try INCLUDING the
+# endTry fetch (VERDICT round-3 weak #2: ~5 s overruns traced to work
+# outside the predictor).
+_FETCH_CACHE: dict = {}
+
+
+def _spg_for(cur_key, cur, gacfg, spg_key):
+    """Seconds-per-generation estimate for the active phase config.
+
+    On a cache miss for the POST config (e.g. a plain CLI run that never
+    called precompile), fall back to the repair config's estimate scaled
+    by the LS-depth ratio — post generations are more expensive roughly
+    in proportion to sweeps x pivot count, and an un-clamped first
+    dispatch after the switch would otherwise blow through -t (plus the
+    mid-run compile, which only precompile can avoid)."""
+    est = _SPG_CACHE.get(cur_key)
+    if est is not None or cur is gacfg:
+        return est
+    base = _SPG_CACHE.get(spg_key)
+    if base is None:
+        return None
+    ratio = max(1.0, cur.ls_sweeps / max(gacfg.ls_sweeps, 1))
+    if gacfg.ls_hot_k > 0 and cur.ls_hot_k == 0:
+        ratio *= 2.0   # full-pivot passes cost more than top-K passes
+    return base * ratio
+
+
+def _sync_vals(*vals):
+    """Multi-host schedule agreement (ADVICE round 3): every process
+    must take the SAME dispatch decisions (chunk sizes, epoch counts,
+    break/continue) or their collective program sequences diverge near
+    the -t boundary and the run deadlocks. Decisions are computed from
+    per-process clocks, then overridden with process 0's values via an
+    all-device broadcast. Identity on single-process runs."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        arr = multihost_utils.broadcast_one_to_all(
+            np.asarray(vals, np.int64))
+        return tuple(int(v) for v in arr)
+    return tuple(int(v) for v in vals)
 
 
 def cached_polish_runner(mesh, gacfg: ga.GAConfig, sig):
@@ -158,10 +202,33 @@ def build_ga_config(cfg: RunConfig) -> ga.GAConfig:
         ls_swap_block=cfg.ls_swap_block,
         ls_block_events=cfg.ls_block_events,
         ls_sideways=cfg.ls_sideways,
+        ls_hot_k=cfg.ls_hot_k,
         ls_converge=cfg.ls_converge, init_sweeps=cfg.init_sweeps,
         rooms_mode=cfg.rooms_mode,
         multi_objective=cfg.nsga2,
     )
+
+
+def build_post_config(cfg: RunConfig, gacfg: ga.GAConfig):
+    """Post-feasibility breeding config, or None when no post_* flag is
+    set. The reference's localSearch changes character once feasible —
+    phase 2 polishes scv to a local optimum with ALL partners
+    (Solution.cpp:619-768) — so the engine mirrors that with a second
+    compiled runner it switches to at the first dispatch after the
+    global best reaches feasibility (VERDICT round-3 next #3)."""
+    if (cfg.post_ls_sweeps is None and cfg.post_swap_block is None
+            and cfg.post_hot_k is None):
+        return None
+    post = dataclasses.replace(
+        gacfg,
+        ls_sweeps=(cfg.post_ls_sweeps if cfg.post_ls_sweeps is not None
+                   else gacfg.ls_sweeps),
+        ls_swap_block=(cfg.post_swap_block
+                       if cfg.post_swap_block is not None
+                       else gacfg.ls_swap_block),
+        ls_hot_k=(cfg.post_hot_k if cfg.post_hot_k is not None
+                  else gacfg.ls_hot_k))
+    return None if post == gacfg else post
 
 
 _DISTRIBUTED_DONE = False
@@ -206,6 +273,25 @@ def _fetch(x) -> np.ndarray:
     return np.asarray(x)
 
 
+def _fetch_final(state, n_islands: int, pop: int):
+    """endTry device->host readback as ONE round trip: concatenate
+    slots/rooms/hcv/scv into a single (N*P, 2E+2) device array and fetch
+    it once (each separate fetch is a multi-second round trip on
+    tunneled devices — the same cost the polish loop's stacked stats
+    fetch avoids). Returns (slots (N,P,E), rooms (N,P,E), best-row hcv
+    (N,), best-row scv (N,)) as numpy."""
+    import jax.numpy as jnp
+    packed = _fetch(jnp.concatenate(
+        [state.slots, state.rooms,
+         state.hcv[:, None], state.scv[:, None]], axis=1))
+    E = (packed.shape[1] - 2) // 2
+    slots = packed[:, :E].reshape(n_islands, pop, E)
+    rooms = packed[:, E:2 * E].reshape(n_islands, pop, E)
+    hcv = packed[:, 2 * E].reshape(n_islands, pop)[:, 0]
+    scv = packed[:, 2 * E + 1].reshape(n_islands, pop)[:, 0]
+    return slots, rooms, hcv, scv
+
+
 def _setup(cfg: RunConfig):
     """Shared run setup: load the instance, build mesh + breeding config
     + cache keys. precompile and _run_tries MUST agree on these (the
@@ -228,9 +314,11 @@ def _setup(cfg: RunConfig):
         n_islands = len(devices)
     mesh = islands.make_mesh(n_islands)
     gacfg = build_ga_config(cfg)
+    gacfg_post = build_post_config(cfg, gacfg)
     fingerprint = ckpt.config_fingerprint(problem, gacfg, n_islands)
     spg_key = (_mesh_key(mesh), gacfg, fingerprint)
-    return problem, pa, mesh, n_islands, gacfg, fingerprint, spg_key
+    return (problem, pa, mesh, n_islands, gacfg, gacfg_post, fingerprint,
+            spg_key)
 
 
 def precompile(cfg: RunConfig) -> None:
@@ -249,13 +337,20 @@ def precompile(cfg: RunConfig) -> None:
     if cfg.backend == "cpu":
         jax.config.update("jax_platforms", "cpu")
     maybe_init_distributed(cfg)
-    problem, pa, mesh, n_islands, gacfg, fingerprint, spg_key = _setup(cfg)
+    (problem, pa, mesh, n_islands, gacfg, gacfg_post, fingerprint,
+     spg_key) = _setup(cfg)
     sig = _shape_sig(problem)
 
     key = jax.random.key(0)
     gacfg_init = dataclasses.replace(gacfg, init_sweeps=0)
     state = cached_init(mesh, cfg.pop_size, gacfg_init)(pa, key)
     jax.block_until_ready(state)
+    # measure the endTry fetch cost once (the packed single-round-trip
+    # readback) so timed runs can reserve it out of the dispatch budget
+    t0 = time.monotonic()
+    _fetch_final(state, n_islands, cfg.pop_size)
+    _FETCH_CACHE[(_mesh_key(mesh), sig, cfg.pop_size)] = \
+        time.monotonic() - t0
     if gacfg.init_sweeps > 0:
         polish, pwarm = cached_polish_runner(mesh, gacfg, sig)
         jax.block_until_ready(polish(pa, key, state, 1))
@@ -269,32 +364,35 @@ def precompile(cfg: RunConfig) -> None:
                                    else 0.7 * sps + 0.3 * prev)
     # static dispatches always run gens = migration_period (shorter
     # remainders go through the dynamic runner), at pow2 n_ep; compile
-    # exactly those
+    # exactly those — for BOTH phase configs when a post-feasibility
+    # switch is configured (the switch must not compile mid-budget)
     gens = cfg.migration_period
     max_ep = (_pow2_floor(max(cfg.epochs_per_dispatch, 1))
               if cfg.generations >= cfg.migration_period else 0)
-    n_ep = 1
-    while n_ep <= max_ep:
-        runner, warm = cached_runner(mesh, gacfg, n_ep, gens, sig)
-        st2, _, _ = runner(pa, key, state)
-        jax.block_until_ready(st2)
-        if not warm:
-            # the timing call MUST differ from the compile call: tunneled
-            # devices deduplicate byte-identical repeat computations
-            # (BASELINE.md methodology note), which once made this
-            # measure ~2e-5 s/gen and let a 146 s dispatch through a
-            # 60 s budget — so re-run with a different key
-            t0 = time.monotonic()
-            st2, _, _ = runner(pa, jax.random.key(1), state)
+    for g in ([gacfg] if gacfg_post is None else [gacfg, gacfg_post]):
+        g_spg_key = (_mesh_key(mesh), g, fingerprint)
+        n_ep = 1
+        while n_ep <= max_ep:
+            runner, warm = cached_runner(mesh, g, n_ep, gens, sig)
+            st2, _, _ = runner(pa, key, state)
             jax.block_until_ready(st2)
-            spg = (time.monotonic() - t0) / (n_ep * gens)
-            prev = _SPG_CACHE.get(spg_key)
-            _SPG_CACHE[spg_key] = (spg if prev is None
-                                   else 0.7 * spg + 0.3 * prev)
-        n_ep *= 2
-    dyn, _ = cached_dynamic_runner(mesh, gacfg, cfg.migration_period,
-                                   sig)
-    jax.block_until_ready(dyn(pa, key, state, 1))
+            if not warm:
+                # the timing call MUST differ from the compile call:
+                # tunneled devices deduplicate byte-identical repeat
+                # computations (BASELINE.md methodology note), which once
+                # made this measure ~2e-5 s/gen and let a 146 s dispatch
+                # through a 60 s budget — so re-run with a different key
+                t0 = time.monotonic()
+                st2, _, _ = runner(pa, jax.random.key(1), state)
+                jax.block_until_ready(st2)
+                spg = (time.monotonic() - t0) / (n_ep * gens)
+                prev = _SPG_CACHE.get(g_spg_key)
+                _SPG_CACHE[g_spg_key] = (spg if prev is None
+                                         else 0.7 * spg + 0.3 * prev)
+            n_ep *= 2
+        dyn, _ = cached_dynamic_runner(mesh, g, cfg.migration_period,
+                                       sig)
+        jax.block_until_ready(dyn(pa, key, state, 1))
 
 
 def run(cfg: RunConfig, out=None) -> int:
@@ -355,12 +453,16 @@ def _run_tries(cfg: RunConfig, out) -> int:
     # is keyed on the full config fingerprint (instance dims + breeding
     # params + island layout), so a measurement from one problem is never
     # trusted for a differently-shaped one.
-    problem, pa, mesh, n_islands, gacfg, fingerprint, spg_key = _setup(cfg)
+    (problem, pa, mesh, n_islands, gacfg, gacfg_post, fingerprint,
+     spg_key) = _setup(cfg)
     sig = _shape_sig(problem)
     # init runs WITHOUT the fused polish (init_sweeps=0): the polish is
     # dispatched in budget-aware chunks right after (see below)
     gacfg_init = dataclasses.replace(gacfg, init_sweeps=0)
     seed = cfg.resolved_seed()
+    # -t must cover the endTry fetch too: reserve its measured cost out
+    # of every dispatch-fitting decision (1.0 s prior when unmeasured)
+    reserve = _FETCH_CACHE.get((_mesh_key(mesh), sig, cfg.pop_size), 1.0)
     _phase(out, cfg.trace, "load", 0, time.monotonic() - t0)
 
     global_best = INT_MAX
@@ -410,7 +512,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
                 prev_sum = None
                 stalls = 0
                 while done < gacfg.init_sweeps:
-                    remaining_t = (cfg.time_limit
+                    remaining_t = (cfg.time_limit - reserve
                                    - (time.monotonic() - t_try))
                     chunk = min(4, gacfg.init_sweeps - done)
                     if sec_per_sweep is not None and sec_per_sweep > 0:
@@ -418,15 +520,22 @@ def _run_tries(cfg: RunConfig, out) -> int:
                         # varies with how many passes actually run, and
                         # an underestimate here is a budget overshoot
                         fit = int(remaining_t / (1.25 * sec_per_sweep))
-                        if fit < 1:
-                            break
-                        chunk = min(chunk, fit)
+                        chunk = 0 if fit < 1 else min(chunk, fit)
                     elif remaining_t <= 0:
+                        chunk = 0
+                    # multi-host: all processes must dispatch the same
+                    # chunk (or all break) — process 0 decides
+                    chunk, = _sync_vals(chunk)
+                    if chunk < 1:
                         break
                     tp0 = time.monotonic()
-                    state = polish(pa, jax.random.fold_in(k_init, done),
-                                   state, chunk)
-                    pen = _fetch(state.penalty)
+                    state, stats = polish(
+                        pa, jax.random.fold_in(k_init, done), state,
+                        chunk)
+                    # ONE stacked (pen, hcv, scv) fetch per chunk — each
+                    # fetch is a multi-second round trip on tunneled
+                    # devices (VERDICT round-3 weak #3)
+                    stats = _fetch(stats)
                     tp1 = time.monotonic()
                     _phase(out, cfg.trace, "polish", trial, tp1 - tp0,
                            sweeps=chunk)
@@ -443,8 +552,9 @@ def _run_tries(cfg: RunConfig, out) -> int:
                     # visible to time-to-feasible measurement (the
                     # reference logs its init LS bests the same way,
                     # ga.cpp:203-228 fires on any new local best)
-                    hcv_a = _fetch(state.hcv).reshape(n_islands, -1)
-                    scv_a = _fetch(state.scv).reshape(n_islands, -1)
+                    pen = stats[0]
+                    hcv_a = stats[1].reshape(n_islands, -1)
+                    scv_a = stats[2].reshape(n_islands, -1)
                     for i in range(n_islands):
                         rep = jsonl.reported_best(hcv_a[i, 0], scv_a[i, 0])
                         if rep < best_seen[i]:
@@ -467,11 +577,22 @@ def _run_tries(cfg: RunConfig, out) -> int:
 
         epochs_done = 0
         epochs_at_ckpt = 0
-        sec_per_gen = _SPG_CACHE.get(spg_key)
+        # two-phase breeding: `cur` starts as the repair config and
+        # switches to gacfg_post at the first dispatch boundary after
+        # the global best reaches feasibility (both programs are warm —
+        # precompile builds them together)
+        cur, cur_key = gacfg, spg_key
+        if (gacfg_post is not None
+                and min(best_seen) < FEASIBLE_LIMIT):
+            # feasibility already reached during the init polish
+            cur = gacfg_post
+            cur_key = (_mesh_key(mesh), cur, fingerprint)
+            _phase(out, cfg.trace, "phase-switch", trial, 0.0, gens=0)
+        sec_per_gen = _spg_for(cur_key, cur, gacfg, spg_key)
         while gens_done < cfg.generations:
-            remaining_t = cfg.time_limit - (time.monotonic() - t_try)
-            if remaining_t <= 0:
-                break
+            remaining_t = (cfg.time_limit - reserve
+                           - (time.monotonic() - t_try))
+            stop = remaining_t <= 0
             remaining = cfg.generations - gens_done
             dyn_gens = None
             gens = cfg.migration_period
@@ -488,7 +609,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
                 # generations left — served by the dynamic-gens runner
                 # (no fresh static shape, no new compile)
                 n_ep, dyn_gens = 1, remaining
-            if sec_per_gen is not None and sec_per_gen > 0:
+            if not stop and sec_per_gen is not None and sec_per_gen > 0:
                 # -t must HOLD: launch only work predicted to fit the
                 # remaining budget (the reference checks its clock before
                 # every LS candidate, Solution.cpp:499; our granularity
@@ -503,8 +624,8 @@ def _run_tries(cfg: RunConfig, out) -> int:
                 # went unused).
                 g_fit = int(remaining_t / sec_per_gen)
                 if g_fit < 1:
-                    break
-                if dyn_gens is not None:
+                    stop = True
+                elif dyn_gens is not None:
                     dyn_gens = min(dyn_gens, g_fit)
                 else:
                     fit_ep = g_fit // gens
@@ -512,18 +633,26 @@ def _run_tries(cfg: RunConfig, out) -> int:
                         n_ep, dyn_gens = 1, min(g_fit, gens)
                     elif fit_ep < n_ep:
                         n_ep = _pow2_floor(fit_ep)
+            # multi-host: the dispatch schedule (stop / shape / size)
+            # must be identical on every process — process 0 decides
+            stop, is_dyn, n_ep, dg = _sync_vals(
+                stop, dyn_gens is not None, n_ep,
+                0 if dyn_gens is None else dyn_gens)
+            if stop:
+                break
+            dyn_gens = dg if is_dyn else None
 
             key, k_epoch = jax.random.split(key)
             if dyn_gens is not None:
                 runner, warm = cached_dynamic_runner(
-                    mesh, gacfg, cfg.migration_period, sig)
+                    mesh, cur, cfg.migration_period, sig)
                 td0 = time.monotonic()
                 state, trace, _gbest = runner(pa, k_epoch, state, dyn_gens)
                 trace = _fetch(trace)[:, :, :dyn_gens]
                 gens_run = dyn_gens
             else:
-                runner, warm = cached_runner(mesh, gacfg, n_ep, gens,
-                                              sig)
+                runner, warm = cached_runner(mesh, cur, n_ep, gens,
+                                             sig)
                 td0 = time.monotonic()
                 state, trace, _gbest = runner(pa, k_epoch, state)
                 trace = _fetch(trace)          # blocks on the dispatch
@@ -543,7 +672,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
                 spg = (td1 - td0) / gens_run
                 sec_per_gen = (spg if sec_per_gen is None
                                else 0.7 * spg + 0.3 * sec_per_gen)
-                _SPG_CACHE[spg_key] = sec_per_gen
+                _SPG_CACHE[cur_key] = sec_per_gen
 
             # per-generation logEntry emission from the device-side trace
             flat = trace.reshape(n_islands, gens_run, 2)
@@ -555,6 +684,17 @@ def _run_tries(cfg: RunConfig, out) -> int:
                         best_seen[i] = rep
                         tg = (td0 - t_try) + (g + 1) / total * (td1 - td0)
                         jsonl.log_entry(out, i, 0, rep, tg)
+
+            # post-feasibility switch (reference phase-2 analogue): the
+            # decision reads best_seen, which every process derives from
+            # the same allgathered trace — no divergence risk
+            if (cur is gacfg and gacfg_post is not None
+                    and min(best_seen) < FEASIBLE_LIMIT):
+                cur = gacfg_post
+                cur_key = (_mesh_key(mesh), cur, fingerprint)
+                sec_per_gen = _spg_for(cur_key, cur, gacfg, spg_key)
+                _phase(out, cfg.trace, "phase-switch", trial, 0.0,
+                       gens=gens_done)
 
             if (cfg.checkpoint
                     and epochs_done - epochs_at_ckpt >= cfg.checkpoint_every):
@@ -568,10 +708,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
         # final per-island solution records (endTry, ga.cpp:169-197)
         t = time.monotonic()
         P = cfg.pop_size
-        slots = _fetch(state.slots).reshape(n_islands, P, -1)
-        rooms = _fetch(state.rooms).reshape(n_islands, P, -1)
-        hcv = _fetch(state.hcv).reshape(n_islands, P)[:, 0]
-        scv = _fetch(state.scv).reshape(n_islands, P)[:, 0]
+        slots, rooms, hcv, scv = _fetch_final(state, n_islands, P)
         _phase(out, cfg.trace, "fetch", trial, time.monotonic() - t)
         total_time = time.monotonic() - t_try
         for i in range(n_islands):

@@ -101,6 +101,10 @@ _TUNE_FIELDS = {"pop": "pop_size", "sweeps": "ls_sweeps",
                 "migration_period": "migration_period",
                 "block_events": "ls_block_events",
                 "sideways": "ls_sideways",
+                "hot_k": "ls_hot_k",
+                "post_sweeps": "post_ls_sweeps",
+                "post_swap_block": "post_swap_block",
+                "post_hot_k": "post_hot_k",
                 "epochs_per_dispatch": "epochs_per_dispatch"}
 
 
@@ -176,6 +180,10 @@ def main():
         "migration_period": opt("--migration-period", None, int),
         "block_events": opt("--block-events", None, int),
         "sideways": opt("--sideways", None, float),
+        "hot_k": opt("--hot-k", None, int),
+        "post_sweeps": opt("--post-sweeps", None, int),
+        "post_swap_block": opt("--post-swap-block", None, int),
+        "post_hot_k": opt("--post-hot-k", None, int),
         "epochs_per_dispatch": opt("--epochs-per-dispatch", None, int),
     }
     do_cpu = "--no-cpu" not in argv

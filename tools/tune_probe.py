@@ -16,16 +16,13 @@ from tools.quality_race import make_instances, run_tpu, warm_tpu  # noqa: E402
 
 
 GRID = [
-    # block_events > 1: E/B-depth sweep passes — many more passes per
-    # second at 1/B acceptance density per pass
-    dict(pop=1024, sweeps=4, init_sweeps=200, swap_block=8,
-         block_events=8, migration_period=2, epochs_per_dispatch=1),
-    dict(pop=512, sweeps=8, init_sweeps=400, swap_block=16,
-         block_events=16, migration_period=2, epochs_per_dispatch=1),
-    dict(pop=1024, sweeps=2, init_sweeps=100, swap_block=32,
-         block_events=8, migration_period=2, epochs_per_dispatch=1),
-    dict(pop=256, sweeps=16, init_sweeps=800, swap_block=16,
-         block_events=32, migration_period=2, epochs_per_dispatch=1),
+    # round-4 scv-endgame probes, part 3: pop 32 won part 2 (82 vs 135
+    # at pop 256 — more generations of GA mixing beat deeper children);
+    # push toward the reference's own pop 10 with deeper polish
+    dict(pop=16, post_sweeps=8, post_swap_block=32, post_hot_k=0),
+    dict(pop=8, post_sweeps=8, post_swap_block=32, post_hot_k=0),
+    dict(pop=32, post_sweeps=16, post_swap_block=32, post_hot_k=0),
+    dict(pop=16, post_sweeps=16, post_swap_block=64, post_hot_k=0),
 ]
 
 
