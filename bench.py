@@ -49,6 +49,14 @@ def _instance():
                            attend_prob=0.02)
 
 
+def _small_instance():
+    """The quality race's `small` spec (tools/quality_race.py SPECS) —
+    the shape the small-scale tuned defaults are sized for."""
+    from timetabling_ga_tpu.problem import random_instance
+    return random_instance(101, n_events=100, n_rooms=5, n_features=5,
+                           n_students=80, attend_prob=0.05)
+
+
 def measure_tpu_evals(problem) -> float:
     """Dependent-chain batched evaluation on the device (see BASELINE.md
     methodology: identical dispatches get deduplicated by the tunnel, so
@@ -184,6 +192,109 @@ def measure_generation_sweep(problem, pop: int) -> dict:
             "candidate_evals_per_sec": round(gps * evals_per_gen, 1)}
 
 
+def measure_generation_sweep_tuned(problem, label: str) -> dict:
+    """VERDICT round-3 next #7: bench the SHIPPED configuration. The
+    plain `measure_generation_sweep` rows use ls_sweeps=1 without
+    converge/sideways/hot-K, but `RunConfig.apply_tuned_defaults` ships
+    something else entirely — this row derives the tuned config
+    programmatically (so it cannot rot when the defaults move) and
+    measures the ms/gen the engine's budget-aware dispatch sizing
+    actually needs. When the tuned defaults define a post-feasibility
+    phase, its config is measured too (`post_ms_per_gen`)."""
+    import jax
+    from timetabling_ga_tpu.ops import ga
+    from timetabling_ga_tpu.runtime import engine
+    from timetabling_ga_tpu.runtime.config import RunConfig
+
+    cfg = RunConfig(input="<bench>")
+    cfg.apply_tuned_defaults(problem.n_events)
+    gacfg = engine.build_ga_config(cfg)
+    post = engine.build_post_config(cfg, gacfg)
+
+    pa = problem.device_arrays()
+    gens = 4
+    out = {"pop": gacfg.pop_size, "ls_sweeps": gacfg.ls_sweeps,
+           "hot_k": gacfg.ls_hot_k, "converge": gacfg.ls_converge,
+           "sideways": gacfg.ls_sideways}
+    state = ga.init_population(pa, jax.random.key(0), gacfg.pop_size)
+    jax.block_until_ready(state)
+    for name, g in (("ms_per_gen", gacfg),) + (
+            (("post_ms_per_gen", post),) if post is not None else ()):
+        run = jax.jit(lambda k, s, g=g: ga.run(pa, k, s, g, gens)[0])
+        warm = run(jax.random.key(1), state)
+        jax.block_until_ready(warm)
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(jax.random.key(2), warm))
+        dt = time.perf_counter() - t0
+        out[name] = round(dt / gens * 1e3, 1)
+        print(f"# tuned sweep generation [{label}] {name} "
+              f"(pop {g.pop_size}, sweeps {g.ls_sweeps}, hot_k "
+              f"{g.ls_hot_k}): {dt / gens * 1e3:.0f} ms/gen",
+              file=sys.stderr)
+    return out
+
+
+def measure_ls_shootout_feasible(problem) -> dict:
+    """VERDICT round-3 next #8: the shootout regime the race is actually
+    lost in. The random-start shootout ends with both sides infeasible —
+    it measures hcv repair only. This one first polishes the population
+    to feasibility OUTSIDE the timed section (converge sweeps with
+    plateau walking, the production init-polish recipe), then compares
+    one full-pivot sweep pass against an equal-wall-clock K-random
+    budget on the scv-polish endgame. Lower mean penalty wins."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from timetabling_ga_tpu.ops import delta, fitness, sweep
+    from timetabling_ga_tpu.ops.rooms import batch_assign_rooms
+
+    pa = problem.device_arrays()
+    P = 256
+    slots = jax.random.randint(jax.random.key(6), (P, problem.n_events),
+                               0, problem.n_slots, dtype=jnp.int32)
+    rooms = batch_assign_rooms(pa, slots)
+    # untimed prep: repair to (near-)feasibility, production recipe
+    slots, rooms = sweep.jit_sweep_local_search(
+        pa, jax.random.key(7), slots, rooms, 60, 8, converge=True,
+        sideways=0.25, hot_k=48)
+    jax.block_until_ready((slots, rooms))
+    pen0, hcv0, _ = fitness.batch_penalty(pa, slots, rooms)
+    feas_frac = float((np.asarray(hcv0) == 0).mean())
+
+    def timed(fn, *args, **kw):
+        out = fn(pa, jax.random.key(8), slots, rooms, *args, **kw)
+        jax.block_until_ready(out)      # warm/compile
+        t0 = time.perf_counter()
+        out = fn(pa, jax.random.key(9), slots, rooms, *args, **kw)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        pen, _, _ = fitness.batch_penalty(pa, *out)
+        return float(np.asarray(pen).mean()), dt
+
+    sweep_pen, sweep_dt = timed(sweep.jit_sweep_local_search, 1, 16)
+    probe_rounds = 50
+    _, probe_dt = timed(delta.jit_batch_local_search_delta, probe_rounds, 8)
+    rounds = max(1, int(probe_rounds * sweep_dt / probe_dt))
+    rand_pen, rand_dt = timed(delta.jit_batch_local_search_delta, rounds, 8)
+    if abs(rand_dt - sweep_dt) / sweep_dt > 0.05:
+        rounds = max(1, int(rounds * sweep_dt / rand_dt))
+        rand_pen, rand_dt = timed(delta.jit_batch_local_search_delta,
+                                  rounds, 8)
+    print(f"# LS shootout (feasible start, {feas_frac:.0%} feasible, "
+          f"mean pen {float(np.asarray(pen0).mean()):,.1f}): sweep "
+          f"{sweep_pen:,.1f} in {sweep_dt:.2f}s vs K-random "
+          f"{rand_pen:,.1f} in {rand_dt:.2f}s ({rounds} rounds)",
+          file=sys.stderr)
+    return {"start_feasible_frac": round(feas_frac, 3),
+            "start_mean_pen": round(float(np.asarray(pen0).mean()), 1),
+            "sweep_mean_pen": round(sweep_pen, 1),
+            "sweep_seconds": round(sweep_dt, 3),
+            "krandom_mean_pen": round(rand_pen, 1),
+            "krandom_seconds": round(rand_dt, 3),
+            "krandom_rounds": rounds,
+            "winner": "sweep" if sweep_pen <= rand_pen else "krandom"}
+
+
 def measure_scale() -> dict:
     """VERDICT item 6: synthetic E=2000 / R=80, pop=32768, single chip —
     exercises the memory plan (SURVEY hard part 3)."""
@@ -237,8 +348,8 @@ def measure_ls_shootout(problem) -> dict:
 
     pa = problem.device_arrays()
     P = 512
-    slots = jax.random.randint(jax.random.key(3), (P, N_EVENTS), 0,
-                               problem.n_slots, dtype=jnp.int32)
+    slots = jax.random.randint(jax.random.key(3), (P, problem.n_events),
+                               0, problem.n_slots, dtype=jnp.int32)
     rooms = batch_assign_rooms(pa, slots)
     jax.block_until_ready((slots, rooms))
 
@@ -291,8 +402,15 @@ def main() -> None:
              lambda: measure_generation_sweep(problem, 128)),
             ("generation_sweep_1024",
              lambda: measure_generation_sweep(problem, 1024)),
+            ("generation_sweep_tuned_comp",
+             lambda: measure_generation_sweep_tuned(problem, "comp")),
+            ("generation_sweep_tuned_small",
+             lambda: measure_generation_sweep_tuned(
+                 _small_instance(), "small")),
             ("scale_2000ev", measure_scale),
-            ("ls_shootout", lambda: measure_ls_shootout(problem))):
+            ("ls_shootout", lambda: measure_ls_shootout(problem)),
+            ("ls_shootout_feasible",
+             lambda: measure_ls_shootout_feasible(problem))):
         try:
             extra[name] = fn()
         except Exception as e:  # pragma: no cover - defensive

@@ -317,6 +317,45 @@ def test_engine_time_budget_holds(tim_file):
         f"budget 6s (+{fetch:.2f}s fetch reserve), ran {wall:.1f}s"
 
 
+def test_budget_tail_polish(tim_file):
+    """When the generation loop stops because not even one more
+    generation is predicted to fit, the stranded budget slice must run
+    sweep-granular tail polish instead of idling (engine tail-polish
+    phase; the reference's per-candidate clock check means ITS last
+    slice is pure local search too, Solution.cpp:499)."""
+    import time as _time
+    from timetabling_ga_tpu.runtime import engine as eng
+    cfg = RunConfig(input=tim_file, seed=5, pop_size=8, islands=1,
+                    generations=10 ** 9, migration_period=5,
+                    ls_mode="sweep", ls_sweeps=1, init_sweeps=0,
+                    time_limit=4.0, backend="cpu", trace=True,
+                    auto_tune=False)
+    eng.precompile(cfg)
+    saved = dict(eng._SPG_CACHE)
+    try:
+        # force the generation loop to stop immediately (every
+        # generation predicted not to fit) so the WHOLE budget is tail
+        for k in list(eng._SPG_CACHE):
+            eng._SPG_CACHE[k] = 1e9
+        buf = io.StringIO()
+        t0 = _time.monotonic()
+        eng.run(cfg, out=buf)
+        wall = _time.monotonic() - t0
+    finally:
+        eng._SPG_CACHE.clear()
+        eng._SPG_CACHE.update(saved)
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    phases = [x["phase"]["name"] for x in lines if "phase" in x]
+    assert "tail-polish" in phases, phases
+    assert phases.count("dispatch") == 0   # no generation ever fit
+    fetch = max(eng._FETCH_CACHE.values()) if eng._FETCH_CACHE else 1.0
+    assert wall < 4.0 * 1.05 + fetch + 0.5, \
+        f"tail polish overshot: {wall:.1f}s on a 4s budget"
+    bests = [x["logEntry"]["best"] for x in lines if "logEntry" in x]
+    assert bests == sorted(bests, reverse=True)
+    assert any("runEntry" in x for x in lines)
+
+
 def test_time_to_feasible_guard(tim_file):
     """Regression guard (VERDICT round-2 item 9): the engine must reach
     feasibility on an easy instance and report it through logEntry
@@ -378,15 +417,19 @@ def test_distributed_single_process_smoke(tim_file):
 
 def test_apply_tuned_defaults_size_rule_and_overrides():
     """Size-tuned production defaults (VERDICT round-2 item 8): small
-    instances get the deep-sweep config, comp-scale the wide-multistart
-    config, and explicit user settings always win."""
+    populations with deep per-child sweeps at both scales, comp scale
+    adding violation-guided repair + a full-pivot post-feasibility
+    endgame; explicit user settings always win."""
     small = RunConfig(input="x.tim").apply_tuned_defaults(100)
     assert (small.pop_size, small.ls_sweeps, small.init_sweeps) == \
-        (128, 6, 30)
+        (32, 6, 30)
     assert small.ls_mode == "sweep" and small.ls_converge
     assert small.ls_sideways > 0
+    assert small.post_ls_sweeps and small.post_hot_k == 0
     big = RunConfig(input="x.tim").apply_tuned_defaults(400)
-    assert (big.pop_size, big.ls_sweeps, big.init_sweeps) == (256, 2, 200)
+    assert (big.pop_size, big.ls_sweeps, big.init_sweeps) == (16, 2, 200)
+    assert big.ls_hot_k > 0 and big.post_hot_k == 0
+    assert big.post_ls_sweeps > big.ls_sweeps
     # explicit values survive
     mine = RunConfig(input="x.tim", pop_size=64,
                      ls_sweeps=3).apply_tuned_defaults(400)
@@ -406,7 +449,7 @@ def test_explicit_flags_survive_auto_tune():
     assert cfg.ls_mode == "random"      # not overridden to "sweep"
     assert cfg.ls_sweeps == 1           # not overridden to 2
     assert cfg.ls_sideways == 0.0       # not overridden to 0.25
-    assert cfg.pop_size == 256          # untouched field still tuned
+    assert cfg.pop_size == 16           # untouched field still tuned
 
 
 def test_tpu_path_thread_id_is_zero(tim_file):
@@ -469,12 +512,18 @@ def test_build_post_config_mapping():
     assert build_post_config(cfg2, build_ga_config(cfg2)) is None
     cfg3 = RunConfig(input="x.tim", ls_mode="sweep", ls_sweeps=2,
                      ls_hot_k=48, post_hot_k=0, post_ls_sweeps=4,
-                     post_swap_block=16)
+                     post_swap_block=16, post_sideways=0.5)
     p = build_post_config(cfg3, build_ga_config(cfg3))
     assert p is not None
     assert (p.ls_hot_k, p.ls_sweeps, p.ls_swap_block) == (0, 4, 16)
+    assert p.ls_sideways == 0.5
     # untouched fields inherit
     assert p.ls_mode == "sweep" and p.pop_size == cfg3.pop_size
+    # post_sideways alone is enough to define a post phase
+    cfg4 = RunConfig(input="x.tim", ls_mode="sweep", ls_sideways=0.25,
+                     post_sideways=0.0)
+    p4 = build_post_config(cfg4, build_ga_config(cfg4))
+    assert p4 is not None and p4.ls_sideways == 0.0
 
 
 def test_distributed_two_process_run(tim_file, tmp_path):

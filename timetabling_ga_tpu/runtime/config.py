@@ -93,6 +93,7 @@ class RunConfig:
     post_ls_sweeps: Optional[int] = None     # sweep passes per child
     post_swap_block: Optional[int] = None    # Move2 partners per pivot
     post_hot_k: Optional[int] = None         # pivot selection (0 = all)
+    post_sideways: Optional[float] = None    # plateau-walk acceptance
     ls_converge: bool = False  # sweep LS early-exits at the population-
     #                            wide local optimum (reference stopping
     #                            rule); ls_sweeps becomes the hard bound
@@ -137,32 +138,40 @@ class RunConfig:
 
         The reference scales its LS budget with problem type the same
         way (-p 1/2/3 -> maxSteps 200/1000/2000, ga.cpp:389-397); here
-        the knob set is (pop, LS depth, dispatch granularity), measured
-        in the round-3 quality races:
-          - small instances (E <= 200) win with a modest population and
-            DEEP per-child sweeps (pop 128, 6 convergence-bounded passes
-            per child);
-          - comp-scale instances (E > 200) win with a parallel
-            multistart (pop 256) polished toward its fixed point (the
-            long init_sweeps bound; the engine's stall detector ends the
-            polish when the penalty sum stops dropping), then evolved
-            with moderate per-child sweeps.
+        the knob set is (pop, LS depth, post-feasibility polish depth),
+        measured in the round-3/4 quality probes:
+          - SMALL populations with very deep children dominate: the
+            per-child sweep LS is so strong that generations of GA
+            mixing beat multistart breadth at equal wall clock (pop 32
+            small / pop 16 comp — approaching the reference's own
+            pop 10, ga.cpp:64);
+          - comp-scale instances (E > 200) repair fastest with
+            violation-guided top-K pivots, then need a DIFFERENT
+            endgame: deep full-pivot sweeps with a wide Move2 partner
+            block once feasible (post_* fields).
         Returns self (mutated) for chaining; only fields the user left
         at their dataclass defaults are touched."""
         d = RunConfig()
-        tuned = (dict(pop_size=128, ls_sweeps=6, init_sweeps=30,
-                      ls_swap_block=8, migration_period=10)
+        tuned = (dict(pop_size=32, ls_sweeps=6, init_sweeps=30,
+                      ls_swap_block=8, migration_period=10,
+                      post_ls_sweeps=12, post_swap_block=64,
+                      post_hot_k=0)
                  if n_events <= 200 else
                  # comp scale: violation-guided top-K sweeps while
                  # infeasible (repair is concentrated on few hot events
-                 # — measured 3x faster time-to-feasible on comp01s),
-                 # then switch to full-pivot deeper sweeps for the scv
-                 # polish endgame once feasible (hot-K alone polishes
-                 # worse: round-4 probes 154 vs 120 best-at-budget)
-                 dict(pop_size=256, ls_sweeps=2, init_sweeps=200,
+                 # — measured time-to-feasible 28.6 s -> 0.5-3 s on
+                 # comp01s), then switch to deep full-pivot wide-partner
+                 # sweeps for the scv polish endgame once feasible.
+                 # Round-4 probe ladder on comp01s best-at-budget (60 s,
+                 # seed 42): pop 256 no post = 135 -> pop 32 post 16x32
+                 # = 82 -> pop 16 post 16x64 = 68; the same config took
+                 # comp05s to 343 (< the round-3 CPU baseline 351).
+                 # Small populations win: with children this deep, GA
+                 # mixing generations beat multistart breadth
+                 dict(pop_size=16, ls_sweeps=2, init_sweeps=200,
                       ls_swap_block=8, migration_period=2,
-                      ls_hot_k=48, post_hot_k=0, post_ls_sweeps=4,
-                      post_swap_block=16))
+                      ls_hot_k=48, post_hot_k=0, post_ls_sweeps=16,
+                      post_swap_block=64))
         # plateau-walking acceptance: measured to take comp05s from
         # never-feasible (hcv stuck at 3 — pure correlation clashes) to
         # feasible in ~24 s; see ops/sweep.py sweep_pass
@@ -208,6 +217,7 @@ _FLAG_MAP = {
     "--post-sweeps": ("post_ls_sweeps", int),
     "--post-swap-block": ("post_swap_block", int),
     "--post-hot-k": ("post_hot_k", int),
+    "--post-sideways": ("post_sideways", float),
     "--init-sweeps": ("init_sweeps", int),
     "--rooms-mode": ("rooms_mode", str),
     "--checkpoint": ("checkpoint", str),

@@ -217,7 +217,7 @@ def build_post_config(cfg: RunConfig, gacfg: ga.GAConfig):
     compiled runner it switches to at the first dispatch after the
     global best reaches feasibility (VERDICT round-3 next #3)."""
     if (cfg.post_ls_sweeps is None and cfg.post_swap_block is None
-            and cfg.post_hot_k is None):
+            and cfg.post_hot_k is None and cfg.post_sideways is None):
         return None
     post = dataclasses.replace(
         gacfg,
@@ -227,7 +227,9 @@ def build_post_config(cfg: RunConfig, gacfg: ga.GAConfig):
                        if cfg.post_swap_block is not None
                        else gacfg.ls_swap_block),
         ls_hot_k=(cfg.post_hot_k if cfg.post_hot_k is not None
-                  else gacfg.ls_hot_k))
+                  else gacfg.ls_hot_k),
+        ls_sideways=(cfg.post_sideways if cfg.post_sideways is not None
+                     else gacfg.ls_sideways))
     return None if post == gacfg else post
 
 
@@ -345,23 +347,36 @@ def precompile(cfg: RunConfig) -> None:
     gacfg_init = dataclasses.replace(gacfg, init_sweeps=0)
     state = cached_init(mesh, cfg.pop_size, gacfg_init)(pa, key)
     jax.block_until_ready(state)
-    # measure the endTry fetch cost once (the packed single-round-trip
-    # readback) so timed runs can reserve it out of the dispatch budget
-    t0 = time.monotonic()
-    _fetch_final(state, n_islands, cfg.pop_size)
-    _FETCH_CACHE[(_mesh_key(mesh), sig, cfg.pop_size)] = \
-        time.monotonic() - t0
-    if gacfg.init_sweeps > 0:
-        polish, pwarm = cached_polish_runner(mesh, gacfg, sig)
+    # measure the endTry fetch cost (the packed single-round-trip
+    # readback) so timed runs can reserve it out of the dispatch
+    # budget. Measured TWICE, keeping the minimum: the first
+    # device->host transfer in a process pays one-time tunnel/DMA
+    # setup that inflated the reserve enough to swallow a whole 30 s
+    # budget (the engine then stopped at t=1.7 s having done nothing —
+    # round-4 probe regression)
+    dts = []
+    for _ in range(2):
+        t0 = time.monotonic()
+        _fetch_final(state, n_islands, cfg.pop_size)
+        dts.append(time.monotonic() - t0)
+    _FETCH_CACHE[(_mesh_key(mesh), sig, cfg.pop_size)] = min(dts)
+    # polish runners for BOTH phase configs: the init polish uses the
+    # repair config's, the budget-tail polish (see _run_tries) uses the
+    # ACTIVE phase's — and neither may compile inside a timed budget
+    for g in ([gacfg] if gacfg_post is None else [gacfg, gacfg_post]):
+        if gacfg.init_sweeps <= 0 and g.ls_mode != "sweep":
+            continue
+        g_spg_key = (_mesh_key(mesh), g, fingerprint)
+        polish, pwarm = cached_polish_runner(mesh, g, sig)
         jax.block_until_ready(polish(pa, key, state, 1))
-        if not pwarm:
+        if not pwarm or g_spg_key not in _SPS_CACHE:
             t0 = time.monotonic()
             jax.block_until_ready(
                 polish(pa, jax.random.key(1), state, 1))
             sps = time.monotonic() - t0
-            prev = _SPS_CACHE.get(spg_key)
-            _SPS_CACHE[spg_key] = (sps if prev is None
-                                   else 0.7 * sps + 0.3 * prev)
+            prev = _SPS_CACHE.get(g_spg_key)
+            _SPS_CACHE[g_spg_key] = (sps if prev is None
+                                     else 0.7 * sps + 0.3 * prev)
     # static dispatches always run gens = migration_period (shorter
     # remainders go through the dynamic runner), at pow2 n_ep; compile
     # exactly those — for BOTH phase configs when a post-feasibility
@@ -461,8 +476,13 @@ def _run_tries(cfg: RunConfig, out) -> int:
     gacfg_init = dataclasses.replace(gacfg, init_sweeps=0)
     seed = cfg.resolved_seed()
     # -t must cover the endTry fetch too: reserve its measured cost out
-    # of every dispatch-fitting decision (1.0 s prior when unmeasured)
+    # of every dispatch-fitting decision (1.0 s prior when unmeasured).
+    # Capped at a quarter of the budget: an implausibly large measured
+    # reserve (first-fetch tunnel setup, transient stall) must degrade
+    # to a bounded overshoot risk, not to the run doing NOTHING with
+    # its budget
     reserve = _FETCH_CACHE.get((_mesh_key(mesh), sig, cfg.pop_size), 1.0)
+    reserve = min(reserve, 0.25 * cfg.time_limit)
     _phase(out, cfg.trace, "load", 0, time.monotonic() - t0)
 
     global_best = INT_MAX
@@ -589,6 +609,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
             cur_key = (_mesh_key(mesh), cur, fingerprint)
             _phase(out, cfg.trace, "phase-switch", trial, 0.0, gens=0)
         sec_per_gen = _spg_for(cur_key, cur, gacfg, spg_key)
+        time_stopped = False
         while gens_done < cfg.generations:
             remaining_t = (cfg.time_limit - reserve
                            - (time.monotonic() - t_try))
@@ -639,6 +660,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
                 stop, dyn_gens is not None, n_ep,
                 0 if dyn_gens is None else dyn_gens)
             if stop:
+                time_stopped = True
                 break
             dyn_gens = dg if is_dyn else None
 
@@ -704,6 +726,66 @@ def _run_tries(cfg: RunConfig, out) -> int:
                 epochs_at_ckpt = epochs_done
                 _phase(out, cfg.trace, "checkpoint", trial,
                        time.monotonic() - t)
+
+        # BUDGET-TAIL POLISH: the generation loop stops when not even
+        # one more generation fits, stranding up to sec_per_gen seconds
+        # — multi-second for deep-children configs (measured: 8 s of a
+        # 60 s comp05s race). Sweep passes are an order finer-grained,
+        # so the stranded slice runs LS-only polish over the whole
+        # population instead of idling. The reference spends its last
+        # slice the same way: the per-candidate clock check means the
+        # final moments are pure local search (Solution.cpp:499). Only
+        # dispatched when the runner is already compiled (precompile
+        # builds it for both phase configs) and a measured sec/sweep
+        # says a chunk fits.
+        sec_per_sweep = (_SPS_CACHE.get(cur_key)
+                         if cur.ls_mode == "sweep" and time_stopped
+                         else None)
+        if sec_per_sweep is not None and sec_per_sweep > 0:
+            polish, pwarm = cached_polish_runner(mesh, cur, sig)
+            prev_sum = None
+            stalls = 0
+            while pwarm:
+                remaining_t = (cfg.time_limit - reserve
+                               - (time.monotonic() - t_try))
+                chunk = min(4, int(remaining_t / (1.25 * sec_per_sweep)))
+                chunk, = _sync_vals(chunk)
+                if chunk < 1:
+                    break
+                key, k_tail = jax.random.split(key)
+                tp0 = time.monotonic()
+                state, stats = polish(pa, k_tail, state, chunk)
+                stats = _fetch(stats)
+                tp1 = time.monotonic()
+                _phase(out, cfg.trace, "tail-polish", trial, tp1 - tp0,
+                       sweeps=chunk)
+                # the local estimate adapts (converged chunks early-exit
+                # and get cheaper) but is NOT written back to
+                # _SPS_CACHE: a converge-deflated sec/sweep would make a
+                # later run's init polish admit chunks ~4x its
+                # prediction right at the budget boundary
+                sec_per_sweep = (0.7 * (tp1 - tp0) / chunk
+                                 + 0.3 * sec_per_sweep)
+                hcv_a = stats[1].reshape(n_islands, -1)
+                scv_a = stats[2].reshape(n_islands, -1)
+                for i in range(n_islands):
+                    rep = jsonl.reported_best(hcv_a[i, 0], scv_a[i, 0])
+                    if rep < best_seen[i]:
+                        best_seen[i] = rep
+                        jsonl.log_entry(out, i, 0, rep, tp1 - t_try)
+                # same stall rule as the init polish: once the penalty
+                # sum stops dropping the population is at (or plateau-
+                # walking around) its sweep fixed point — without
+                # sideways acceptance every further chunk is a no-op,
+                # and even with it two flat chunks end the walk
+                cur_sum = int(stats[0].astype(np.int64).sum())
+                if prev_sum is not None and cur_sum >= prev_sum:
+                    stalls += 1
+                    if stalls >= 2 or cur.ls_sideways == 0.0:
+                        break
+                else:
+                    stalls = 0
+                prev_sum = cur_sum
 
         # final per-island solution records (endTry, ga.cpp:169-197)
         t = time.monotonic()

@@ -105,6 +105,7 @@ _TUNE_FIELDS = {"pop": "pop_size", "sweeps": "ls_sweeps",
                 "post_sweeps": "post_ls_sweeps",
                 "post_swap_block": "post_swap_block",
                 "post_hot_k": "post_hot_k",
+                "post_sideways": "post_sideways",
                 "epochs_per_dispatch": "epochs_per_dispatch"}
 
 
@@ -184,6 +185,7 @@ def main():
         "post_sweeps": opt("--post-sweeps", None, int),
         "post_swap_block": opt("--post-swap-block", None, int),
         "post_hot_k": opt("--post-hot-k", None, int),
+        "post_sideways": opt("--post-sideways", None, float),
         "epochs_per_dispatch": opt("--epochs-per-dispatch", None, int),
     }
     do_cpu = "--no-cpu" not in argv

@@ -16,13 +16,16 @@ from tools.quality_race import make_instances, run_tpu, warm_tpu  # noqa: E402
 
 
 GRID = [
-    # round-4 scv-endgame probes, part 3: pop 32 won part 2 (82 vs 135
-    # at pop 256 — more generations of GA mixing beat deeper children);
-    # push toward the reference's own pop 10 with deeper polish
-    dict(pop=16, post_sweeps=8, post_swap_block=32, post_hot_k=0),
-    dict(pop=8, post_sweeps=8, post_swap_block=32, post_hot_k=0),
-    dict(pop=32, post_sweeps=16, post_swap_block=32, post_hot_k=0),
-    dict(pop=16, post_sweeps=16, post_swap_block=64, post_hot_k=0),
+    # round-4 probes, part 6 (small instances, 30 s budget): the comp
+    # winner was pop 16 + deep full-pivot post polish (comp01s 68,
+    # comp05s 343 — the latter beating the round-3 CPU 351). Does the
+    # same endgame recipe beat the shipped small defaults (pop 128,
+    # 6 sweeps -> 17 vs CPU 14 in round 3)?
+    dict(),   # shipped tuned defaults, as the baseline
+    dict(pop=16, sweeps=2, hot_k=48, init_sweeps=200,
+         migration_period=2, post_sweeps=16, post_swap_block=64,
+         post_hot_k=0),
+    dict(pop=32, post_sweeps=12, post_swap_block=64, post_hot_k=0),
 ]
 
 
