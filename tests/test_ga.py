@@ -87,11 +87,39 @@ def test_random_move_never_move1(small_problem):
 
 def test_tournament_picks_best_of_draws(small_problem):
     penalty = jnp.asarray(np.arange(100, 0, -1, dtype=np.int32))  # best=99
+    scv = jnp.zeros(100, jnp.int32)
     for i in range(20):
         key = jax.random.key(i)
-        w = int(ga.tournament(key, penalty, 5))
+        w = int(ga.tournament(key, penalty, scv, 5))
         draws = np.asarray(jax.random.randint(key, (5,), 0, 100))
         assert w == draws[np.argmin(np.asarray(penalty)[draws])]
+
+
+def test_tournament_breaks_penalty_ties_by_scv():
+    """At equal penalty the tournament must prefer lower scv — the
+    reported-metric (hcv*1e6+scv) tie-break (fitness.lex_order): when
+    hcv sits at an infeasibility floor the race is decided by scv."""
+    penalty = jnp.full((50,), 1_000_005, jnp.int32)
+    scv = jnp.asarray(np.arange(50, 0, -1, dtype=np.int32))
+    for i in range(20):
+        key = jax.random.key(200 + i)
+        w = int(ga.tournament(key, penalty, scv, 5))
+        draws = np.asarray(jax.random.randint(key, (5,), 0, 50))
+        assert int(scv[w]) == int(np.asarray(scv)[draws].min())
+
+
+def test_lex_order_sorts_reported_metric():
+    """fitness.lex_order == ascending sort of hcv*1e6+scv whenever the
+    internal penalty majorizes (it always does: feasible penalty IS scv
+    and any hcv difference dominates the infeasible offset)."""
+    rng = np.random.default_rng(3)
+    hcv = rng.integers(0, 4, 64).astype(np.int32)
+    scv = rng.integers(0, 300, 64).astype(np.int32)
+    pen = np.where(hcv == 0, scv, 1_000_000 + hcv).astype(np.int32)
+    order = np.asarray(fitness.lex_order(jnp.asarray(pen),
+                                         jnp.asarray(scv)))
+    reported = hcv.astype(np.int64) * 1_000_000 + scv
+    assert (np.diff(reported[order]) >= 0).all()
 
 
 def test_init_population_sorted_and_valid(small_problem):

@@ -88,6 +88,22 @@ def test_sweep_monotone_improvement(inst):
     assert (np.asarray(s1) >= 0).all() and (np.asarray(s1) < pa.n_slots).all()
 
 
+def test_sweep_acceptance_is_lexicographic(inst):
+    """Acceptance uses the (penalty, scv) lexicographic order — the
+    reported evaluation's (hcv*1e6+scv) total order: per individual a
+    pass may never worsen the pair, and among infeasible individuals
+    whose penalty holds, scv may only drop (penalty-only acceptance let
+    scv drift while hcv sat at an infeasibility floor — the round-4
+    `medium` race regime)."""
+    problem, pa = inst
+    slots, rooms = _rand_pop(pa, jax.random.key(21), 8)
+    st0 = init_state(pa, slots, rooms)
+    st1, _ = sweep.sweep_pass(pa, jax.random.key(22), st0, swap_block=4)
+    p0, s0 = np.asarray(st0.pen), np.asarray(st0.scv)
+    p1, s1 = np.asarray(st1.pen), np.asarray(st1.scv)
+    assert ((p1 < p0) | ((p1 == p0) & (s1 <= s0))).all()
+
+
 def test_sweep_converge_reaches_local_optimum(inst):
     """converge=True must run passes until the WHOLE population is at a
     Move1+Move2-block local optimum (the reference's stopping rule): one
@@ -361,13 +377,20 @@ def test_move3_superset_neighborhood_property():
     slots = jax.random.randint(jax.random.key(20), (16, pa.n_events), 0,
                                pa.n_slots, dtype=jnp.int32)
     rooms = batch_assign_rooms(pa, slots)
-    a = sweep.sweep_local_search(pa, jax.random.key(21), slots, rooms,
-                                 n_sweeps=6, swap_block=4, p3=0.0)
-    b = sweep.sweep_local_search(pa, jax.random.key(21), slots, rooms,
-                                 n_sweeps=6, swap_block=4, p3=1.0)
-    pen_a, _, _ = fitness.batch_penalty(pa, *a)
-    pen_b, _, _ = fitness.batch_penalty(pa, *b)
-    # identical keys, superset candidates: the p3 path must not lose on
-    # average (each step picks the argmin over a superset; trajectories
-    # diverge but the richer neighborhood should not hurt the mean)
-    assert np.asarray(pen_b).mean() <= np.asarray(pen_a).mean() * 1.05
+    # identical keys, superset candidates: per step the p3 path picks
+    # the lexicographic argmin over a superset, but trajectories diverge
+    # after the first differing pick, so any single key is noise-bound
+    # (final penalties are small integers; a one-key mean once flipped
+    # from pass to fail on an unrelated tie-break change). Aggregate
+    # over several keys and allow absolute slack of 1 scv point.
+    means_a, means_b = [], []
+    for k in (21, 22, 23):
+        a = sweep.sweep_local_search(pa, jax.random.key(k), slots, rooms,
+                                     n_sweeps=6, swap_block=4, p3=0.0)
+        b = sweep.sweep_local_search(pa, jax.random.key(k), slots, rooms,
+                                     n_sweeps=6, swap_block=4, p3=1.0)
+        pen_a, _, _ = fitness.batch_penalty(pa, *a)
+        pen_b, _, _ = fitness.batch_penalty(pa, *b)
+        means_a.append(np.asarray(pen_a).mean())
+        means_b.append(np.asarray(pen_b).mean())
+    assert np.mean(means_b) <= np.mean(means_a) + 1.0, (means_a, means_b)

@@ -141,6 +141,21 @@ def reported_evaluation(hcv, scv) -> int:
     return int(hcv) * INFEASIBLE_OFFSET + int(scv)
 
 
+def lex_order(penalty, scv):
+    """Sort indices by (penalty, scv) lexicographically — the total
+    order of the REPORTED evaluation (hcv*1e6+scv, ga.cpp:191) expressed
+    without its int32-overflowing composite: the internal penalty
+    majorizes exactly as in the reported form (any hcv difference
+    dominates; feasible penalty IS scv), and scv breaks penalty ties.
+
+    The tie-break matters whenever hcv is pinned at an infeasibility
+    floor: under plain penalty ordering the population drifts on scv —
+    invisible internally, but the reported metric counts every point of
+    it (round-4 race: `medium` never goes feasible for either side, so
+    best-at-budget is decided entirely by scv at equal hcv)."""
+    return jnp.lexsort((scv, penalty))
+
+
 # ---------------------------------------------------------------------------
 # Batched (population) forms
 #

@@ -98,9 +98,11 @@ class PopState(NamedTuple):
 
 
 def evaluate(pa, slots, rooms_arr) -> PopState:
-    """Build a PopState by evaluating (P, E) genotypes, sorted best-first."""
+    """Build a PopState by evaluating (P, E) genotypes, sorted best-first
+    by (penalty, scv) — the reported-evaluation order (fitness.lex_order),
+    so row 0 is the individual the JSONL protocol should report."""
     penalty, hcv, scv = fitness.batch_penalty(pa, slots, rooms_arr)
-    order = jnp.argsort(penalty)
+    order = fitness.lex_order(penalty, scv)
     return PopState(slots=slots[order], rooms=rooms_arr[order],
                     penalty=penalty[order], hcv=hcv[order], scv=scv[order])
 
@@ -138,14 +140,17 @@ def init_population(pa, key, pop_size: int,
     return evaluate(pa, slots, rooms_arr)
 
 
-def tournament(key, penalty: jnp.ndarray, k: int) -> jnp.ndarray:
+def tournament(key, penalty: jnp.ndarray, scv: jnp.ndarray,
+               k: int) -> jnp.ndarray:
     """Tournament selection: k uniform draws, return index of the best
-    (ga.cpp:129-145 selection5: 5 draws, argmin penalty). The reference
-    reads the population unlocked while other threads sort (a data race,
-    SURVEY C14); here the population is immutable within a generation."""
+    by (penalty, scv) — scv breaks penalty ties toward the reported
+    metric (ga.cpp:129-145 selection5: 5 draws, argmin penalty). The
+    reference reads the population unlocked while other threads sort (a
+    data race, SURVEY C14); here the population is immutable within a
+    generation."""
     P = penalty.shape[0]
     draws = jax.random.randint(key, (k,), 0, P)
-    return draws[jnp.argmin(penalty[draws])]
+    return draws[jnp.lexsort((scv[draws], penalty[draws]))[0]]
 
 
 def _make_child(pa, key, state: PopState, cfg: GAConfig, mo_stats=None):
@@ -166,8 +171,8 @@ def _make_child(pa, key, state: PopState, cfg: GAConfig, mo_stats=None):
         ia = nsga.crowded_tournament(k_a, ranks, crowd, cfg.tournament_k)
         ib = nsga.crowded_tournament(k_b, ranks, crowd, cfg.tournament_k)
     else:
-        ia = tournament(k_a, state.penalty, cfg.tournament_k)
-        ib = tournament(k_b, state.penalty, cfg.tournament_k)
+        ia = tournament(k_a, state.penalty, state.scv, cfg.tournament_k)
+        ib = tournament(k_b, state.penalty, state.scv, cfg.tournament_k)
     s_a, r_a = state.slots[ia], state.rooms[ia]
     s_b = state.slots[ib]
 
@@ -245,9 +250,9 @@ def generation(pa, key, state: PopState, cfg: GAConfig) -> PopState:
         # migration emigrants (parallel/islands.py relies on that)
         from timetabling_ga_tpu.ops.nsga import nsga_survivor_indices
         keep = nsga_survivor_indices(all_hcv, all_scv, cfg.pop_size)
-        order = keep[jnp.argsort(all_pen[keep])]
+        order = keep[fitness.lex_order(all_pen[keep], all_scv[keep])]
     else:
-        order = jnp.argsort(all_pen)[:cfg.pop_size]
+        order = fitness.lex_order(all_pen, all_scv)[:cfg.pop_size]
     return PopState(slots=all_slots[order], rooms=all_rooms[order],
                     penalty=all_pen[order], hcv=all_hcv[order],
                     scv=all_scv[order])

@@ -39,7 +39,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-from timetabling_ga_tpu.ops import ga
+from timetabling_ga_tpu.ops import fitness, ga
 
 
 AXIS = "island"
@@ -115,8 +115,9 @@ def _migrate(state: ga.PopState, n_islands: int) -> ga.PopState:
 
     state = jax.tree.map(lambda x, a, b: x.at[-1].set(a).at[-2].set(b),
                          state, imm_f, imm_b)
-    # restore sorted order (replacement + sort, ga.cpp:580-585)
-    order = jnp.argsort(state.penalty)
+    # restore sorted order (replacement + sort, ga.cpp:580-585), by the
+    # reported-metric order (penalty, scv) like everywhere else
+    order = fitness.lex_order(state.penalty, state.scv)
     return jax.tree.map(lambda x: x[order], state)
 
 

@@ -168,10 +168,14 @@ class RunConfig:
                  # comp05s to 343 (< the round-3 CPU baseline 351).
                  # Small populations win: with children this deep, GA
                  # mixing generations beat multistart breadth
+                 # epochs_per_dispatch 4: at migration_period 2 a
+                 # dispatch per epoch is a host round trip every 2
+                 # generations; fusing 4 epochs cut comp01s 68 -> 64
+                 # and medium 239 -> 224 (probe part 7)
                  dict(pop_size=16, ls_sweeps=2, init_sweeps=200,
                       ls_swap_block=8, migration_period=2,
                       ls_hot_k=48, post_hot_k=0, post_ls_sweeps=16,
-                      post_swap_block=64))
+                      post_swap_block=64, epochs_per_dispatch=4))
         # plateau-walking acceptance: measured to take comp05s from
         # never-feasible (hcv stuck at 3 — pure correlation clashes) to
         # feasible in ~24 s; see ops/sweep.py sweep_pass

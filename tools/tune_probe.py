@@ -16,16 +16,15 @@ from tools.quality_race import make_instances, run_tpu, warm_tpu  # noqa: E402
 
 
 GRID = [
-    # round-4 probes, part 6 (small instances, 30 s budget): the comp
-    # winner was pop 16 + deep full-pivot post polish (comp01s 68,
-    # comp05s 343 — the latter beating the round-3 CPU 351). Does the
-    # same endgame recipe beat the shipped small defaults (pop 128,
-    # 6 sweeps -> 17 vs CPU 14 in round 3)?
-    dict(),   # shipped tuned defaults, as the baseline
-    dict(pop=16, sweeps=2, hot_k=48, init_sweeps=200,
-         migration_period=2, post_sweeps=16, post_swap_block=64,
-         post_hot_k=0),
-    dict(pop=32, post_sweeps=12, post_swap_block=64, post_hot_k=0),
+    # round-4 probes, part 7: (a) effect of the lexicographic
+    # (penalty, scv) ordering on the scv-decided regimes, (b) fusing
+    # more epochs per dispatch — at migration_period 2 the engine does
+    # a host round trip every 2 generations, and on this tunnel each
+    # trace fetch is expensive, so fusion may reclaim a large budget
+    # fraction
+    dict(),   # shipped tuned defaults (now with lex ordering)
+    dict(epochs_per_dispatch=4),
+    dict(epochs_per_dispatch=8),
 ]
 
 
