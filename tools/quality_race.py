@@ -153,6 +153,29 @@ def run_tpu(tim_path: str, budget: float, seed: int, tune: dict,
             "wall_s": round(dt, 1), **used}
 
 
+def _tpu_retry(fn, *args, attempts: int = 3, wait_s: float = 90.0):
+    """Run a TPU-side race step, retrying on device UNAVAILABLE errors.
+
+    The tunneled device goes through sick windows (minutes long) where
+    any dispatch dies with 'UNAVAILABLE: TPU device error' — an
+    infrastructure artifact that killed entire race legs (round 4:
+    three comp05s attempts died in such windows while every component
+    passed in isolation between them). A retry after a wait usually
+    lands in a healthy window. Timed results are unaffected: a run
+    either completes its full budget or raises."""
+    from jax.errors import JaxRuntimeError
+    for attempt in range(attempts):
+        try:
+            return fn(*args)
+        except JaxRuntimeError as e:
+            if "UNAVAILABLE" not in str(e) or attempt == attempts - 1:
+                raise
+            print(f"# device UNAVAILABLE ({fn.__name__}, attempt "
+                  f"{attempt + 1}/{attempts}); retrying in {wait_s:.0f}s",
+                  file=sys.stderr, flush=True)
+            time.sleep(wait_s)
+
+
 def main():
     argv = sys.argv[1:]
 
@@ -197,11 +220,13 @@ def main():
                 "w", suffix=".tim", delete=False) as fh:
             fh.write(dump_tim(problem))
             tim_path = fh.name
-        warm_tpu(tim_path, budget, seeds[0], tune, problem.n_events)
+        _tpu_retry(warm_tpu, tim_path, budget, seeds[0], tune,
+                   problem.n_events)
         for seed in seeds:
             cpu = (run_cpu_baseline(tim_path, budget, seed)
                    if do_cpu else None)
-            tpu = run_tpu(tim_path, budget, seed, tune, problem.n_events)
+            tpu = _tpu_retry(run_tpu, tim_path, budget, seed, tune,
+                             problem.n_events)
             row = {"instance": name, "budget_s": budget, "seed": seed,
                    "cpu": cpu, "tpu": tpu}
             if cpu is not None:
