@@ -389,7 +389,10 @@ def measure_ls_shootout(problem) -> dict:
 
 def main() -> None:
     problem = _instance()
-    tpu = measure_tpu_evals(problem)
+    # retry the headline through device sick windows (shared policy,
+    # timetabling_ga_tpu/runtime/retry.py) instead of zeroing the round
+    from timetabling_ga_tpu.runtime.retry import retry_unavailable
+    tpu = retry_unavailable(measure_tpu_evals, problem)
     cpu = measure_cpu_native(problem)
     vs_baseline = tpu / cpu if cpu > 0 else 0.0
 

@@ -123,10 +123,27 @@ def cached_init(mesh, pop_size: int, gacfg: ga.GAConfig):
     return f
 
 
+# Hard ceiling on one fused dispatch's predicted wall time. The
+# tunneled device kills kernels that run too long ('UNAVAILABLE: TPU
+# device error — often a kernel fault'): the comp05s post-phase runner
+# at 4 fused epochs crossed that watchdog while 2 epochs stayed under
+# it, and the converge while_loops' data-dependent pass counts made the
+# failure nondeterministic across runs (round-4 diagnosis: every
+# component passed in isolation; the step-by-step precompile died
+# exactly at post/n_ep=4). Dispatches are therefore sized so
+# sec_per_gen * gens <= this cap — long enough to amortize the ~70 ms
+# dispatch + trace-fetch overhead, far under the watchdog.
+DISPATCH_CAP_S = 30.0
+
 # Measured seconds-per-generation, persisted across engine.run calls with
 # the same (mesh, config, problem shape) so a warm-up run's measurement
 # bounds even the FIRST dispatch of a later timed run.
 _SPG_CACHE: dict = {}
+# Largest n_epochs precompile actually built per (mesh, gacfg,
+# fingerprint) under DISPATCH_CAP_S — timed runs never dispatch beyond
+# it (a bigger shape would both compile mid-budget and risk the
+# watchdog).
+_MAX_EP_CACHE: dict = {}
 # Likewise for seconds-per-sweep-pass of the init polish runner.
 _SPS_CACHE: dict = {}
 # Measured final-fetch cost (slots/rooms/hcv/scv round trip), reserved
@@ -387,7 +404,14 @@ def precompile(cfg: RunConfig) -> None:
     for g in ([gacfg] if gacfg_post is None else [gacfg, gacfg_post]):
         g_spg_key = (_mesh_key(mesh), g, fingerprint)
         n_ep = 1
+        max_built = 0
         while n_ep <= max_ep:
+            spg_est = _SPG_CACHE.get(g_spg_key)
+            if (n_ep > 1 and spg_est is not None
+                    and spg_est * gens * n_ep > DISPATCH_CAP_S):
+                # a fused dispatch this large would risk the device's
+                # long-kernel watchdog — don't even build the shape
+                break
             runner, warm = cached_runner(mesh, g, n_ep, gens, sig)
             st2, _, _ = runner(pa, key, state)
             jax.block_until_ready(st2)
@@ -404,7 +428,9 @@ def precompile(cfg: RunConfig) -> None:
                 prev = _SPG_CACHE.get(g_spg_key)
                 _SPG_CACHE[g_spg_key] = (spg if prev is None
                                          else 0.7 * spg + 0.3 * prev)
+            max_built = n_ep
             n_ep *= 2
+        _MAX_EP_CACHE[g_spg_key] = max(max_built, 1)
         dyn, _ = cached_dynamic_runner(mesh, g, cfg.migration_period,
                                        sig)
         jax.block_until_ready(dyn(pa, key, state, 1))
@@ -642,11 +668,36 @@ def _run_tries(cfg: RunConfig, out) -> int:
                 # (pow2 n_ep, migration_period) shapes — the exact set
                 # precompile() builds
                 n_ep = _pow2_floor(n_ep)
+                # never exceed what precompile built under the
+                # long-kernel watchdog cap (DISPATCH_CAP_S), and bound
+                # the dispatch's PREDICTED wall time by the same cap —
+                # an over-long fused dispatch dies as a device error
+                cap_ep = _MAX_EP_CACHE.get(cur_key)
+                if cap_ep is not None:
+                    n_ep = min(n_ep, cap_ep)
+                if sec_per_gen is not None and sec_per_gen > 0:
+                    fit_cap = int(DISPATCH_CAP_S / (sec_per_gen * gens))
+                    n_ep = max(1, min(n_ep, _pow2_floor(max(1, fit_cap))))
+                if (sec_per_gen is not None and sec_per_gen > 0
+                        and sec_per_gen * gens > DISPATCH_CAP_S):
+                    # even ONE epoch predicts over the watchdog cap:
+                    # fall through to the dynamic runner with however
+                    # many generations fit it (migration then closes
+                    # the shortened epoch — a cadence change, but the
+                    # alternative is a dispatch the device may kill)
+                    n_ep = 1
+                    dyn_gens = max(1, int(DISPATCH_CAP_S / sec_per_gen))
+                    dyn_gens = min(dyn_gens, gens)
             else:
                 # clamped final dispatch: fewer than migration_period
                 # generations left — served by the dynamic-gens runner
-                # (no fresh static shape, no new compile)
+                # (no fresh static shape, no new compile). The watchdog
+                # cap applies here too: a 40-generation tail at 1 s/gen
+                # would otherwise be one over-cap fused dispatch
                 n_ep, dyn_gens = 1, remaining
+                if sec_per_gen is not None and sec_per_gen > 0:
+                    dyn_gens = max(1, min(
+                        dyn_gens, int(DISPATCH_CAP_S / sec_per_gen)))
             if not stop and sec_per_gen is not None and sec_per_gen > 0:
                 # -t must HOLD: launch only work predicted to fit the
                 # remaining budget (the reference checks its clock before

@@ -153,27 +153,11 @@ def run_tpu(tim_path: str, budget: float, seed: int, tune: dict,
             "wall_s": round(dt, 1), **used}
 
 
-def _tpu_retry(fn, *args, attempts: int = 3, wait_s: float = 90.0):
-    """Run a TPU-side race step, retrying on device UNAVAILABLE errors.
-
-    The tunneled device goes through sick windows (minutes long) where
-    any dispatch dies with 'UNAVAILABLE: TPU device error' — an
-    infrastructure artifact that killed entire race legs (round 4:
-    three comp05s attempts died in such windows while every component
-    passed in isolation between them). A retry after a wait usually
-    lands in a healthy window. Timed results are unaffected: a run
-    either completes its full budget or raises."""
-    from jax.errors import JaxRuntimeError
-    for attempt in range(attempts):
-        try:
-            return fn(*args)
-        except JaxRuntimeError as e:
-            if "UNAVAILABLE" not in str(e) or attempt == attempts - 1:
-                raise
-            print(f"# device UNAVAILABLE ({fn.__name__}, attempt "
-                  f"{attempt + 1}/{attempts}); retrying in {wait_s:.0f}s",
-                  file=sys.stderr, flush=True)
-            time.sleep(wait_s)
+def _tpu_retry(fn, *args):
+    """Run a TPU-side race step through the shared sick-window retry
+    policy (timetabling_ga_tpu.runtime.retry)."""
+    from timetabling_ga_tpu.runtime.retry import retry_unavailable
+    return retry_unavailable(fn, *args, attempts=3, wait_s=90.0)
 
 
 def main():
