@@ -96,6 +96,7 @@ def run_cpu_baseline(tim_path: str, budget: float, seed: int) -> dict:
 
 
 _TUNE_FIELDS = {"pop": "pop_size", "sweeps": "ls_sweeps",
+                "p3": "p3",
                 "init_sweeps": "init_sweeps",
                 "swap_block": "ls_swap_block",
                 "migration_period": "migration_period",

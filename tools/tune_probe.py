@@ -16,15 +16,15 @@ from tools.quality_race import make_instances, run_tpu, warm_tpu  # noqa: E402
 
 
 GRID = [
-    # round-4 probes, part 7: (a) effect of the lexicographic
-    # (penalty, scv) ordering on the scv-decided regimes, (b) fusing
-    # more epochs per dispatch — at migration_period 2 the engine does
-    # a host round trip every 2 generations, and on this tunnel each
-    # trace fetch is expensive, so fusion may reclaim a large budget
-    # fraction
-    dict(),   # shipped tuned defaults (now with lex ordering)
-    dict(epochs_per_dispatch=4),
-    dict(epochs_per_dispatch=8),
+    # round-4 probes, part 9 (small-instance rescue, round 2): fusion
+    # and pop moved nothing (seeds 42/43 pinned at 16/20 across epd 1/4
+    # and pop 32/64 — a genuine search plateau). Try move classes and
+    # acceptance the current endgame lacks: 3-cycles (Move3 sweep
+    # block), a hotter plateau walk in the post phase, deeper per-child
+    # main-phase sweeps
+    dict(p3=0.15),
+    dict(post_sideways=0.5),
+    dict(sweeps=8),
 ]
 
 
