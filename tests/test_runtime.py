@@ -426,6 +426,7 @@ def test_apply_tuned_defaults_size_rule_and_overrides():
     assert small.ls_mode == "sweep" and small.ls_converge
     assert small.ls_sideways > 0
     assert small.post_ls_sweeps and small.post_hot_k == 0
+    assert small.p3 > 0   # Move3 sweep block: the small-plateau lever
     big = RunConfig(input="x.tim").apply_tuned_defaults(400)
     assert (big.pop_size, big.ls_sweeps, big.init_sweeps) == (16, 2, 200)
     assert big.ls_hot_k > 0 and big.post_hot_k == 0

@@ -155,7 +155,14 @@ class RunConfig:
         tuned = (dict(pop_size=32, ls_sweeps=6, init_sweeps=30,
                       ls_swap_block=8, migration_period=10,
                       post_ls_sweeps=12, post_swap_block=64,
-                      post_hot_k=0)
+                      post_hot_k=0,
+                      # 3-cycles in the sweep (Move3 block) escape the
+                      # small-instance scv plateaus Move1/2 cannot:
+                      # round-4 probe part 9, seeds 42/43 went 16 -> 14
+                      # and 20 -> 16 while every other lever (pop,
+                      # dispatch fusion, hotter sideways, more sweeps)
+                      # moved nothing
+                      p3=0.15)
                  if n_events <= 200 else
                  # comp scale: violation-guided top-K sweeps while
                  # infeasible (repair is concentrated on few hot events

@@ -403,12 +403,28 @@ def precompile(cfg: RunConfig) -> None:
               if cfg.generations >= cfg.migration_period else 0)
     for g in ([gacfg] if gacfg_post is None else [gacfg, gacfg_post]):
         g_spg_key = (_mesh_key(mesh), g, fingerprint)
+        # dynamic runner FIRST: one generation is the smallest dispatch
+        # the engine can make, so it doubles as the safe sec/gen probe
+        # for configs whose FULL epoch would outrun the watchdog (a
+        # deep post config at a long migration_period — e.g. p3 sweeps
+        # at migration_period 10 — dies inside even the n_ep=1 static
+        # shape; executing that shape to measure it is the bug)
+        dyn, _ = cached_dynamic_runner(mesh, g, cfg.migration_period,
+                                       sig)
+        jax.block_until_ready(dyn(pa, key, state, 1))
+        spg_est = _SPG_CACHE.get(g_spg_key)
+        if spg_est is None:
+            t0 = time.monotonic()
+            jax.block_until_ready(dyn(pa, jax.random.key(1), state, 1))
+            # 1 generation + dispatch/migration overhead: an
+            # OVERESTIMATE of sec/gen, used only to gate the static
+            # builds below (conservative = never builds a shape the
+            # watchdog would kill)
+            spg_est = time.monotonic() - t0
         n_ep = 1
         max_built = 0
         while n_ep <= max_ep:
-            spg_est = _SPG_CACHE.get(g_spg_key)
-            if (n_ep > 1 and spg_est is not None
-                    and spg_est * gens * n_ep > DISPATCH_CAP_S):
+            if spg_est * gens * n_ep > DISPATCH_CAP_S:
                 # a fused dispatch this large would risk the device's
                 # long-kernel watchdog — don't even build the shape
                 break
@@ -428,12 +444,22 @@ def precompile(cfg: RunConfig) -> None:
                 prev = _SPG_CACHE.get(g_spg_key)
                 _SPG_CACHE[g_spg_key] = (spg if prev is None
                                          else 0.7 * spg + 0.3 * prev)
+                spg_est = _SPG_CACHE[g_spg_key]
             max_built = n_ep
             n_ep *= 2
-        _MAX_EP_CACHE[g_spg_key] = max(max_built, 1)
-        dyn, _ = cached_dynamic_runner(mesh, g, cfg.migration_period,
-                                       sig)
-        jax.block_until_ready(dyn(pa, key, state, 1))
+        if max_built == 0 and g_spg_key not in _SPG_CACHE:
+            # even one epoch predicts over the cap: timed runs go
+            # through the dynamic runner with capped generation counts,
+            # which needs a sec/gen estimate — store the conservative
+            # dyn-probe value (overhead fraction is negligible for
+            # generations this heavy)
+            _SPG_CACHE[g_spg_key] = spg_est
+        if max_ep >= 1:
+            # max_ep == 0 means the GENERATION BUDGET is below one
+            # epoch (a smoke run), not that the watchdog refused static
+            # shapes — recording 0 would force every later same-config
+            # run in this process onto the dynamic runner
+            _MAX_EP_CACHE[g_spg_key] = max_built
 
 
 def run(cfg: RunConfig, out=None) -> int:
@@ -673,21 +699,26 @@ def _run_tries(cfg: RunConfig, out) -> int:
                 # the dispatch's PREDICTED wall time by the same cap —
                 # an over-long fused dispatch dies as a device error
                 cap_ep = _MAX_EP_CACHE.get(cur_key)
-                if cap_ep is not None:
+                if cap_ep:
                     n_ep = min(n_ep, cap_ep)
                 if sec_per_gen is not None and sec_per_gen > 0:
                     fit_cap = int(DISPATCH_CAP_S / (sec_per_gen * gens))
                     n_ep = max(1, min(n_ep, _pow2_floor(max(1, fit_cap))))
-                if (sec_per_gen is not None and sec_per_gen > 0
+                if cap_ep == 0 or (
+                        sec_per_gen is not None and sec_per_gen > 0
                         and sec_per_gen * gens > DISPATCH_CAP_S):
-                    # even ONE epoch predicts over the watchdog cap:
-                    # fall through to the dynamic runner with however
-                    # many generations fit it (migration then closes
-                    # the shortened epoch — a cadence change, but the
-                    # alternative is a dispatch the device may kill)
+                    # even ONE epoch predicts over the watchdog cap
+                    # (or precompile refused to build any static shape,
+                    # cap_ep == 0): fall through to the dynamic runner
+                    # with however many generations fit — migration
+                    # then closes the shortened epoch, a cadence
+                    # change, but the alternative is a dispatch the
+                    # device may kill
                     n_ep = 1
-                    dyn_gens = max(1, int(DISPATCH_CAP_S / sec_per_gen))
-                    dyn_gens = min(dyn_gens, gens)
+                    dyn_gens = gens
+                    if sec_per_gen is not None and sec_per_gen > 0:
+                        dyn_gens = max(1, min(
+                            gens, int(DISPATCH_CAP_S / sec_per_gen)))
             else:
                 # clamped final dispatch: fewer than migration_period
                 # generations left — served by the dynamic-gens runner
@@ -752,13 +783,21 @@ def _run_tries(cfg: RunConfig, out) -> int:
                    epochs=n_ep, gens=gens_run)
             gens_done += gens_run
             epochs_done += n_ep
-            if warm and gens_run >= cfg.migration_period:
+            if warm and (gens_run >= cfg.migration_period
+                         or td1 - td0 >= 5.0):
                 # compiling dispatches are excluded: compile time would
                 # inflate the estimate, and the poisoned value would both
                 # end this run early and persist into later runs. Tiny
                 # dynamic tails are excluded too: their wall time is
                 # dominated by fixed dispatch/migration/fetch overhead,
-                # which would inflate the per-generation estimate
+                # which would inflate the per-generation estimate — but
+                # a dispatch that ran >= 5 s is overhead-free enough to
+                # measure REGARDLESS of generation count, which is the
+                # only feedback path in the watchdog-capped dyn regime
+                # (gens_run < migration_period on every dispatch there;
+                # without this the run would trust the one-generation
+                # precompile probe forever, and generation cost is
+                # data-dependent)
                 spg = (td1 - td0) / gens_run
                 sec_per_gen = (spg if sec_per_gen is None
                                else 0.7 * spg + 0.3 * sec_per_gen)
