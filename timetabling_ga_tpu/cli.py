@@ -14,11 +14,18 @@ from __future__ import annotations
 import sys
 
 from timetabling_ga_tpu.runtime import parse_args
-from timetabling_ga_tpu.runtime.engine import run
+from timetabling_ga_tpu.runtime.engine import precompile, run
 
 
 def main(argv=None) -> int:
     cfg = parse_args(sys.argv[1:] if argv is None else argv)
+    # compile-then-run, like the reference binary (mpicxx compiles
+    # before anyone races it): XLA compilation happens BEFORE the per-
+    # try clock starts, so -t bounds solve time, not compile time — a
+    # cold CLI run otherwise spends several times its budget compiling
+    # inside it. Also seeds the sec/gen estimates the budget-aware
+    # dispatch sizing needs on its very first dispatch.
+    precompile(cfg)
     run(cfg)
     return 0
 
