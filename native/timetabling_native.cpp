@@ -25,6 +25,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <ctime>
 #include <functional>
 #include <string>
 #include <vector>
@@ -352,7 +353,21 @@ struct GaParams {
   int threads = 1;
 };
 
+// --clock cpu switches every budget/timestamp read to process CPU time
+// (CLOCK_PROCESS_CPUTIME_ID). Two uses: (a) budgets immune to machine
+// contention when baselines run in the background; (b) an N-thread run
+// at wall budget T burns ~N*T CPU-seconds, so "-t N*T --clock cpu" on
+// one thread is the resource-equivalent stand-in for N OpenMP threads
+// splitting the generation budget (ga.cpp:510) — the asymmetric-budget
+// race protocol (BASELINE.md).
+static bool g_clock_cpu = false;
+
 static double now_sec() {
+  if (g_clock_cpu) {
+    struct timespec ts;
+    clock_gettime(CLOCK_PROCESS_CPUTIME_ID, &ts);
+    return (double)ts.tv_sec + ts.tv_nsec * 1e-9;
+  }
 #ifdef _OPENMP
   return omp_get_wtime();
 #else
@@ -1093,6 +1108,12 @@ int main(int argc, char **argv) {
     else if (a == "--ls-candidates") { const char *v = val(); if (v) g.ls_candidates = std::atoi(v); }
     else if (a == "--islands") { const char *v = val(); if (v) n_islands = std::atoi(v); }
     else if (a == "--migration-period") { const char *v = val(); if (v) migration_period = std::atoi(v); }
+    else if (a == "--clock") {
+      const char *v = val();
+      if (v && std::string(v) == "cpu") tt::g_clock_cpu = true;
+      else if (v && std::string(v) == "wall") tt::g_clock_cpu = false;
+      else { std::fprintf(stderr, "unknown --clock: %s (wall|cpu)\n", v ? v : ""); return 2; }
+    }
     else if (!a.empty()) { std::fprintf(stderr, "unknown flag: %s\n", a.c_str()); return 2; }
   }
   if (!input) { std::fprintf(stderr, "No instance file specified, use -i <file>\n"); return 2; }

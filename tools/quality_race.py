@@ -17,10 +17,26 @@ makes real — the timed run reuses the warm run's programs. Output: one
 result JSON per (instance, seed) on stdout plus a markdown summary
 table on stderr, for BASELINE.md.
 
+Asymmetric budgets (VERDICT round-4 next #1 — the honest 32-core
+extrapolation): `--cpu-budget-factor N` gives the CPU side N x the TPU
+wall-clock budget, measured in PROCESS CPU TIME (`tt_cpu --clock cpu`)
+so the number is immune to machine contention and equals what N OpenMP
+threads splitting the generation budget (ga.cpp:510) would burn in 1 x
+wall. `--no-tpu` runs only the CPU legs (so the long legs can run in
+the background), `--no-cpu` only the TPU legs; rows from separate
+invocations carry the same keys and merge by (instance, seed).
+
+Island legs (VERDICT round-4 next #2): `--cpu-islands N` runs the CPU
+side as N islands with ring migration (tt_cpu --islands); `--tpu-islands
+N` requests N islands on the TPU side (capped at the device count).
+`--nsga2` switches the TPU side to the NSGA-II replacement stage.
+
 Usage:
   python tools/quality_race.py [--budget S] [--quick] [--seeds a,b,c]
       [--pop N] [--sweeps N] [--init-sweeps N] [--swap-block N]
-      [--instances small,small-tight,...] [--no-cpu]
+      [--instances small,small-tight,...] [--no-cpu] [--no-tpu]
+      [--cpu-budget-factor N] [--cpu-islands N] [--tpu-islands N]
+      [--nsga2]
 """
 
 from __future__ import annotations
@@ -77,22 +93,44 @@ def _first_feasible_time(lines):
     return None
 
 
-def run_cpu_baseline(tim_path: str, budget: float, seed: int) -> dict:
-    threads = os.cpu_count() or 1
+def run_cpu_baseline(tim_path: str, budget: float, seed: int,
+                     factor: float = 1.0, islands: int = 1,
+                     clock: str = None) -> dict:
+    if clock is None:
+        clock = "cpu" if factor != 1.0 else "wall"
+    if clock not in ("wall", "cpu"):
+        raise SystemExit(f"unknown --cpu-clock: {clock} (wall|cpu)")
+    # wall mode: full host cores (the symmetric-race baseline). cpu mode:
+    # ONE thread — process CPU time is summed across threads, so N
+    # threads would burn the budget N x faster in wall terms and the
+    # recorded factor would overstate the handicap; the one-thread
+    # protocol keeps "factor N" == "N threads at 1x wall" exactly.
+    threads = 1 if clock == "cpu" else (os.cpu_count() or 1)
+    cpu_budget = budget * factor
+    cmd = [TT_CPU, "-i", tim_path, "-s", str(seed), "-c", str(threads),
+           "-t", str(cpu_budget), "--algo", "reference",
+           "--generations", "1000000"]
+    if clock == "cpu":
+        # budget measured in process CPU time: immune to contention when
+        # baseline legs run in the background (see module doc). NOTE the
+        # binary's logEntry timestamps (time_to_feasible_s) are then CPU
+        # seconds too — the "clock" field in the result records which.
+        cmd += ["--clock", "cpu"]
+    if islands > 1:
+        cmd += ["--islands", str(islands)]
     t0 = time.perf_counter()
     out = subprocess.run(
-        [TT_CPU, "-i", tim_path, "-s", str(seed), "-c", str(threads),
-         "-t", str(budget), "--algo", "reference",
-         "--generations", "1000000"],
-        capture_output=True, text=True, timeout=budget * 3 + 120,
-        check=True)
+        cmd, capture_output=True, text=True,
+        timeout=cpu_budget * 4 + 300, check=True)
     dt = time.perf_counter() - t0
     lines = [json.loads(x) for x in out.stdout.splitlines()]
     run_entries = [x["runEntry"] for x in lines if "runEntry" in x]
     return {"best": run_entries[-1]["totalBest"],
             "feasible": run_entries[-1]["feasible"],
             "time_to_feasible_s": _first_feasible_time(lines),
-            "wall_s": round(dt, 1), "threads": threads}
+            "wall_s": round(dt, 1), "threads": threads,
+            "budget_s": cpu_budget, "islands": islands,
+            "clock": clock}
 
 
 _TUNE_FIELDS = {"pop": "pop_size", "sweeps": "ls_sweeps",
@@ -107,7 +145,9 @@ _TUNE_FIELDS = {"pop": "pop_size", "sweeps": "ls_sweeps",
                 "post_swap_block": "post_swap_block",
                 "post_hot_k": "post_hot_k",
                 "post_sideways": "post_sideways",
-                "epochs_per_dispatch": "epochs_per_dispatch"}
+                "epochs_per_dispatch": "epochs_per_dispatch",
+                "tpu_islands": "islands",
+                "nsga2": "nsga2"}
 
 
 def tpu_config(tim_path: str, budget: float, seed: int, tune: dict,
@@ -195,8 +235,14 @@ def main():
         "post_hot_k": opt("--post-hot-k", None, int),
         "post_sideways": opt("--post-sideways", None, float),
         "epochs_per_dispatch": opt("--epochs-per-dispatch", None, int),
+        "tpu_islands": opt("--tpu-islands", None, int),
+        "nsga2": True if "--nsga2" in argv else None,
     }
     do_cpu = "--no-cpu" not in argv
+    do_tpu = "--no-tpu" not in argv
+    cpu_factor = opt("--cpu-budget-factor", 1.0)
+    cpu_islands = opt("--cpu-islands", 1, int)
+    cpu_clock = opt("--cpu-clock", None, str)
 
     from timetabling_ga_tpu.problem import dump_tim
     rows = []
@@ -205,22 +251,27 @@ def main():
                 "w", suffix=".tim", delete=False) as fh:
             fh.write(dump_tim(problem))
             tim_path = fh.name
-        _tpu_retry(warm_tpu, tim_path, budget, seeds[0], tune,
-                   problem.n_events)
+        if do_tpu:
+            _tpu_retry(warm_tpu, tim_path, budget, seeds[0], tune,
+                       problem.n_events)
         for seed in seeds:
-            cpu = (run_cpu_baseline(tim_path, budget, seed)
+            cpu = (run_cpu_baseline(tim_path, budget, seed,
+                                    factor=cpu_factor,
+                                    islands=cpu_islands,
+                                    clock=cpu_clock)
                    if do_cpu else None)
-            tpu = _tpu_retry(run_tpu, tim_path, budget, seed, tune,
-                             problem.n_events)
+            tpu = (_tpu_retry(run_tpu, tim_path, budget, seed, tune,
+                              problem.n_events) if do_tpu else None)
             row = {"instance": name, "budget_s": budget, "seed": seed,
+                   "cpu_budget_factor": cpu_factor,
                    "cpu": cpu, "tpu": tpu}
-            if cpu is not None:
+            if cpu is not None and tpu is not None:
                 row["tpu_wins"] = tpu["best"] <= cpu["best"]
             rows.append(row)
             print(json.dumps(row), flush=True)
         os.unlink(tim_path)
 
-    if do_cpu:
+    if do_cpu and do_tpu:
         print("\n| instance | seed | budget | CPU ref best | TPU best | "
               "CPU t-to-feas | TPU t-to-feas | winner |", file=sys.stderr)
         print("|---|---|---|---|---|---|---|---|", file=sys.stderr)
