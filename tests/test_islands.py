@@ -177,3 +177,113 @@ def test_migration_skipped_for_tiny_population(island_setup, mesh):
                               np.asarray(state.slots))
         assert np.array_equal(np.asarray(out.penalty),
                               np.asarray(state.penalty))
+
+
+def test_kick_runner_reseeds_worst_half(island_setup, mesh):
+    """Stall kick (VERDICT round-4 next #5): the worst half of every
+    island becomes mutated copies of its best; the elite half (and in
+    particular the island best) is preserved, and the state comes back
+    evaluated + sorted."""
+    problem, pa, state = island_setup
+    cfg = ga.GAConfig(pop_size=POP)
+    kick = islands.make_kick_runner(mesh, cfg, n_moves=3)
+    out = kick(pa, jax.random.key(11), state)
+    E = problem.n_events
+    in_slots = np.asarray(state.slots).reshape(N_ISLANDS, POP, E)
+    in_pen = np.asarray(state.penalty).reshape(N_ISLANDS, POP)
+    out_pen = np.asarray(out.penalty).reshape(N_ISLANDS, POP)
+    out_scv = np.asarray(out.scv).reshape(N_ISLANDS, POP)
+    out_slots = np.asarray(out.slots).reshape(N_ISLANDS, POP, E)
+    for i in range(N_ISLANDS):
+        # the island best never regresses (elite half untouched)
+        assert out_pen[i, 0] <= in_pen[i, 0]
+        # sorted by (penalty, scv)
+        keys = list(zip(out_pen[i].tolist(), out_scv[i].tolist()))
+        assert keys == sorted(keys)
+        # elite rows survive: every pre-kick elite row is still present
+        out_set = {r.tobytes() for r in out_slots[i]}
+        for j in range(POP // 2):
+            assert in_slots[i, j].tobytes() in out_set
+
+
+def test_kick_runner_tiny_population_noop(mesh):
+    """P < 2 has no 'worst half'; the kick must be an identity."""
+    problem = random_instance(33, n_events=12, n_rooms=4, n_features=2,
+                              n_students=8, attend_prob=0.15)
+    pa = problem.device_arrays()
+    state = islands.init_island_population(pa, jax.random.key(2), mesh, 1)
+    cfg = ga.GAConfig(pop_size=1)
+    kick = islands.make_kick_runner(mesh, cfg)
+    out = kick(pa, jax.random.key(3), state)
+    assert np.array_equal(np.asarray(out.slots), np.asarray(state.slots))
+
+
+def test_local_islands_init_and_migration(mesh):
+    """Local islands (n_islands > device count — the multiple-MPI-ranks-
+    per-node analogue): 16 islands on the 8-device mesh (L=2). Init gives
+    16 independent sorted populations; one migration preserves the exact
+    bidirectional ring provenance over ALL 16 islands, crossing shard
+    boundaries via ppermute and local-island boundaries via rolls."""
+    import functools
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    NI = 2 * N_ISLANDS
+    problem = random_instance(31, n_events=20, n_rooms=5, n_features=2,
+                              n_students=12, attend_prob=0.1)
+    pa = problem.device_arrays()
+    state = islands.init_island_population(
+        pa, jax.random.key(0), mesh, POP, n_islands=NI)
+    assert state.slots.shape == (NI * POP, problem.n_events)
+    blocks = np.asarray(state.slots).reshape(NI, POP, -1)
+    for i in range(NI - 1):
+        assert not np.array_equal(blocks[i], blocks[i + 1])
+    pen = np.asarray(state.penalty).reshape(NI, POP)
+    assert (np.diff(pen, axis=1) >= 0).all()   # per-island sorted
+
+    pen = pen.copy()
+    for i in range(NI):
+        pen[i, 0] = 1000 + i
+        pen[i, 1] = 2000 + i
+        pen[i, 2:] = 3_000_000 + np.arange(POP - 2)
+    state = state._replace(penalty=jnp.asarray(pen.reshape(-1)))
+
+    spec = ga.PopState(slots=P(islands.AXIS), rooms=P(islands.AXIS),
+                       penalty=P(islands.AXIS), hcv=P(islands.AXIS),
+                       scv=P(islands.AXIS))
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
+                       out_specs=spec)
+    def do_migrate(st):
+        return islands._migrate(st, NI, L=2)
+
+    out = do_migrate(state)
+    pen_out = np.asarray(out.penalty).reshape(NI, POP)
+    for i in range(NI):
+        got = set(pen_out[i].tolist())
+        assert 1000 + i in got and 2000 + i in got
+        assert 1000 + (i - 1) % NI in got     # forward ring
+        assert 2000 + (i + 1) % NI in got     # backward ring
+
+
+def test_local_islands_runner_trace_order(mesh):
+    """The island-major trace layout holds for L>1: runner trace rows
+    [d*L, (d+1)*L) belong to device d's local islands, and each equals
+    that island's best (hcv, scv) after the last generation (modulo the
+    final migration, which can only improve a best row)."""
+    NI = 2 * N_ISLANDS
+    problem = random_instance(37, n_events=16, n_rooms=4, n_features=2,
+                              n_students=10, attend_prob=0.15)
+    pa = problem.device_arrays()
+    state = islands.init_island_population(
+        pa, jax.random.key(1), mesh, POP, n_islands=NI)
+    cfg = ga.GAConfig(pop_size=POP)
+    runner = islands.make_island_runner(mesh, cfg, n_epochs=2,
+                                        gens_per_epoch=3, n_islands=NI)
+    out, trace, global_best = runner(pa, jax.random.key(2), state)
+    trace = np.asarray(trace)
+    assert trace.shape == (NI, 2, 3, 2)
+    hcv = np.asarray(out.hcv).reshape(NI, POP)
+    pen = np.asarray(out.penalty).reshape(NI, POP)
+    assert (hcv[:, 0] <= trace[:, -1, -1, 0]).all()
+    assert int(global_best) == int(pen[:, 0].min())

@@ -109,6 +109,7 @@ def test_tim_round_trip(tim_file):
     assert dump_tim(load_tim(text2)) == text2
 
 
+@pytest.mark.slow
 def test_engine_end_to_end(tim_file):
     buf = io.StringIO()
     cfg = RunConfig(input=tim_file, seed=3, pop_size=8, islands=2,
@@ -168,6 +169,7 @@ def test_checkpoint_roundtrip(tmp_path, small_problem):
         ckpt.load(path, fp4)
 
 
+@pytest.mark.slow
 def test_engine_resume_seed_conflict(tim_file, tmp_path):
     """Resuming with an EXPLICIT conflicting -s is refused; resuming
     without -s adopts the checkpoint's seed (default time() seeds must
@@ -296,6 +298,7 @@ def test_engine_dynamic_tail_serves_clamped_final_dispatch(tim_file):
     assert gens == [50, 50, 23], gens
 
 
+@pytest.mark.slow
 def test_engine_time_budget_holds(tim_file):
     """With programs compiled and the sec/gen estimate seeded outside
     the budget (the race protocol, tools/quality_race.py warm_tpu), the
@@ -317,6 +320,7 @@ def test_engine_time_budget_holds(tim_file):
         f"budget 6s (+{fetch:.2f}s fetch reserve), ran {wall:.1f}s"
 
 
+@pytest.mark.slow
 def test_budget_tail_polish(tim_file):
     """When the generation loop stops because not even one more
     generation is predicted to fit, the stranded budget slice must run
@@ -356,6 +360,7 @@ def test_budget_tail_polish(tim_file):
     assert any("runEntry" in x for x in lines)
 
 
+@pytest.mark.slow
 def test_time_to_feasible_guard(tim_file):
     """Regression guard (VERDICT round-2 item 9): the engine must reach
     feasibility on an easy instance and report it through logEntry
@@ -377,15 +382,18 @@ def test_time_to_feasible_guard(tim_file):
 def test_distributed_flag_validation():
     with pytest.raises(SystemExit):
         parse_args(["-i", "x.tim", "--coordinator", "h:1"])  # no n/id
-    with pytest.raises(SystemExit):
-        parse_args(["-i", "x.tim", "--distributed",
-                    "--checkpoint", "c.npz"])  # unsupported combo
+    # multi-host + checkpoint is SUPPORTED since round 5 (process 0
+    # saves the allgathered global population; resume re-shards)
+    cfg = parse_args(["-i", "x.tim", "--distributed",
+                      "--checkpoint", "c.npz"])
+    assert cfg.distributed and cfg.checkpoint == "c.npz"
     cfg = parse_args(["-i", "x.tim", "--coordinator", "h:1",
                       "--num-processes", "2", "--process-id", "1"])
     assert cfg.coordinator == "h:1"
     assert cfg.num_processes == 2 and cfg.process_id == 1
 
 
+@pytest.mark.slow
 def test_distributed_single_process_smoke(tim_file):
     """The multi-host entry point (VERDICT round-2 item 6, the
     reference's MPI_Init role, ga.cpp:373-380) wires end-to-end with
@@ -527,6 +535,7 @@ def test_build_post_config_mapping():
     assert p4 is not None and p4.ls_sideways == 0.0
 
 
+@pytest.mark.slow
 def test_distributed_two_process_run(tim_file, tmp_path):
     """A REAL 2-process jax.distributed run (VERDICT round-3 next #5 —
     the reference's mpirun actually exercised >1 rank, ga.cpp:373-380):
@@ -579,3 +588,61 @@ def test_distributed_two_process_run(tim_file, tmp_path):
     sol_bests = [x["solution"]["totalBest"] for x in lines
                  if "solution" in x]
     assert min(sol_bests) == final["totalBest"]
+
+
+@pytest.mark.slow
+def test_distributed_checkpoint_resume(tim_file, tmp_path):
+    """Multi-host checkpoint/resume (VERDICT round-4 next #7): a
+    2-process 8-island run checkpoints (process 0 writes the allgathered
+    GLOBAL population), is torn down, and a second 2-process run resumes
+    from the file and re-shards — the npz serves all ranks the way the
+    reference's wire format did (ga.cpp:264-368)."""
+    import socket
+    import subprocess
+    import sys as _sys
+    from timetabling_ga_tpu.runtime import checkpoint as ck_mod
+    ckfile = str(tmp_path / "dist.ck.npz")
+    outfile = str(tmp_path / "dist_resume.jsonl")
+
+    def run_pair(gens, resume):
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+
+        def proc(pid):
+            env = dict(
+                os.environ, JAX_PLATFORMS="cpu",
+                XLA_FLAGS="--xla_force_host_platform_device_count=4")
+            args = [_sys.executable, "-m", "timetabling_ga_tpu.cli",
+                    "-i", tim_file, "-s", "9", "--backend", "cpu",
+                    "--coordinator", f"localhost:{port}",
+                    "--num-processes", "2", "--process-id", str(pid),
+                    "--pop-size", "4", "--generations", str(gens),
+                    "--migration-period", "5", "--no-auto-tune",
+                    "--ls-mode", "sweep", "--ls-sweeps", "1",
+                    "-m", "8", "-t", "600", "--no-precompile",
+                    "--checkpoint", ckfile, "--checkpoint-every", "1"]
+            if resume:
+                args += ["--resume"]
+            if pid == 0:
+                args += ["-o", outfile]
+            return subprocess.Popen(args, env=env,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE, text=True)
+
+        p0, p1 = proc(0), proc(1)
+        out0, err0 = p0.communicate(timeout=600)
+        out1, err1 = p1.communicate(timeout=120)
+        assert p0.returncode == 0, err0[-3000:]
+        assert p1.returncode == 0, err1[-3000:]
+
+    run_pair(gens=10, resume=False)   # writes the gen-10 checkpoint
+    assert os.path.exists(ckfile)
+    with np.load(ckfile, allow_pickle=False) as z:
+        assert int(z["generation"]) == 10
+        assert z["slots"].shape[0] == 8 * 4   # GLOBAL population saved
+    run_pair(gens=20, resume=True)    # second "incarnation" continues
+    with np.load(ckfile, allow_pickle=False) as z:
+        assert int(z["generation"]) == 20
+    lines = [json.loads(x) for x in open(outfile)]
+    assert [x for x in lines if "runEntry" in x]

@@ -24,8 +24,11 @@ def main(argv=None) -> int:
     # try clock starts, so -t bounds solve time, not compile time — a
     # cold CLI run otherwise spends several times its budget compiling
     # inside it. Also seeds the sec/gen estimates the budget-aware
-    # dispatch sizing needs on its very first dispatch.
-    precompile(cfg)
+    # dispatch sizing needs on its very first dispatch. --no-precompile
+    # skips the probe dispatches (ADVICE round 4) at the cost of
+    # compiling inside -t.
+    if cfg.precompile:
+        precompile(cfg)
     run(cfg)
     return 0
 

@@ -31,6 +31,7 @@ byte-compatible.
 from __future__ import annotations
 
 import dataclasses
+import os
 import sys
 import time
 
@@ -86,39 +87,43 @@ def _shape_sig(problem):
 
 
 def cached_runner(mesh, gacfg: ga.GAConfig, n_epochs: int, gens: int,
-                  sig):
+                  sig, n_islands: int):
     """Returns (runner, was_cached). was_cached=False means this
     (program, instance shape) pair is fresh, so its first call will pay
     an XLA compile."""
-    k = (_mesh_key(mesh), gacfg, n_epochs, gens, sig)
+    k = (_mesh_key(mesh), gacfg, n_epochs, gens, sig, n_islands)
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
     r = islands.make_island_runner(mesh, gacfg, n_epochs=n_epochs,
-                                   gens_per_epoch=gens)
+                                   gens_per_epoch=gens,
+                                   n_islands=n_islands)
     _RUNNER_CACHE[k] = r
     return r, False
 
 
-def cached_dynamic_runner(mesh, gacfg: ga.GAConfig, max_gens: int, sig):
+def cached_dynamic_runner(mesh, gacfg: ga.GAConfig, max_gens: int, sig,
+                          n_islands: int):
     """Tail-dispatch runner with a RUNTIME generation count (one compile
     serves every n_gens <= max_gens), used to spend the last slice of a
     wall-clock budget instead of idling through it."""
-    k = ("dyn", _mesh_key(mesh), gacfg, max_gens, sig)
+    k = ("dyn", _mesh_key(mesh), gacfg, max_gens, sig, n_islands)
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
-    r = islands.make_island_runner_dynamic(mesh, gacfg, max_gens)
+    r = islands.make_island_runner_dynamic(mesh, gacfg, max_gens,
+                                           n_islands=n_islands)
     _RUNNER_CACHE[k] = r
     return r, False
 
 
-def cached_init(mesh, pop_size: int, gacfg: ga.GAConfig):
-    k = (_mesh_key(mesh), pop_size, gacfg)
+def cached_init(mesh, pop_size: int, gacfg: ga.GAConfig,
+                n_islands: int):
+    k = (_mesh_key(mesh), pop_size, gacfg, n_islands)
     f = _INIT_CACHE.get(k)
     if f is None:
         f = jax.jit(lambda pa, key: islands.init_island_population(
-            pa, key, mesh, pop_size, gacfg))
+            pa, key, mesh, pop_size, gacfg, n_islands=n_islands))
         _INIT_CACHE[k] = f
     return f
 
@@ -132,8 +137,12 @@ def cached_init(mesh, pop_size: int, gacfg: ga.GAConfig):
 # component passed in isolation; the step-by-step precompile died
 # exactly at post/n_ep=4). Dispatches are therefore sized so
 # sec_per_gen * gens <= this cap — long enough to amortize the ~70 ms
-# dispatch + trace-fetch overhead, far under the watchdog.
-DISPATCH_CAP_S = 30.0
+# dispatch + trace-fetch overhead, far under the watchdog. The 30 s
+# default is this tunneled device's limit, not a law of nature: on
+# hardware without a long-kernel watchdog, raise (or effectively
+# disable) it via TT_DISPATCH_CAP_S to fuse bigger dispatches
+# (ADVICE round 4).
+DISPATCH_CAP_S = float(os.environ.get("TT_DISPATCH_CAP_S", "30.0"))
 
 # Measured seconds-per-generation, persisted across engine.run calls with
 # the same (mesh, config, problem shape) so a warm-up run's measurement
@@ -189,14 +198,30 @@ def _sync_vals(*vals):
     return tuple(int(v) for v in vals)
 
 
-def cached_polish_runner(mesh, gacfg: ga.GAConfig, sig):
-    """Init-polish runner with a RUNTIME sweep count (one compile serves
-    every chunk size); see islands.make_polish_runner."""
-    k = ("polish", _mesh_key(mesh), gacfg, sig)
+def cached_kick_runner(mesh, gacfg: ga.GAConfig, sig, n_islands: int):
+    """Stall-kick program (islands.make_kick_runner): reseed the worst
+    half of each island from mutated copies of its best. The traced
+    program depends only on (pop_size, p1/p2/p3) of `gacfg`, so the
+    repair config's build serves the post phase too."""
+    k = ("kick", _mesh_key(mesh), gacfg.pop_size, gacfg.p1, gacfg.p2,
+         gacfg.p3, sig, n_islands)
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
-    r = islands.make_polish_runner(mesh, gacfg)
+    r = islands.make_kick_runner(mesh, gacfg, n_islands=n_islands)
+    _RUNNER_CACHE[k] = r
+    return r, False
+
+
+def cached_polish_runner(mesh, gacfg: ga.GAConfig, sig,
+                         n_islands: int):
+    """Init-polish runner with a RUNTIME sweep count (one compile serves
+    every chunk size); see islands.make_polish_runner."""
+    k = ("polish", _mesh_key(mesh), gacfg, sig, n_islands)
+    r = _RUNNER_CACHE.get(k)
+    if r is not None:
+        return r, True
+    r = islands.make_polish_runner(mesh, gacfg, n_islands=n_islands)
     _RUNNER_CACHE[k] = r
     return r, False
 
@@ -280,6 +305,20 @@ def maybe_init_distributed(cfg: RunConfig) -> None:
     _DISTRIBUTED_DONE = True
 
 
+def _reshard_state(state: ga.PopState, mesh) -> ga.PopState:
+    """Place a host (numpy) PopState onto the mesh as GLOBAL
+    island-sharded arrays. Multi-host safe: every process holds the full
+    host copy (the checkpoint stores the global population), and
+    `make_array_from_callback` slices out each process's local shards —
+    the resume-side counterpart of the checkpoint allgather."""
+    from jax.sharding import NamedSharding
+    sh = NamedSharding(mesh, jax.sharding.PartitionSpec(islands.AXIS))
+    return jax.tree.map(
+        lambda x: jax.make_array_from_callback(
+            np.asarray(x).shape, sh, lambda idx, x=x: np.asarray(x)[idx]),
+        state)
+
+
 def _fetch(x) -> np.ndarray:
     """Device->host fetch that also works for multi-host global arrays:
     single-process it is a plain np.asarray; multi-process the shards
@@ -326,12 +365,22 @@ def _setup(cfg: RunConfig):
     pa = problem.device_arrays()
     devices = jax.devices()
     n_islands = cfg.islands if cfg.islands is not None else len(devices)
-    if n_islands > len(devices):
-        print(f"warning: {n_islands} islands requested but only "
-              f"{len(devices)} devices; using {len(devices)}",
-              file=sys.stderr)
-        n_islands = len(devices)
-    mesh = islands.make_mesh(n_islands)
+    if n_islands <= len(devices):
+        mesh = islands.make_mesh(n_islands)
+    else:
+        # more islands than devices: each device carries
+        # n_islands/n_devices vmapped LOCAL islands (islands.
+        # local_islands) — the analogue of mpirun oversubscribing ranks
+        # onto nodes, which is how the reference's island count scales
+        # past the node count (ga.cpp:379). Rounded down to a multiple
+        # of the device count so shards stay uniform.
+        n_dev = len(devices)
+        if n_islands % n_dev:
+            down = (n_islands // n_dev) * n_dev
+            print(f"warning: {n_islands} islands is not a multiple of "
+                  f"{n_dev} devices; using {down}", file=sys.stderr)
+            n_islands = down
+        mesh = islands.make_mesh(n_dev)
     gacfg = build_ga_config(cfg)
     gacfg_post = build_post_config(cfg, gacfg)
     fingerprint = ckpt.config_fingerprint(problem, gacfg, n_islands)
@@ -362,7 +411,8 @@ def precompile(cfg: RunConfig) -> None:
 
     key = jax.random.key(0)
     gacfg_init = dataclasses.replace(gacfg, init_sweeps=0)
-    state = cached_init(mesh, cfg.pop_size, gacfg_init)(pa, key)
+    state = cached_init(mesh, cfg.pop_size, gacfg_init,
+                        n_islands)(pa, key)
     jax.block_until_ready(state)
     # measure the endTry fetch cost (the packed single-round-trip
     # readback) so timed runs can reserve it out of the dispatch
@@ -376,7 +426,8 @@ def precompile(cfg: RunConfig) -> None:
         t0 = time.monotonic()
         _fetch_final(state, n_islands, cfg.pop_size)
         dts.append(time.monotonic() - t0)
-    _FETCH_CACHE[(_mesh_key(mesh), sig, cfg.pop_size)] = min(dts)
+    _FETCH_CACHE[(_mesh_key(mesh), sig, cfg.pop_size,
+                  n_islands)] = min(dts)
     # polish runners for BOTH phase configs: the init polish uses the
     # repair config's, the budget-tail polish (see _run_tries) uses the
     # ACTIVE phase's — and neither may compile inside a timed budget
@@ -384,7 +435,7 @@ def precompile(cfg: RunConfig) -> None:
         if gacfg.init_sweeps <= 0 and g.ls_mode != "sweep":
             continue
         g_spg_key = (_mesh_key(mesh), g, fingerprint)
-        polish, pwarm = cached_polish_runner(mesh, g, sig)
+        polish, pwarm = cached_polish_runner(mesh, g, sig, n_islands)
         jax.block_until_ready(polish(pa, key, state, 1))
         if not pwarm or g_spg_key not in _SPS_CACHE:
             t0 = time.monotonic()
@@ -394,6 +445,11 @@ def precompile(cfg: RunConfig) -> None:
             prev = _SPS_CACHE.get(g_spg_key)
             _SPS_CACHE[g_spg_key] = (sps if prev is None
                                      else 0.7 * sps + 0.3 * prev)
+    # stall-kick program (worst-half reseed; dispatched by timed runs
+    # when the post phase plateaus — must not compile mid-budget)
+    if cfg.kick_stall > 0 and gacfg_post is not None and cfg.pop_size >= 2:
+        kicker, _ = cached_kick_runner(mesh, gacfg, sig, n_islands)
+        jax.block_until_ready(kicker(pa, key, state))
     # static dispatches always run gens = migration_period (shorter
     # remainders go through the dynamic runner), at pow2 n_ep; compile
     # exactly those — for BOTH phase configs when a post-feasibility
@@ -410,7 +466,7 @@ def precompile(cfg: RunConfig) -> None:
         # at migration_period 10 — dies inside even the n_ep=1 static
         # shape; executing that shape to measure it is the bug)
         dyn, _ = cached_dynamic_runner(mesh, g, cfg.migration_period,
-                                       sig)
+                                       sig, n_islands)
         jax.block_until_ready(dyn(pa, key, state, 1))
         spg_est = _SPG_CACHE.get(g_spg_key)
         if spg_est is None:
@@ -428,7 +484,8 @@ def precompile(cfg: RunConfig) -> None:
                 # a fused dispatch this large would risk the device's
                 # long-kernel watchdog — don't even build the shape
                 break
-            runner, warm = cached_runner(mesh, g, n_ep, gens, sig)
+            runner, warm = cached_runner(mesh, g, n_ep, gens, sig,
+                                         n_islands)
             st2, _, _ = runner(pa, key, state)
             jax.block_until_ready(st2)
             if not warm:
@@ -553,6 +610,12 @@ def _polish_chunks(out, cfg, pa, polish, state, base_key, t_try, reserve,
             chunk = 0 if fit < 1 else min(chunk, fit)
         elif remaining_t <= 0:
             chunk = 0
+        else:
+            # no sec/sweep estimate yet: cap the unpredicted chunk at 1
+            # pass (mirroring precompile's single-pass probe) so a deep
+            # converge chunk at comp scale cannot overshoot -t before
+            # the first measurement exists (ADVICE round 4)
+            chunk = min(chunk, 1)
         chunk, = _sync_vals(chunk)
         if chunk < 1:
             break
@@ -610,7 +673,8 @@ def _run_tries(cfg: RunConfig, out) -> int:
     # reserve (first-fetch tunnel setup, transient stall) must degrade
     # to a bounded overshoot risk, not to the run doing NOTHING with
     # its budget
-    reserve = _FETCH_CACHE.get((_mesh_key(mesh), sig, cfg.pop_size), 1.0)
+    reserve = _FETCH_CACHE.get(
+        (_mesh_key(mesh), sig, cfg.pop_size, n_islands), 1.0)
     reserve = min(reserve, 0.25 * cfg.time_limit)
     _phase(out, cfg.trace, "load", 0, time.monotonic() - t0)
 
@@ -629,6 +693,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
             try:
                 state, key, gens_done, best_seen, saved_seed = ckpt.load(
                     cfg.checkpoint, fingerprint)
+                state = _reshard_state(state, mesh)
                 if saved_seed is not None:
                     if cfg.seed is not None and cfg.seed != saved_seed:
                         raise ValueError(
@@ -638,9 +703,22 @@ def _run_tries(cfg: RunConfig, out) -> int:
                     seed = saved_seed   # default seed adopts the saved one
             except FileNotFoundError:
                 state = None
+            # multi-host: every process must take the SAME resume path
+            # (the loaded and fresh-init paths dispatch different
+            # mesh-wide programs). A checkpoint visible to only some
+            # processes (non-shared filesystem) must fail fast, not
+            # deadlock at the first mismatched collective launch.
+            loaded = int(state is not None)
+            agreed, = _sync_vals(loaded)
+            if agreed != loaded:
+                raise RuntimeError(
+                    "--resume: the checkpoint file is visible on some "
+                    "processes but not others — multi-host resume needs "
+                    "the checkpoint on a filesystem all hosts share")
         if state is None:
             t = time.monotonic()
-            state = cached_init(mesh, cfg.pop_size, gacfg_init)(pa, k_init)
+            state = cached_init(mesh, cfg.pop_size, gacfg_init,
+                                n_islands)(pa, k_init)
             jax.block_until_ready(state)
             _phase(out, cfg.trace, "init", trial, time.monotonic() - t)
             # Initial-population LS polish (ga.cpp:429-434), CHUNKED so
@@ -655,7 +733,8 @@ def _run_tries(cfg: RunConfig, out) -> int:
             if best_seen is None:
                 best_seen = [INT_MAX] * n_islands
             if gacfg.init_sweeps > 0:
-                polish, pwarm = cached_polish_runner(mesh, gacfg, sig)
+                polish, pwarm = cached_polish_runner(mesh, gacfg, sig,
+                                                     n_islands)
                 state, _ = _polish_chunks(
                     out, cfg, pa, polish, state, k_init, t_try, reserve,
                     _SPS_CACHE.get(spg_key), n_islands, best_seen,
@@ -676,13 +755,25 @@ def _run_tries(cfg: RunConfig, out) -> int:
             # feasibility already reached during the init polish
             cur = gacfg_post
             cur_key = (_mesh_key(mesh), cur, fingerprint)
-            _phase(out, cfg.trace, "phase-switch", trial, 0.0, gens=0)
+            _phase(out, cfg.trace, "phase-switch", trial, 0.0, at_gen=0)
         sec_per_gen = _spg_for(cur_key, cur, gacfg, spg_key)
         time_stopped = False
+        kick_stall = 0
+        kick_best = min(best_seen)
+        profiled = False
         while gens_done < cfg.generations:
             remaining_t = (cfg.time_limit - reserve
                            - (time.monotonic() - t_try))
             stop = remaining_t <= 0
+            if (sec_per_gen is not None
+                    and sec_per_gen > DISPATCH_CAP_S):
+                # even ONE generation predicts past the device watchdog
+                # (deep post configs at comp scale can get there):
+                # dispatching it risks a mid-try device kill the engine
+                # cannot retry. Stop the generation loop and spend the
+                # budget in the finer-grained sweep tail polish below
+                # (ADVICE round 4).
+                stop = True
             remaining = cfg.generations - gens_done
             dyn_gens = None
             gens = cfg.migration_period
@@ -766,19 +857,33 @@ def _run_tries(cfg: RunConfig, out) -> int:
             key, k_epoch = jax.random.split(key)
             if dyn_gens is not None:
                 runner, warm = cached_dynamic_runner(
-                    mesh, cur, cfg.migration_period, sig)
-                td0 = time.monotonic()
-                state, trace, _gbest = runner(pa, k_epoch, state, dyn_gens)
-                trace = _fetch(trace)[:, :, :dyn_gens]
+                    mesh, cur, cfg.migration_period, sig, n_islands)
+                args = (pa, k_epoch, state, dyn_gens)
                 gens_run = dyn_gens
             else:
                 runner, warm = cached_runner(mesh, cur, n_ep, gens,
-                                             sig)
-                td0 = time.monotonic()
-                state, trace, _gbest = runner(pa, k_epoch, state)
-                trace = _fetch(trace)          # blocks on the dispatch
+                                             sig, n_islands)
+                args = (pa, k_epoch, state)
                 gens_run = n_ep * gens
+            # --trace-profile: capture ONE warm dispatch per try with
+            # jax.profiler (device kernel timeline; SURVEY section 5's
+            # tracing gap). Warm only — profiling a compiling dispatch
+            # would record XLA compilation, not the program
+            do_prof = (cfg.trace_profile is not None and not profiled
+                       and warm)
+            if do_prof:
+                jax.profiler.start_trace(cfg.trace_profile)
+            td0 = time.monotonic()
+            state, trace, _gbest = runner(*args)
+            trace = _fetch(trace)              # blocks on the dispatch
+            if dyn_gens is not None:
+                trace = trace[:, :, :dyn_gens]
             td1 = time.monotonic()
+            if do_prof:
+                jax.profiler.stop_trace()
+                profiled = True
+                _phase(out, True, "profile", trial, td1 - td0,
+                       dir=cfg.trace_profile)
             _phase(out, cfg.trace, "dispatch", trial, td1 - td0,
                    epochs=n_ep, gens=gens_run)
             gens_done += gens_run
@@ -823,13 +928,65 @@ def _run_tries(cfg: RunConfig, out) -> int:
                 cur_key = (_mesh_key(mesh), cur, fingerprint)
                 sec_per_gen = _spg_for(cur_key, cur, gacfg, spg_key)
                 _phase(out, cfg.trace, "phase-switch", trial, 0.0,
-                       gens=gens_done)
+                       at_gen=gens_done)
+
+            # stall kick (VERDICT round-4 next #5): in the post phase —
+            # the scv-polish endgame where small seed 43 sat pinned on a
+            # plateau for its whole budget — count consecutive dispatches
+            # with no new global best; at cfg.kick_stall of them, reseed
+            # the worst half of every island from mutated copies of its
+            # best (islands.make_kick_runner; the single-island analogue
+            # of migration's diversity injection, ga.cpp:522-535).
+            if (cur is gacfg_post and cfg.kick_stall > 0
+                    and cfg.pop_size >= 2):
+                nb = min(best_seen)
+                kick_stall = 0 if nb < kick_best else kick_stall + 1
+                kick_best = nb
+                # the budget check keeps -t honest: a kick straight
+                # after the final dispatch would otherwise run past the
+                # limit. It reads the PROCESS-LOCAL clock, so the
+                # mesh-wide launch decision goes through _sync_vals like
+                # every other dispatch decision (best_seen alone is
+                # process-identical; the clock is not).
+                kick_fits = (cfg.time_limit - reserve
+                             - (time.monotonic() - t_try)) > 0
+                do_kick, = _sync_vals(
+                    kick_stall >= cfg.kick_stall and kick_fits)
+                if do_kick:
+                    # precompile builds this program (same enabling
+                    # condition); under --no-precompile the first kick
+                    # pays its XLA compile inside -t like every other
+                    # program in that mode
+                    kicker, _kwarm = cached_kick_runner(mesh, gacfg,
+                                                        sig, n_islands)
+                    key, k_kick = jax.random.split(key)
+                    t = time.monotonic()
+                    state = kicker(pa, k_kick, state)
+                    jax.block_until_ready(state)
+                    # context key is at_gen, NOT gens: `gens` on a
+                    # phase record means generations EXECUTED by
+                    # that phase (budget accounting sums it)
+                    _phase(out, cfg.trace, "kick", trial,
+                           time.monotonic() - t, at_gen=gens_done)
+                    kick_stall = 0
 
             if (cfg.checkpoint
                     and epochs_done - epochs_at_ckpt >= cfg.checkpoint_every):
                 t = time.monotonic()
-                ckpt.save(cfg.checkpoint, state, key, gens_done,
-                          fingerprint, best_seen, seed)
+                # multi-host: every process allgathers the global
+                # population (a collective — all must participate), then
+                # process 0 alone writes the npz; the file holds the
+                # GLOBAL state, so a resume can re-shard it onto any
+                # process layout with the same total island count (the
+                # reference's wire format likewise serves all ranks,
+                # ga.cpp:264-368)
+                ckpt_state = state
+                if jax.process_count() > 1:
+                    ckpt_state = ga.PopState(
+                        *[_fetch(x) for x in state])
+                if jax.process_count() <= 1 or jax.process_index() == 0:
+                    ckpt.save(cfg.checkpoint, ckpt_state, key, gens_done,
+                              fingerprint, best_seen, seed)
                 epochs_at_ckpt = epochs_done
                 _phase(out, cfg.trace, "checkpoint", trial,
                        time.monotonic() - t)
@@ -849,7 +1006,8 @@ def _run_tries(cfg: RunConfig, out) -> int:
                          if cur.ls_mode == "sweep" and time_stopped
                          else None)
         if sec_per_sweep is not None and sec_per_sweep > 0:
-            polish, pwarm = cached_polish_runner(mesh, cur, sig)
+            polish, pwarm = cached_polish_runner(mesh, cur, sig,
+                                                 n_islands)
             if pwarm:   # never compile inside the budget
                 key, k_tail = jax.random.split(key)
                 # no sps_cache_key: tail timings of converged

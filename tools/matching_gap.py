@@ -1,0 +1,150 @@
+"""Measure the matcher-attributable hcv gap on the DEVICE path during a
+production run (VERDICT round-4 next #3).
+
+The reference's primary room assigner is an exact per-slot maximum
+matching (Solution::maxMatching, Solution.cpp:836-891); the TPU
+production path uses the greedy scan (ops/rooms.py assign_rooms) and the
+hcv penalty absorbs any imperfection. This tool puts a NUMBER on that
+absorption: it runs the shipped engine configuration on the room-tight
+fixtures, snapshots the final population via the checkpoint path, and
+for every individual compares
+
+  greedy   = assignment_room_hcv(slots, rooms)      # what the run has
+  exact_lb = room_hcv_lower_bound(slots)            # Hopcroft-Karp bound
+  augment  = assignment_room_hcv(slots, augment_rooms(slots, rooms))
+
+`greedy - exact_lb` is the hcv the matcher leaves on the table; if the
+bounded augmenting matcher (already built, ops/rooms.py:augment_rooms)
+closes it, wiring it into the breeding rematch is worth a re-race.
+
+Usage: python tools/matching_gap.py [--budget S] [--instances a,b]
+       [--seeds a,b,c]
+Output: one JSON line per (instance, seed) + a summary table on stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def measure(problem, state_slots, state_rooms) -> dict:
+    """Population-wide matcher slack: greedy-vs-exact and augment-vs-
+    exact, plus the best row's numbers (row 0 is the reported one)."""
+    import numpy as np
+
+    from timetabling_ga_tpu.oracle.matching import (
+        assignment_room_hcv, room_hcv_lower_bound)
+    from timetabling_ga_tpu.ops.rooms import augment_rooms
+
+    import jax
+
+    pa = problem.device_arrays()
+    aug = np.asarray(jax.jit(jax.vmap(
+        lambda s, r: augment_rooms(pa, s, r)))(state_slots, state_rooms))
+
+    rows = []
+    for i in range(state_slots.shape[0]):
+        s = np.asarray(state_slots[i])
+        lb = room_hcv_lower_bound(problem, s)
+        g = assignment_room_hcv(problem, s, np.asarray(state_rooms[i]))
+        a = assignment_room_hcv(problem, s, aug[i])
+        rows.append((g, a, lb))
+    g = np.array([r[0] for r in rows])
+    a = np.array([r[1] for r in rows])
+    lb = np.array([r[2] for r in rows])
+    return {
+        "pop": len(rows),
+        "best_row": {"greedy": int(g[0]), "augment": int(a[0]),
+                     "exact_lb": int(lb[0]),
+                     "slack_greedy": int(g[0] - lb[0]),
+                     "slack_augment": int(a[0] - lb[0])},
+        "mean_slack_greedy": round(float((g - lb).mean()), 3),
+        "max_slack_greedy": int((g - lb).max()),
+        "mean_slack_augment": round(float((a - lb).mean()), 3),
+        "max_slack_augment": int((a - lb).max()),
+        "frac_rows_with_greedy_slack": round(float((g > lb).mean()), 3),
+    }
+
+
+def run_one(name: str, problem, budget: float, seed: int) -> dict:
+    """Production run (tuned defaults, like the race) with a checkpoint;
+    measure on the checkpointed final population."""
+    import numpy as np
+
+    from timetabling_ga_tpu.runtime import checkpoint as ckpt
+    from timetabling_ga_tpu.runtime import engine
+    from timetabling_ga_tpu.problem import dump_tim
+    from timetabling_ga_tpu.runtime.config import RunConfig
+
+    with tempfile.NamedTemporaryFile("w", suffix=".tim",
+                                     delete=False) as fh:
+        fh.write(dump_tim(problem))
+        tim_path = fh.name
+    ck = tempfile.mktemp(suffix=".npz")
+    try:
+        cfg = RunConfig(input=tim_path, seed=seed, islands=1,
+                        generations=10 ** 9, time_limit=budget,
+                        checkpoint=ck, checkpoint_every=1)
+        cfg.apply_tuned_defaults(problem.n_events)
+        engine.precompile(cfg)
+        import io
+        t0 = time.perf_counter()
+        best = engine.run(cfg, out=io.StringIO())
+        wall = time.perf_counter() - t0
+        state, _key, gens, _bs, _seed = ckpt.load(
+            ck, ckpt.config_fingerprint(
+                problem, engine.build_ga_config(cfg), 1))
+        m = measure(problem, np.asarray(state.slots),
+                    np.asarray(state.rooms))
+        return {"instance": name, "seed": seed, "budget_s": budget,
+                "best": int(best), "gens_at_snapshot": gens,
+                "wall_s": round(wall, 1), **m}
+    finally:
+        os.unlink(tim_path)
+        if os.path.exists(ck):
+            os.unlink(ck)
+
+
+def main():
+    argv = sys.argv[1:]
+
+    def opt(name, default, typ=float):
+        if name in argv:
+            return typ(argv[argv.index(name) + 1])
+        return default
+
+    budget = opt("--budget", 30.0)
+    seeds = [int(s) for s in str(opt("--seeds", "42", str)).split(",")]
+    names = str(opt("--instances", "small-tight,comp05s", str)).split(",")
+
+    from tools.quality_race import make_instances
+    from timetabling_ga_tpu.runtime.retry import retry_unavailable
+
+    out_rows = []
+    for name, problem in make_instances(set(names)):
+        for seed in seeds:
+            row = retry_unavailable(run_one, name, problem, budget, seed,
+                                    attempts=3, wait_s=90.0)
+            out_rows.append(row)
+            print(json.dumps(row), flush=True)
+
+    print("\n| instance | seed | best | best-row greedy/aug/exact | "
+          "pop mean slack greedy/aug |", file=sys.stderr)
+    print("|---|---|---|---|---|", file=sys.stderr)
+    for r in out_rows:
+        b = r["best_row"]
+        print(f"| {r['instance']} | {r['seed']} | {r['best']} | "
+              f"{b['greedy']}/{b['augment']}/{b['exact_lb']} | "
+              f"{r['mean_slack_greedy']}/{r['mean_slack_augment']} |",
+              file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -28,8 +28,10 @@ invocations carry the same keys and merge by (instance, seed).
 
 Island legs (VERDICT round-4 next #2): `--cpu-islands N` runs the CPU
 side as N islands with ring migration (tt_cpu --islands); `--tpu-islands
-N` requests N islands on the TPU side (capped at the device count).
-`--nsga2` switches the TPU side to the NSGA-II replacement stage.
+N` requests N islands on the TPU side — N may exceed the device count
+(each device then carries N/devices vmapped local islands; see
+parallel/islands.py local_islands). `--nsga2` switches the TPU side to
+the NSGA-II replacement stage.
 
 Usage:
   python tools/quality_race.py [--budget S] [--quick] [--seeds a,b,c]
