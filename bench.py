@@ -113,12 +113,22 @@ def measure_cpu_native(problem) -> float:
     native.eval_batch(problem, slots[:64], rooms[:64], threads)  # warm
     reps = 3
     t0 = time.perf_counter()
+    c0 = time.process_time()
     for _ in range(reps):
         native.eval_batch(problem, slots, rooms, threads)
-    dt = time.perf_counter() - t0
+    dt_wall = time.perf_counter() - t0
+    dt_cpu = time.process_time() - c0
+    # Contention-immune denominator: under background load the wall
+    # clock overstates the native evaluator's cost (and so inflates
+    # vs_baseline — dishonest in our favor). Process CPU time divided
+    # by the thread count equals wall time on an idle box (OpenMP
+    # threads each burn ~wall seconds) and stays correct under
+    # contention; take the FASTER implied rate = the machine's real
+    # capability.
+    dt = min(dt_wall, dt_cpu / max(threads, 1))
     rate = POP * reps / dt
-    print(f"# cpu native ({threads} threads): {rate:,.0f} evals/s",
-          file=sys.stderr)
+    print(f"# cpu native ({threads} threads): {rate:,.0f} evals/s "
+          f"(wall {dt_wall:.2f}s, cpu {dt_cpu:.2f}s)", file=sys.stderr)
     return rate
 
 
@@ -331,16 +341,23 @@ def measure_generation_nsga(problem) -> dict:
     return out
 
 
-# v5e HBM peak, for the bandwidth-bound check (public spec: 819 GB/s)
-HBM_PEAK_GBPS = 819.0
+# v5e public peaks, for the roofline placement of the fitness kernel
+HBM_PEAK_GBPS = 819.0       # HBM bandwidth
+BF16_PEAK_TFLOPS = 197.0    # MXU bf16
 
 
 def measure_kernel_cost(problem, achieved_evals_per_sec: float) -> dict:
-    """Arithmetic-intensity numbers behind the 'bandwidth-bound' claim
-    (VERDICT round-4 weak #6): XLA's own cost model (compiled
-    cost_analysis) gives flops and HBM bytes accessed for one fitness
-    batch; dividing by the MEASURED evals/s yields the implied HBM
-    bandwidth demand, compared against the chip's peak."""
+    """Arithmetic-intensity numbers behind the round-4 'bandwidth-bound'
+    adjective (VERDICT round-4 weak #6), from XLA's own cost model
+    (compiled cost_analysis) for one fitness batch.
+
+    Interpretation caveat that the numbers themselves expose: XLA's
+    'bytes accessed' is LOGICAL (per-HLO buffer traffic, counted before
+    fusion keeps intermediates in VMEM), so it upper-bounds HBM traffic.
+    When logical bytes x measured evals/s exceeds the HBM peak — as it
+    does here — that is POSITIVE evidence of fusion: the excess
+    fraction provably never left the chip, and the kernel is
+    compute-rich rather than HBM-starved."""
     import jax
     import numpy as np
     from timetabling_ga_tpu.ops import fitness
@@ -358,19 +375,31 @@ def measure_kernel_cost(problem, achieved_evals_per_sec: float) -> dict:
     byts = float(ca.get("bytes accessed", 0.0))
     out = {"pop": POP,
            "flops_per_eval": round(flops / POP, 1),
-           "bytes_per_eval": round(byts / POP, 1),
+           "logical_bytes_per_eval": round(byts / POP, 1),
            "arithmetic_intensity_flops_per_byte":
                round(flops / byts, 3) if byts else None}
     if byts and achieved_evals_per_sec:
-        demand = byts / POP * achieved_evals_per_sec / 1e9
-        out["implied_hbm_gbps_at_measured_rate"] = round(demand, 1)
+        logical_gbps = byts / POP * achieved_evals_per_sec / 1e9
+        tflops = flops / POP * achieved_evals_per_sec / 1e12
+        out["achieved_tflops"] = round(tflops, 1)
+        out["bf16_peak_tflops"] = BF16_PEAK_TFLOPS
+        out["flop_utilization_vs_bf16_peak_pct"] = round(
+            100 * tflops / BF16_PEAK_TFLOPS, 1)
+        out["logical_gbps_at_measured_rate"] = round(logical_gbps, 1)
         out["hbm_peak_gbps"] = HBM_PEAK_GBPS
-        out["hbm_utilization_pct"] = round(100 * demand / HBM_PEAK_GBPS, 1)
+        # logical bytes the HBM could not have served = provably fused
+        out["min_fused_fraction_pct"] = round(
+            max(0.0, 100 * (1 - HBM_PEAK_GBPS / logical_gbps)), 1)
     print(f"# kernel cost (XLA model): {out['flops_per_eval']:,.0f} "
-          f"flop/eval, {out['bytes_per_eval']:,.0f} B/eval, "
-          f"AI={out['arithmetic_intensity_flops_per_byte']}, implied "
-          f"{out.get('implied_hbm_gbps_at_measured_rate', '?')} GB/s of "
-          f"{HBM_PEAK_GBPS} peak", file=sys.stderr)
+          f"flop/eval, {out['logical_bytes_per_eval']:,.0f} logical "
+          f"B/eval, AI={out['arithmetic_intensity_flops_per_byte']}; "
+          f"achieved {out.get('achieved_tflops', '?')} TFLOP/s "
+          f"({out.get('flop_utilization_vs_bf16_peak_pct', '?')}% of "
+          f"bf16 peak), logical "
+          f"{out.get('logical_gbps_at_measured_rate', '?')} GB/s vs "
+          f"{HBM_PEAK_GBPS} HBM peak -> >= "
+          f"{out.get('min_fused_fraction_pct', '?')}% provably fused",
+          file=sys.stderr)
     return out
 
 

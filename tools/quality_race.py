@@ -149,6 +149,7 @@ _TUNE_FIELDS = {"pop": "pop_size", "sweeps": "ls_sweeps",
                 "post_sideways": "post_sideways",
                 "epochs_per_dispatch": "epochs_per_dispatch",
                 "tpu_islands": "islands",
+                "kick_stall": "kick_stall",
                 "nsga2": "nsga2"}
 
 
@@ -238,6 +239,7 @@ def main():
         "post_sideways": opt("--post-sideways", None, float),
         "epochs_per_dispatch": opt("--epochs-per-dispatch", None, int),
         "tpu_islands": opt("--tpu-islands", None, int),
+        "kick_stall": opt("--kick-stall", None, int),
         "nsga2": True if "--nsga2" in argv else None,
     }
     do_cpu = "--no-cpu" not in argv
