@@ -120,12 +120,13 @@ def measure_cpu_native(problem) -> float:
     dt_cpu = time.process_time() - c0
     # Contention-immune denominator: under background load the wall
     # clock overstates the native evaluator's cost (and so inflates
-    # vs_baseline — dishonest in our favor). Process CPU time divided
-    # by the thread count equals wall time on an idle box (OpenMP
-    # threads each burn ~wall seconds) and stays correct under
-    # contention; take the FASTER implied rate = the machine's real
-    # capability.
-    dt = min(dt_wall, dt_cpu / max(threads, 1))
+    # vs_baseline — dishonest in our favor). With ONE thread, process
+    # CPU time is exact and contention-free, so use the smaller of the
+    # two. With several threads, cpu/threads would assume perfect
+    # OpenMP scaling (and trip over cgroup quotas below os.cpu_count),
+    # OVERSTATING the baseline — keep the wall clock there; multi-core
+    # boxes should run the bench idle.
+    dt = min(dt_wall, dt_cpu) if threads == 1 else dt_wall
     rate = POP * reps / dt
     print(f"# cpu native ({threads} threads): {rate:,.0f} evals/s "
           f"(wall {dt_wall:.2f}s, cpu {dt_cpu:.2f}s)", file=sys.stderr)

@@ -186,8 +186,8 @@ def test_kick_runner_reseeds_worst_half(island_setup, mesh):
     evaluated + sorted."""
     problem, pa, state = island_setup
     cfg = ga.GAConfig(pop_size=POP)
-    kick = islands.make_kick_runner(mesh, cfg, n_moves=3)
-    out = kick(pa, jax.random.key(11), state)
+    kick = islands.make_kick_runner(mesh, cfg)
+    out = kick(pa, jax.random.key(11), state, 3)
     E = problem.n_events
     in_slots = np.asarray(state.slots).reshape(N_ISLANDS, POP, E)
     in_pen = np.asarray(state.penalty).reshape(N_ISLANDS, POP)
@@ -214,7 +214,7 @@ def test_kick_runner_tiny_population_noop(mesh):
     state = islands.init_island_population(pa, jax.random.key(2), mesh, 1)
     cfg = ga.GAConfig(pop_size=1)
     kick = islands.make_kick_runner(mesh, cfg)
-    out = kick(pa, jax.random.key(3), state)
+    out = kick(pa, jax.random.key(3), state, 3)
     assert np.array_equal(np.asarray(out.slots), np.asarray(state.slots))
 
 

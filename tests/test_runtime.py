@@ -196,6 +196,7 @@ def test_engine_resume_seed_conflict(tim_file, tmp_path):
         assert int(z["generation"]) == 20
 
 
+@pytest.mark.slow
 def test_engine_exact_generation_budget(tim_file):
     """A budget not divisible by migration_period must be honored exactly
     (clamped final dispatch), not overshot."""
@@ -226,6 +227,7 @@ def test_engine_trace_phases(tim_file):
             assert x["phase"]["seconds"] >= 0
 
 
+@pytest.mark.slow
 def test_engine_multi_epoch_dispatch(tim_file):
     """epochs_per_dispatch > 1 fuses epochs into one device call but
     must produce the identical generation count and protocol shape."""
@@ -278,6 +280,7 @@ def test_engine_resume(tim_file, tmp_path):
         assert bests[-1] <= best_saved[i]
 
 
+@pytest.mark.slow
 def test_engine_dynamic_tail_serves_clamped_final_dispatch(tim_file):
     """The clamped final dispatch (generation budget not a multiple of
     migration_period) must run through the dynamic-gens runner — exact
@@ -461,6 +464,7 @@ def test_explicit_flags_survive_auto_tune():
     assert cfg.pop_size == 16           # untouched field still tuned
 
 
+@pytest.mark.slow
 def test_tpu_path_thread_id_is_zero(tim_file):
     """threadID := 0 on the TPU path, by definition (runtime/jsonl.py
     module docstring): island breeding is one fused vmap with no thread
@@ -481,6 +485,7 @@ def test_tpu_path_thread_id_is_zero(tim_file):
     assert all(s["threadID"] == 0 for s in sols)
 
 
+@pytest.mark.slow
 def test_post_feasibility_phase_switch(tim_file):
     """With post_* flags set, the engine must switch breeding configs at
     the first dispatch boundary after the global best reaches

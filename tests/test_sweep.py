@@ -339,6 +339,7 @@ def test_hot_sweep_reaches_feasibility(inst):
     assert (np.asarray(hcv) == 0).any()
 
 
+@pytest.mark.slow
 def test_move3_sweep_state_consistent(inst):
     """p3 > 0 adds 3-cycle candidates; maintained state must stay exact
     after passes that can accept them (the _delta_one 3-relocation path
@@ -360,6 +361,7 @@ def test_move3_sweep_state_consistent(inst):
     np.testing.assert_array_equal(np.asarray(st.occ), np.asarray(st2.occ))
 
 
+@pytest.mark.slow
 def test_move3_superset_neighborhood_property():
     """Property check on a dense instance: p3=1 adds 3-cycle candidates
     to every step (a strict superset of the p3=0 candidate set, same

@@ -449,7 +449,7 @@ def precompile(cfg: RunConfig) -> None:
     # when the post phase plateaus — must not compile mid-budget)
     if cfg.kick_stall > 0 and gacfg_post is not None and cfg.pop_size >= 2:
         kicker, _ = cached_kick_runner(mesh, gacfg, sig, n_islands)
-        jax.block_until_ready(kicker(pa, key, state))
+        jax.block_until_ready(kicker(pa, key, state, 3))
     # static dispatches always run gens = migration_period (shorter
     # remainders go through the dynamic runner), at pow2 n_ep; compile
     # exactly those — for BOTH phase configs when a post-feasibility
@@ -760,6 +760,11 @@ def _run_tries(cfg: RunConfig, out) -> int:
         time_stopped = False
         kick_stall = 0
         kick_best = min(best_seen)
+        kick_streak = 0     # kicks since the last improvement: each one
+        #                     escalates the perturbation depth (3, 6,
+        #                     12, 16 moves) — re-converging to the same
+        #                     basin means the previous depth was too
+        #                     shallow to escape it
         profiled = False
         while gens_done < cfg.generations:
             remaining_t = (cfg.time_limit - reserve
@@ -940,7 +945,11 @@ def _run_tries(cfg: RunConfig, out) -> int:
             if (cur is gacfg_post and cfg.kick_stall > 0
                     and cfg.pop_size >= 2):
                 nb = min(best_seen)
-                kick_stall = 0 if nb < kick_best else kick_stall + 1
+                if nb < kick_best:
+                    kick_stall = 0
+                    kick_streak = 0
+                else:
+                    kick_stall += 1
                 kick_best = nb
                 # the budget check keeps -t honest: a kick straight
                 # after the final dispatch would otherwise run past the
@@ -959,16 +968,20 @@ def _run_tries(cfg: RunConfig, out) -> int:
                     # program in that mode
                     kicker, _kwarm = cached_kick_runner(mesh, gacfg,
                                                         sig, n_islands)
+                    n_moves = min(3 << kick_streak,
+                                  islands.KICK_MAX_MOVES)
                     key, k_kick = jax.random.split(key)
                     t = time.monotonic()
-                    state = kicker(pa, k_kick, state)
+                    state = kicker(pa, k_kick, state, n_moves)
                     jax.block_until_ready(state)
                     # context key is at_gen, NOT gens: `gens` on a
                     # phase record means generations EXECUTED by
                     # that phase (budget accounting sums it)
                     _phase(out, cfg.trace, "kick", trial,
-                           time.monotonic() - t, at_gen=gens_done)
+                           time.monotonic() - t, at_gen=gens_done,
+                           moves=n_moves)
                     kick_stall = 0
+                    kick_streak += 1
 
             if (cfg.checkpoint
                     and epochs_done - epochs_at_ckpt >= cfg.checkpoint_every):
