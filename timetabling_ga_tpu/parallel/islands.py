@@ -371,6 +371,32 @@ def make_kick_runner(mesh: Mesh, cfg: ga.GAConfig,
     return jax.jit(_kick)
 
 
+def make_shrink_runner(mesh: Mesh, pop_in: int, pop_out: int,
+                       n_islands: int = None):
+    """Truncate every island's population to its elite `pop_out` rows
+    (islands are (penalty, scv)-sorted, so rows [0, pop_out) are the
+    best). Used at the post-feasibility phase switch when the endgame
+    runs a smaller population than the repair phase (post_pop_size):
+    fewer rows per generation buys proportionally more deep-polish
+    generations per second, and the discarded rows are the repair
+    phase's worst — measured on comp01s to beat polishing the full
+    population (BASELINE.md round 5)."""
+    L = local_islands(mesh, n_islands)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(ga.PopState(slots=P(AXIS), rooms=P(AXIS), penalty=P(AXIS),
+                              hcv=P(AXIS), scv=P(AXIS)),),
+        out_specs=ga.PopState(slots=P(AXIS), rooms=P(AXIS), penalty=P(AXIS),
+                              hcv=P(AXIS), scv=P(AXIS)),
+        check_vma=False)
+    def _shrink(state):
+        blk = _blocks(state, L, pop_in)
+        return _flat(jax.tree.map(lambda x: x[:, :pop_out], blk))
+
+    return jax.jit(_shrink)
+
+
 def make_island_runner_dynamic(mesh: Mesh, cfg: ga.GAConfig,
                                max_gens: int, n_islands: int = None):
     """Like `make_island_runner(n_epochs=1)` but the generation count is

@@ -98,6 +98,16 @@ class RunConfig:
     post_swap_block: Optional[int] = None    # Move2 partners per pivot
     post_hot_k: Optional[int] = None         # pivot selection (0 = all)
     post_sideways: Optional[float] = None    # plateau-walk acceptance
+    post_pop_size: Optional[int] = None      # endgame population: at the
+    #                           phase switch each island truncates to its
+    #                           elite top-k rows (islands.
+    #                           make_shrink_runner) — fewer rows per
+    #                           generation buys proportionally more
+    #                           deep-polish generations per second, while
+    #                           the REPAIR phase keeps the full
+    #                           population's robustness (a pop this small
+    #                           from generation 0 strands whole runs
+    #                           infeasible — measured, BASELINE.md r5)
     ls_converge: bool = False  # sweep LS early-exits at the population-
     #                            wide local optimum (reference stopping
     #                            rule); ls_sweeps becomes the hard bound
@@ -200,18 +210,37 @@ class RunConfig:
                  # dispatch per epoch is a host round trip every 2
                  # generations; fusing 4 epochs cut comp01s 68 -> 64
                  # and medium 239 -> 224 (probe part 7)
+                 # post_pop_size 4: the endgame shrinks each island to
+                 # its elite 4 rows at the phase switch — comp01s probe
+                 # (round 5): pop-16 post 72/65/67 vs pop-4-throughout
+                 # 61/49/52 at 60 s, while pop-4 REPAIR is unsafe (a
+                 # pop-8 run stranded a seed infeasible); the shrink
+                 # keeps full-pop repair and small-pop polish
                  dict(pop_size=16, ls_sweeps=2, init_sweeps=200,
                       ls_swap_block=8, migration_period=2,
                       ls_hot_k=48, post_hot_k=0, post_ls_sweeps=16,
-                      post_swap_block=64, epochs_per_dispatch=4))
+                      post_swap_block=64, epochs_per_dispatch=4,
+                      post_pop_size=4))
         # plateau-walking acceptance: measured to take comp05s from
         # never-feasible (hcv stuck at 3 — pure correlation clashes) to
         # feasible in ~24 s; see ops/sweep.py sweep_pass
         tuned.update(ls_mode="sweep", ls_converge=True, ls_sideways=0.25)
+        if self.checkpoint:
+            # the mid-run shape change cannot round-trip a
+            # checkpoint/resume cycle (parse_args refuses the explicit
+            # combination for the same reason)
+            tuned.pop("post_pop_size", None)
         for field, value in tuned.items():
             if (field not in self.explicit_fields
                     and getattr(self, field) == getattr(d, field)):
                 setattr(self, field, value)
+        if (self.post_pop_size is not None
+                and self.post_pop_size >= self.pop_size):
+            # an explicit small --pop-size can undercut the tuned
+            # endgame shrink; a post population >= the repair one is
+            # meaningless (and > would crash the shard reshape), so
+            # drop the shrink rather than error on a tuned default
+            self.post_pop_size = None
         return self
 
     def resolved_max_steps(self) -> int:
@@ -250,6 +279,7 @@ _FLAG_MAP = {
     "--post-swap-block": ("post_swap_block", int),
     "--post-hot-k": ("post_hot_k", int),
     "--post-sideways": ("post_sideways", float),
+    "--post-pop-size": ("post_pop_size", int),
     "--init-sweeps": ("init_sweeps", int),
     "--rooms-mode": ("rooms_mode", str),
     "--checkpoint": ("checkpoint", str),
@@ -312,4 +342,15 @@ def parse_args(argv) -> RunConfig:
         raise SystemExit("--coordinator requires --num-processes and "
                          "--process-id (the reference's mpirun provides "
                          "these; here they are explicit)")
+    if cfg.post_pop_size is not None and cfg.checkpoint:
+        raise SystemExit("--post-pop-size changes the population shape "
+                         "mid-run, which a checkpoint/resume cycle "
+                         "cannot represent; drop one of the two flags")
+    if (cfg.post_pop_size is not None and "pop_size" in seen
+            and cfg.post_pop_size > cfg.pop_size):
+        # only checkable at parse time when the user pinned BOTH sides;
+        # otherwise auto-tune may still change pop_size — engine._setup
+        # re-validates the final pair
+        raise SystemExit("--post-pop-size must not exceed --pop-size "
+                         "(it truncates to the elite rows)")
     return cfg

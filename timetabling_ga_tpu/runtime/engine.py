@@ -201,8 +201,9 @@ def _sync_vals(*vals):
 def cached_kick_runner(mesh, gacfg: ga.GAConfig, sig, n_islands: int):
     """Stall-kick program (islands.make_kick_runner): reseed the worst
     half of each island from mutated copies of its best. The traced
-    program depends only on (pop_size, p1/p2/p3) of `gacfg`, so the
-    repair config's build serves the post phase too."""
+    program depends only on (pop_size, p1/p2/p3) of `gacfg`; the kick
+    fires in the POST phase, so callers build it from the post config —
+    whose pop_size may be the shrunk one (post_pop_size)."""
     k = ("kick", _mesh_key(mesh), gacfg.pop_size, gacfg.p1, gacfg.p2,
          gacfg.p3, sig, n_islands)
     r = _RUNNER_CACHE.get(k)
@@ -211,6 +212,18 @@ def cached_kick_runner(mesh, gacfg: ga.GAConfig, sig, n_islands: int):
     r = islands.make_kick_runner(mesh, gacfg, n_islands=n_islands)
     _RUNNER_CACHE[k] = r
     return r, False
+
+
+def cached_shrink_runner(mesh, pop_in: int, pop_out: int,
+                         n_islands: int):
+    """Elite truncation at the post-feasibility switch (post_pop_size);
+    see islands.make_shrink_runner."""
+    k = ("shrink", _mesh_key(mesh), pop_in, pop_out, n_islands)
+    r = _RUNNER_CACHE.get(k)
+    if r is None:
+        r = islands.make_shrink_runner(mesh, pop_in, pop_out, n_islands)
+        _RUNNER_CACHE[k] = r
+    return r
 
 
 def cached_polish_runner(mesh, gacfg: ga.GAConfig, sig,
@@ -259,10 +272,13 @@ def build_post_config(cfg: RunConfig, gacfg: ga.GAConfig):
     compiled runner it switches to at the first dispatch after the
     global best reaches feasibility (VERDICT round-3 next #3)."""
     if (cfg.post_ls_sweeps is None and cfg.post_swap_block is None
-            and cfg.post_hot_k is None and cfg.post_sideways is None):
+            and cfg.post_hot_k is None and cfg.post_sideways is None
+            and cfg.post_pop_size is None):
         return None
     post = dataclasses.replace(
         gacfg,
+        pop_size=(cfg.post_pop_size if cfg.post_pop_size is not None
+                  else gacfg.pop_size),
         ls_sweeps=(cfg.post_ls_sweeps if cfg.post_ls_sweeps is not None
                    else gacfg.ls_sweeps),
         ls_swap_block=(cfg.post_swap_block
@@ -383,6 +399,20 @@ def _setup(cfg: RunConfig):
         mesh = islands.make_mesh(n_dev)
     gacfg = build_ga_config(cfg)
     gacfg_post = build_post_config(cfg, gacfg)
+    if (cfg.checkpoint and gacfg_post is not None
+            and gacfg_post.pop_size != gacfg.pop_size):
+        # parse_args refuses the flag combination; this guards
+        # programmatic construction the same way (the mid-run shape
+        # change cannot round-trip a checkpoint/resume cycle)
+        raise ValueError("post_pop_size with checkpoint is unsupported")
+    if gacfg_post is not None and gacfg_post.pop_size > gacfg.pop_size:
+        # post-tune validation (parse_args can only check when the user
+        # pinned both flags): a post population larger than the repair
+        # one has no elite rows to grow from, and the shard reshape
+        # would fail with an opaque XLA error instead of this message
+        raise ValueError(
+            f"post_pop_size {gacfg_post.pop_size} exceeds pop_size "
+            f"{gacfg.pop_size}")
     fingerprint = ckpt.config_fingerprint(problem, gacfg, n_islands)
     spg_key = (_mesh_key(mesh), gacfg, fingerprint)
     return (problem, pa, mesh, n_islands, gacfg, gacfg_post, fingerprint,
@@ -428,6 +458,25 @@ def precompile(cfg: RunConfig) -> None:
         dts.append(time.monotonic() - t0)
     _FETCH_CACHE[(_mesh_key(mesh), sig, cfg.pop_size,
                   n_islands)] = min(dts)
+    # phase-config -> warm-up state: the post phase may run a SMALLER
+    # population (post_pop_size elite truncation); its programs must be
+    # warmed with the shrunk shape, and the shrink program itself must
+    # be compiled (it runs at the in-budget phase switch)
+    state_for = {gacfg: state}
+    if gacfg_post is not None:
+        if gacfg_post.pop_size != gacfg.pop_size:
+            shrink = cached_shrink_runner(
+                mesh, gacfg.pop_size, gacfg_post.pop_size, n_islands)
+            st_post = shrink(state)
+            jax.block_until_ready(st_post)
+            state_for[gacfg_post] = st_post
+            # warm the SHRUNK-shape endTry fetch too: the final fetch of
+            # a post_pop_size run uses the post population's shape, and
+            # an unwarmed concat would pay its compile inside -t beyond
+            # the measured reserve
+            _fetch_final(st_post, n_islands, gacfg_post.pop_size)
+        else:
+            state_for[gacfg_post] = state
     # polish runners for BOTH phase configs: the init polish uses the
     # repair config's, the budget-tail polish (see _run_tries) uses the
     # ACTIVE phase's — and neither may compile inside a timed budget
@@ -436,20 +485,23 @@ def precompile(cfg: RunConfig) -> None:
             continue
         g_spg_key = (_mesh_key(mesh), g, fingerprint)
         polish, pwarm = cached_polish_runner(mesh, g, sig, n_islands)
-        jax.block_until_ready(polish(pa, key, state, 1))
+        jax.block_until_ready(polish(pa, key, state_for[g], 1))
         if not pwarm or g_spg_key not in _SPS_CACHE:
             t0 = time.monotonic()
             jax.block_until_ready(
-                polish(pa, jax.random.key(1), state, 1))
+                polish(pa, jax.random.key(1), state_for[g], 1))
             sps = time.monotonic() - t0
             prev = _SPS_CACHE.get(g_spg_key)
             _SPS_CACHE[g_spg_key] = (sps if prev is None
                                      else 0.7 * sps + 0.3 * prev)
     # stall-kick program (worst-half reseed; dispatched by timed runs
-    # when the post phase plateaus — must not compile mid-budget)
-    if cfg.kick_stall > 0 and gacfg_post is not None and cfg.pop_size >= 2:
-        kicker, _ = cached_kick_runner(mesh, gacfg, sig, n_islands)
-        jax.block_until_ready(kicker(pa, key, state, 3))
+    # when the post phase plateaus — must not compile mid-budget). Built
+    # from the POST config: that is the phase it fires in, and the post
+    # population may be the shrunk one
+    if (cfg.kick_stall > 0 and gacfg_post is not None
+            and gacfg_post.pop_size >= 2):
+        kicker, _ = cached_kick_runner(mesh, gacfg_post, sig, n_islands)
+        jax.block_until_ready(kicker(pa, key, state_for[gacfg_post], 3))
     # static dispatches always run gens = migration_period (shorter
     # remainders go through the dynamic runner), at pow2 n_ep; compile
     # exactly those — for BOTH phase configs when a post-feasibility
@@ -459,6 +511,7 @@ def precompile(cfg: RunConfig) -> None:
               if cfg.generations >= cfg.migration_period else 0)
     for g in ([gacfg] if gacfg_post is None else [gacfg, gacfg_post]):
         g_spg_key = (_mesh_key(mesh), g, fingerprint)
+        g_state = state_for[g]
         # dynamic runner FIRST: one generation is the smallest dispatch
         # the engine can make, so it doubles as the safe sec/gen probe
         # for configs whose FULL epoch would outrun the watchdog (a
@@ -467,11 +520,12 @@ def precompile(cfg: RunConfig) -> None:
         # shape; executing that shape to measure it is the bug)
         dyn, _ = cached_dynamic_runner(mesh, g, cfg.migration_period,
                                        sig, n_islands)
-        jax.block_until_ready(dyn(pa, key, state, 1))
+        jax.block_until_ready(dyn(pa, key, g_state, 1))
         spg_est = _SPG_CACHE.get(g_spg_key)
         if spg_est is None:
             t0 = time.monotonic()
-            jax.block_until_ready(dyn(pa, jax.random.key(1), state, 1))
+            jax.block_until_ready(dyn(pa, jax.random.key(1), g_state,
+                                      1))
             # 1 generation + dispatch/migration overhead: an
             # OVERESTIMATE of sec/gen, used only to gate the static
             # builds below (conservative = never builds a shape the
@@ -486,7 +540,7 @@ def precompile(cfg: RunConfig) -> None:
                 break
             runner, warm = cached_runner(mesh, g, n_ep, gens, sig,
                                          n_islands)
-            st2, _, _ = runner(pa, key, state)
+            st2, _, _ = runner(pa, key, g_state)
             jax.block_until_ready(st2)
             if not warm:
                 # the timing call MUST differ from the compile call:
@@ -495,7 +549,7 @@ def precompile(cfg: RunConfig) -> None:
                 # made this measure ~2e-5 s/gen and let a 146 s dispatch
                 # through a 60 s budget — so re-run with a different key
                 t0 = time.monotonic()
-                st2, _, _ = runner(pa, jax.random.key(1), state)
+                st2, _, _ = runner(pa, jax.random.key(1), g_state)
                 jax.block_until_ready(st2)
                 spg = (time.monotonic() - t0) / (n_ep * gens)
                 prev = _SPG_CACHE.get(g_spg_key)
@@ -755,6 +809,12 @@ def _run_tries(cfg: RunConfig, out) -> int:
             # feasibility already reached during the init polish
             cur = gacfg_post
             cur_key = (_mesh_key(mesh), cur, fingerprint)
+            if cur.pop_size != gacfg.pop_size:
+                # endgame elite truncation (post_pop_size); the shrink
+                # program is precompiled and the decision derives from
+                # best_seen — identical on every process
+                state = cached_shrink_runner(
+                    mesh, gacfg.pop_size, cur.pop_size, n_islands)(state)
             _phase(out, cfg.trace, "phase-switch", trial, 0.0, at_gen=0)
         sec_per_gen = _spg_for(cur_key, cur, gacfg, spg_key)
         time_stopped = False
@@ -931,6 +991,10 @@ def _run_tries(cfg: RunConfig, out) -> int:
                     and min(best_seen) < FEASIBLE_LIMIT):
                 cur = gacfg_post
                 cur_key = (_mesh_key(mesh), cur, fingerprint)
+                if cur.pop_size != gacfg.pop_size:
+                    state = cached_shrink_runner(
+                        mesh, gacfg.pop_size, cur.pop_size,
+                        n_islands)(state)
                 sec_per_gen = _spg_for(cur_key, cur, gacfg, spg_key)
                 _phase(out, cfg.trace, "phase-switch", trial, 0.0,
                        at_gen=gens_done)
@@ -943,7 +1007,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
             # best (islands.make_kick_runner; the single-island analogue
             # of migration's diversity injection, ga.cpp:522-535).
             if (cur is gacfg_post and cfg.kick_stall > 0
-                    and cfg.pop_size >= 2):
+                    and cur.pop_size >= 2):
                 nb = min(best_seen)
                 if nb < kick_best:
                     kick_stall = 0
@@ -966,7 +1030,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
                     # condition); under --no-precompile the first kick
                     # pays its XLA compile inside -t like every other
                     # program in that mode
-                    kicker, _kwarm = cached_kick_runner(mesh, gacfg,
+                    kicker, _kwarm = cached_kick_runner(mesh, cur,
                                                         sig, n_islands)
                     n_moves = min(3 << kick_streak,
                                   islands.KICK_MAX_MOVES)
@@ -1031,9 +1095,11 @@ def _run_tries(cfg: RunConfig, out) -> int:
                     reserve, sec_per_sweep, n_islands, best_seen,
                     trial, "tail-polish", None, cur.ls_sideways, True)
 
-        # final per-island solution records (endTry, ga.cpp:169-197)
+        # final per-island solution records (endTry, ga.cpp:169-197).
+        # P is the ACTIVE phase's population (the post phase may have
+        # shrunk it to the elite rows)
         t = time.monotonic()
-        P = cfg.pop_size
+        P = cur.pop_size
         slots, rooms, hcv, scv = _fetch_final(state, n_islands, P)
         _phase(out, cfg.trace, "fetch", trial, time.monotonic() - t)
         total_time = time.monotonic() - t_try
