@@ -236,10 +236,17 @@ class RunConfig:
                 setattr(self, field, value)
         if (self.post_pop_size is not None
                 and self.post_pop_size >= self.pop_size):
-            # an explicit small --pop-size can undercut the tuned
+            if "post_pop_size" in self.explicit_fields:
+                # the USER asked for this shrink; silently ignoring the
+                # flag would be worse than stopping
+                raise SystemExit(
+                    f"--post-pop-size {self.post_pop_size} does not "
+                    f"shrink the (tuned) population {self.pop_size}; "
+                    f"pass --pop-size explicitly or drop the flag")
+            # an explicit small --pop-size can undercut the TUNED
             # endgame shrink; a post population >= the repair one is
             # meaningless (and > would crash the shard reshape), so
-            # drop the shrink rather than error on a tuned default
+            # drop the tuned default rather than error
             self.post_pop_size = None
         return self
 
@@ -346,6 +353,8 @@ def parse_args(argv) -> RunConfig:
         raise SystemExit("--post-pop-size changes the population shape "
                          "mid-run, which a checkpoint/resume cycle "
                          "cannot represent; drop one of the two flags")
+    if cfg.post_pop_size is not None and cfg.post_pop_size < 1:
+        raise SystemExit("--post-pop-size must be >= 1")
     if (cfg.post_pop_size is not None and "pop_size" in seen
             and cfg.post_pop_size > cfg.pop_size):
         # only checkable at parse time when the user pinned BOTH sides;

@@ -405,14 +405,16 @@ def _setup(cfg: RunConfig):
         # programmatic construction the same way (the mid-run shape
         # change cannot round-trip a checkpoint/resume cycle)
         raise ValueError("post_pop_size with checkpoint is unsupported")
-    if gacfg_post is not None and gacfg_post.pop_size > gacfg.pop_size:
+    if gacfg_post is not None and not (
+            1 <= gacfg_post.pop_size <= gacfg.pop_size):
         # post-tune validation (parse_args can only check when the user
         # pinned both flags): a post population larger than the repair
-        # one has no elite rows to grow from, and the shard reshape
-        # would fail with an opaque XLA error instead of this message
+        # one has no elite rows to grow from, below 1 it has no rows at
+        # all — either way the shard reshape would fail with an opaque
+        # XLA error instead of this message
         raise ValueError(
-            f"post_pop_size {gacfg_post.pop_size} exceeds pop_size "
-            f"{gacfg.pop_size}")
+            f"post_pop_size {gacfg_post.pop_size} must be in "
+            f"[1, pop_size={gacfg.pop_size}]")
     fingerprint = ckpt.config_fingerprint(problem, gacfg, n_islands)
     spg_key = (_mesh_key(mesh), gacfg, fingerprint)
     return (problem, pa, mesh, n_islands, gacfg, gacfg_post, fingerprint,
