@@ -233,9 +233,14 @@ def measure_generation_sweep_tuned(problem, label: str) -> dict:
     # long-kernel watchdog (engine.DISPATCH_CAP_S rationale)
     for name, g, gens in (("ms_per_gen", gacfg, 4),) + (
             (("post_ms_per_gen", post, 2),) if post is not None else ()):
+        # the post phase may run a SMALLER population (post_pop_size
+        # elite shrink); measure it on the truncated elite rows exactly
+        # as the engine runs it (state is penalty-sorted)
+        st = (state if g.pop_size == gacfg.pop_size
+              else jax.tree.map(lambda x: x[:g.pop_size], state))
         run = jax.jit(lambda k, s, g=g, gens=gens: ga.run(
             pa, k, s, g, gens)[0])
-        warm = run(jax.random.key(1), state)
+        warm = run(jax.random.key(1), st)
         jax.block_until_ready(warm)
         t0 = time.perf_counter()
         jax.block_until_ready(run(jax.random.key(2), warm))
