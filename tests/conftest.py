@@ -57,3 +57,17 @@ def random_assignment(rng, problem, n):
     rooms = rng.integers(0, problem.n_rooms,
                          size=(n, problem.n_events)).astype(np.int32)
     return slots, rooms
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Free compiled XLA executables after each test module.
+
+    A full-suite run accumulates every module's jitted programs in one
+    process; at round-5 program counts the CPU client segfaulted inside
+    a late scan dispatch (test_sweep, reproducibly at ~the same point,
+    while the same test passes solo). Dropping the caches between
+    modules bounds the live-executable population; cross-module cache
+    reuse was nil anyway (different shapes/configs per module)."""
+    yield
+    jax.clear_caches()
