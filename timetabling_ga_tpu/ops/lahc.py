@@ -1,0 +1,167 @@
+"""Late-Acceptance Hill Climbing chains — the scv-endgame walker.
+
+Motivation (BASELINE.md round 5, asymmetric race): the post-feasibility
+scv endgame is where the reference's sequential first-improvement walk
+(Solution.cpp:619-768) is more sample-efficient per candidate than our
+best-improvement sweeps — at a 32x CPU budget it out-polishes them on
+comp01s/comp05s. Best-improvement + stall kicks plateau because every
+accepted move must improve (or drift sideways); deep scv basins need
+CONTROLLED uphill acceptance. Late-Acceptance Hill Climbing (Burke &
+Bykov, "The late acceptance Hill-Climbing heuristic", EJOR 2017 —
+introduced ON timetabling benchmarks) is exactly that mechanism, and it
+is TPU-shaped: P independent walkers vmapped, each taking one cheap
+delta-evaluated random move per `lax.fori_loop` step, with no
+data-dependent shapes.
+
+The rule, per walker: keep a ring buffer `hist` of the last-seen costs
+at each phase of a length-Lh cycle. A candidate is accepted iff it is
+no worse than the CURRENT cost or no worse than the cost Lh steps ago:
+
+    v = step mod Lh
+    accept = cand <= hist[v]  OR  cand <= cur        (lexicographic)
+    move if accept; hist[v] = cur'; step += 1
+
+Early in the run hist holds high costs, so the walker crosses wide
+plateaus and shallow hills; as improvements feed back into hist the
+acceptance tightens toward pure hill-climbing — an annealing schedule
+with ONE parameter (Lh) and no temperature tuning.
+
+Costs are compared in the reported evaluation's total order
+(hcv*1e6 + scv, ga.cpp:191) expressed overflow-safely as the
+lexicographic pair (penalty, scv) — see fitness.lex_order. Once a
+walker is feasible it can never be accepted into infeasibility: an
+infeasible candidate's penalty (1e6 + hcv) lex-dominates every
+feasible history entry, so the rule rejects it without a gate.
+
+Candidates are the reference's own move distribution: `sample_move`
+(Move1/2/3 at p1:p2:p3, Solution.cpp:441-469) delta-evaluated by
+`_delta_one` — the bit-exactness-tested kernel the sweeps share.
+
+Best-so-far tracking: LAHC walkers wander uphill by design, so each
+walker carries its best-seen (slots, rooms, hcv, scv); the final answer
+is the best snapshot, not the walker's current position.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from timetabling_ga_tpu.ops import fitness
+from timetabling_ga_tpu.ops.delta import (
+    LSState, _apply_move, _delta_one, init_state)
+from timetabling_ga_tpu.ops.moves import sample_move
+from timetabling_ga_tpu.ops.rooms import capacity_rank
+
+
+class LahcState(NamedTuple):
+    """Per-walker LAHC state. Every field has leading axis P, so one
+    sharding spec covers the whole tree (the per-walker `step` counters
+    are identical by construction; per-walker storage keeps the pytree
+    uniformly island-shardable)."""
+
+    ls: LSState            # current walker positions + maintained tensors
+    hist_pen: jnp.ndarray  # (P, Lh) int32 ring buffer of penalties
+    hist_scv: jnp.ndarray  # (P, Lh) int32 ring buffer of scv tie-breaks
+    step: jnp.ndarray      # (P,) int32 chain position (mod Lh indexing)
+    best_slots: jnp.ndarray  # (P, E) int32 best-so-far snapshot
+    best_rooms: jnp.ndarray  # (P, E) int32
+    best_pen: jnp.ndarray    # (P,) int32
+    best_hcv: jnp.ndarray    # (P,) int32
+    best_scv: jnp.ndarray    # (P,) int32
+
+
+def _lex_le(p_a, s_a, p_b, s_b):
+    """(p_a, s_a) <= (p_b, s_b) in the reported-metric order."""
+    return (p_a < p_b) | ((p_a == p_b) & (s_a <= s_b))
+
+
+def _lex_lt(p_a, s_a, p_b, s_b):
+    return (p_a < p_b) | ((p_a == p_b) & (s_a < s_b))
+
+
+def init_lahc(pa, slots, rooms_arr, hist_len: int) -> LahcState:
+    """Start P walkers at the given genotypes; history primed with each
+    walker's initial cost (the standard LAHC initialization: hist[k] :=
+    f(s0) for all k)."""
+    ls = init_state(pa, slots, rooms_arr)
+    P = slots.shape[0]
+    ones = jnp.ones((P, hist_len), jnp.int32)
+    return LahcState(
+        ls=ls,
+        hist_pen=ones * ls.pen[:, None],
+        hist_scv=ones * ls.scv[:, None],
+        step=jnp.zeros((P,), jnp.int32),
+        best_slots=slots, best_rooms=rooms_arr,
+        best_pen=ls.pen, best_hcv=ls.hcv, best_scv=ls.scv)
+
+
+def lahc_steps(pa, key, state: LahcState, n_steps,
+               p1: float = 1.0, p2: float = 1.0, p3: float = 0.0):
+    """Advance every walker `n_steps` LAHC steps (`n_steps` is a RUNTIME
+    scalar — one compile serves every chunk size; the engine sizes
+    chunks to its wall-clock budget like every other dispatch)."""
+    cap_rank = capacity_rank(pa)
+    P, Lh = state.hist_pen.shape
+
+    def one_step(i, st: LahcState) -> LahcState:
+        keys = jax.random.split(jax.random.fold_in(key, i), P)
+
+        def per_walker(k, s, r, att, occ, pen, hcv, scv, hp, hs, step):
+            evs, new_slots, active = sample_move(pa, k, s, p1, p2, p3)
+            d_hcv, d_scv, new_rooms = _delta_one(
+                pa, s, r, att, occ, evs, new_slots, active, cap_rank)
+            c_hcv = hcv + d_hcv
+            c_scv = scv + d_scv
+            c_pen = jnp.where(c_hcv == 0, c_scv,
+                              fitness.INFEASIBLE_OFFSET + c_hcv)
+            v = step % Lh
+            accept = (_lex_le(c_pen, c_scv, hp[v], hs[v])
+                      | _lex_le(c_pen, c_scv, pen, scv))
+            s2, r2, att2, occ2 = _apply_move(
+                pa, (s, r, att, occ), evs, new_slots, new_rooms)
+            s = jnp.where(accept, s2, s)
+            r = jnp.where(accept, r2, r)
+            att = jnp.where(accept, att2, att)
+            occ = jnp.where(accept, occ2, occ)
+            pen = jnp.where(accept, c_pen, pen)
+            hcv = jnp.where(accept, c_hcv, hcv)
+            scv = jnp.where(accept, c_scv, scv)
+            # history takes the POST-decision current cost (Burke-Bykov
+            # update order: acceptance first, then hist[v] := f(current))
+            hp = hp.at[v].set(pen)
+            hs = hs.at[v].set(scv)
+            return s, r, att, occ, pen, hcv, scv, hp, hs, step + 1
+
+        (s, r, att, occ, pen, hcv, scv, hp, hs, step) = jax.vmap(
+            per_walker)(keys, st.ls.slots, st.ls.rooms, st.ls.att,
+                        st.ls.occ, st.ls.pen, st.ls.hcv, st.ls.scv,
+                        st.hist_pen, st.hist_scv, st.step)
+
+        improved = _lex_lt(pen, scv, st.best_pen, st.best_scv)   # (P,)
+        return LahcState(
+            ls=LSState(slots=s, rooms=r, att=att, occ=occ,
+                       pen=pen, hcv=hcv, scv=scv),
+            hist_pen=hp, hist_scv=hs, step=step,
+            best_slots=jnp.where(improved[:, None], s, st.best_slots),
+            best_rooms=jnp.where(improved[:, None], r, st.best_rooms),
+            best_pen=jnp.where(improved, pen, st.best_pen),
+            best_hcv=jnp.where(improved, hcv, st.best_hcv),
+            best_scv=jnp.where(improved, scv, st.best_scv))
+
+    return lax.fori_loop(0, n_steps, one_step, state)
+
+
+@functools.partial(jax.jit, static_argnames=("hist_len",))
+def jit_init_lahc(pa, slots, rooms_arr, hist_len: int):
+    return init_lahc(pa, slots, rooms_arr, hist_len)
+
+
+@functools.partial(jax.jit, static_argnames=("p1", "p2", "p3"))
+def jit_lahc_steps(pa, key, state: LahcState, n_steps,
+                   p1: float = 1.0, p2: float = 1.0, p3: float = 0.0):
+    return lahc_steps(pa, key, state, n_steps, p1, p2, p3)

@@ -397,6 +397,84 @@ def make_shrink_runner(mesh: Mesh, pop_in: int, pop_out: int,
     return jax.jit(_shrink)
 
 
+def _lahc_specs():
+    """Sharding spec tree for LahcState: every field has leading axis P
+    (walkers), sharded along the island axis."""
+    from timetabling_ga_tpu.ops.lahc import LahcState
+    from timetabling_ga_tpu.ops.delta import LSState
+    return LahcState(
+        ls=LSState(*([P(AXIS)] * 7)),
+        hist_pen=P(AXIS), hist_scv=P(AXIS), step=P(AXIS),
+        best_slots=P(AXIS), best_rooms=P(AXIS),
+        best_pen=P(AXIS), best_hcv=P(AXIS), best_scv=P(AXIS))
+
+
+def make_lahc_runners(mesh: Mesh, cfg: ga.GAConfig, hist_len: int,
+                      n_islands: int = None):
+    """Late-Acceptance Hill Climbing endgame programs (ops/lahc.py):
+
+      init(pa, state)              -> lahc_state   (walkers = pop rows)
+      run(pa, key, lahc_state, n)  -> (lahc_state, stats)
+      finalize(lahc_state)         -> PopState     (best snapshots)
+
+    `n` (steps per dispatch) is a RUNTIME argument — the engine sizes
+    each dispatch to its wall-clock budget, like the polish/dynamic
+    runners. `stats` is one (3, n_islands) int32 array of each island's
+    lex-best walker's best-so-far (pen, hcv, scv) — ONE host fetch per
+    chunk for the logEntry stream. `finalize` returns each island's
+    best snapshots as a lex-sorted PopState, so the endTry fetch reads
+    it exactly like a GA population. Walkers are per-island independent;
+    no migration runs during LAHC (each walker is its own chain — the
+    diversity is the walker ensemble, seeded from the elite rows)."""
+    from timetabling_ga_tpu.ops import lahc as lahc_ops
+    L = local_islands(mesh, n_islands)
+    pop = cfg.pop_size
+    specs = _lahc_specs()
+    pop_specs = ga.PopState(slots=P(AXIS), rooms=P(AXIS), penalty=P(AXIS),
+                            hcv=P(AXIS), scv=P(AXIS))
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(), pop_specs),
+        out_specs=specs, check_vma=False)
+    def _init(pa, state):
+        return lahc_ops.init_lahc(pa, state.slots, state.rooms, hist_len)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(), P(), specs, P()),
+        out_specs=(specs, P(None, AXIS)), check_vma=False)
+    def _run(pa, key, lstate, n_steps):
+        my_key = jax.random.fold_in(key, lax.axis_index(AXIS))
+        lstate = lahc_ops.lahc_steps(pa, my_key, lstate, n_steps,
+                                     cfg.p1, cfg.p2, cfg.p3)
+        # per-island lex-best over each island's walker block
+        bp = lstate.best_pen.reshape(L, pop)
+        bh = lstate.best_hcv.reshape(L, pop)
+        bs = lstate.best_scv.reshape(L, pop)
+        idx = jax.vmap(lambda p_, s_: fitness.lex_order(p_, s_)[0])(bp, bs)
+        la = jnp.arange(L)
+        stats = jnp.stack([bp[la, idx], bh[la, idx], bs[la, idx]])
+        return lstate, stats
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(specs,),
+        out_specs=pop_specs, check_vma=False)
+    def _finalize(lstate):
+        def one_island(bs, br, bp, bh, bv):
+            order = fitness.lex_order(bp, bv)
+            return ga.PopState(slots=bs[order], rooms=br[order],
+                               penalty=bp[order], hcv=bh[order],
+                               scv=bv[order])
+        blk = jax.vmap(one_island)(
+            lstate.best_slots.reshape(L, pop, -1),
+            lstate.best_rooms.reshape(L, pop, -1),
+            lstate.best_pen.reshape(L, pop),
+            lstate.best_hcv.reshape(L, pop),
+            lstate.best_scv.reshape(L, pop))
+        return _flat(blk)
+
+    return jax.jit(_init), jax.jit(_run), jax.jit(_finalize)
+
+
 def make_island_runner_dynamic(mesh: Mesh, cfg: ga.GAConfig,
                                max_gens: int, n_islands: int = None):
     """Like `make_island_runner(n_epochs=1)` but the generation count is

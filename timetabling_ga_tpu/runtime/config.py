@@ -108,6 +108,16 @@ class RunConfig:
     #                           population's robustness (a pop this small
     #                           from generation 0 strands whole runs
     #                           infeasible — measured, BASELINE.md r5)
+    post_lahc: int = 0        # > 0 replaces the post-feasibility GA
+    #                           endgame with Late-Acceptance Hill
+    #                           Climbing chains of this history length
+    #                           (ops/lahc.py): each elite row (after the
+    #                           post_pop_size shrink) becomes an
+    #                           independent LAHC walker taking cheap
+    #                           delta-evaluated random moves with the
+    #                           late-acceptance rule — controlled uphill
+    #                           acceptance where the sweep endgame only
+    #                           descends/drifts. 0 = GA endgame (default)
     ls_converge: bool = False  # sweep LS early-exits at the population-
     #                            wide local optimum (reference stopping
     #                            rule); ls_sweeps becomes the hard bound
@@ -287,6 +297,7 @@ _FLAG_MAP = {
     "--post-hot-k": ("post_hot_k", int),
     "--post-sideways": ("post_sideways", float),
     "--post-pop-size": ("post_pop_size", int),
+    "--post-lahc": ("post_lahc", int),
     "--init-sweeps": ("init_sweeps", int),
     "--rooms-mode": ("rooms_mode", str),
     "--checkpoint": ("checkpoint", str),
@@ -355,6 +366,14 @@ def parse_args(argv) -> RunConfig:
                          "cannot represent; drop one of the two flags")
     if cfg.post_pop_size is not None and cfg.post_pop_size < 1:
         raise SystemExit("--post-pop-size must be >= 1")
+    if cfg.post_lahc < 0:
+        raise SystemExit("--post-lahc must be >= 0 (history length; "
+                         "0 disables the LAHC endgame)")
+    if cfg.post_lahc > 1_000_000:
+        # two (pop, hist_len) int32 ring buffers per walker ensemble —
+        # beyond this the allocation fails as an opaque XLA OOM
+        raise SystemExit("--post-lahc history length is implausibly "
+                         "large (max 1000000)")
     if (cfg.post_pop_size is not None and "pop_size" in seen
             and cfg.post_pop_size > cfg.pop_size):
         # only checkable at parse time when the user pinned BOTH sides;

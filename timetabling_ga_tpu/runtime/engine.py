@@ -214,6 +214,32 @@ def cached_kick_runner(mesh, gacfg: ga.GAConfig, sig, n_islands: int):
     return r, False
 
 
+# Measured seconds-per-LAHC-step (walker-ensemble step, not per
+# candidate), persisted like _SPG_CACHE so a precompiled probe bounds
+# the first timed chunk.
+_LAHC_SPS_CACHE: dict = {}
+
+
+def _lahc_key(mesh, gacfg: ga.GAConfig, hist_len: int, fingerprint):
+    return ("lahc", _mesh_key(mesh), gacfg.pop_size, gacfg.p1, gacfg.p2,
+            gacfg.p3, hist_len, fingerprint)
+
+
+def cached_lahc_runners(mesh, gacfg: ga.GAConfig, hist_len: int, sig,
+                        n_islands: int):
+    """(init, run, finalize) LAHC endgame programs
+    (islands.make_lahc_runners). The traced programs depend only on
+    (pop_size, p1/p2/p3, hist_len) of `gacfg` — built from the POST
+    config, whose pop_size may be the shrunk one."""
+    k = ("lahc", _mesh_key(mesh), gacfg.pop_size, gacfg.p1, gacfg.p2,
+         gacfg.p3, hist_len, sig, n_islands)
+    r = _RUNNER_CACHE.get(k)
+    if r is None:
+        r = islands.make_lahc_runners(mesh, gacfg, hist_len, n_islands)
+        _RUNNER_CACHE[k] = r
+    return r
+
+
 def cached_shrink_runner(mesh, pop_in: int, pop_out: int,
                          n_islands: int):
     """Elite truncation at the post-feasibility switch (post_pop_size);
@@ -273,7 +299,7 @@ def build_post_config(cfg: RunConfig, gacfg: ga.GAConfig):
     global best reaches feasibility (VERDICT round-3 next #3)."""
     if (cfg.post_ls_sweeps is None and cfg.post_swap_block is None
             and cfg.post_hot_k is None and cfg.post_sideways is None
-            and cfg.post_pop_size is None):
+            and cfg.post_pop_size is None and cfg.post_lahc <= 0):
         return None
     post = dataclasses.replace(
         gacfg,
@@ -288,6 +314,12 @@ def build_post_config(cfg: RunConfig, gacfg: ga.GAConfig):
                   else gacfg.ls_hot_k),
         ls_sideways=(cfg.post_sideways if cfg.post_sideways is not None
                      else gacfg.ls_sideways))
+    if cfg.post_lahc > 0:
+        # the LAHC endgame needs a phase switch even when every GA post
+        # field is inherited unchanged (post == gacfg); the post config
+        # then only supplies pop size + move probabilities to the
+        # walker programs
+        return post
     return None if post == gacfg else post
 
 
@@ -479,10 +511,30 @@ def precompile(cfg: RunConfig) -> None:
             _fetch_final(st_post, n_islands, gacfg_post.pop_size)
         else:
             state_for[gacfg_post] = state
+    # With a LAHC endgame the post phase never dispatches GA programs
+    # (the engine enters the walker loop at the switch and consumes the
+    # whole remaining budget there), so the post config's GA ladder /
+    # polish / kick programs would be dead compiles — build the LAHC
+    # programs instead (below) and keep the GA builds repair-only.
+    post_ga = gacfg_post if cfg.post_lahc <= 0 else None
+    if cfg.post_lahc > 0 and gacfg_post is not None:
+        init_r, run_r, fin_r = cached_lahc_runners(
+            mesh, gacfg_post, cfg.post_lahc, sig, n_islands)
+        lkey = _lahc_key(mesh, gacfg_post, cfg.post_lahc, fingerprint)
+        ls0 = init_r(pa, state_for[gacfg_post])
+        jax.block_until_ready(ls0)
+        ls1, _ = run_r(pa, key, ls0, 64)       # compile
+        jax.block_until_ready(ls1)
+        if lkey not in _LAHC_SPS_CACHE:
+            t0 = time.monotonic()
+            ls2, stats = run_r(pa, jax.random.key(1), ls0, 256)
+            jax.block_until_ready(stats)
+            _LAHC_SPS_CACHE[lkey] = (time.monotonic() - t0) / 256
+        jax.block_until_ready(fin_r(ls1))
     # polish runners for BOTH phase configs: the init polish uses the
     # repair config's, the budget-tail polish (see _run_tries) uses the
     # ACTIVE phase's — and neither may compile inside a timed budget
-    for g in ([gacfg] if gacfg_post is None else [gacfg, gacfg_post]):
+    for g in ([gacfg] if post_ga is None else [gacfg, post_ga]):
         if gacfg.init_sweeps <= 0 and g.ls_mode != "sweep":
             continue
         g_spg_key = (_mesh_key(mesh), g, fingerprint)
@@ -500,10 +552,10 @@ def precompile(cfg: RunConfig) -> None:
     # when the post phase plateaus — must not compile mid-budget). Built
     # from the POST config: that is the phase it fires in, and the post
     # population may be the shrunk one
-    if (cfg.kick_stall > 0 and gacfg_post is not None
-            and gacfg_post.pop_size >= 2):
-        kicker, _ = cached_kick_runner(mesh, gacfg_post, sig, n_islands)
-        jax.block_until_ready(kicker(pa, key, state_for[gacfg_post], 3))
+    if (cfg.kick_stall > 0 and post_ga is not None
+            and post_ga.pop_size >= 2):
+        kicker, _ = cached_kick_runner(mesh, post_ga, sig, n_islands)
+        jax.block_until_ready(kicker(pa, key, state_for[post_ga], 3))
     # static dispatches always run gens = migration_period (shorter
     # remainders go through the dynamic runner), at pow2 n_ep; compile
     # exactly those — for BOTH phase configs when a post-feasibility
@@ -511,7 +563,7 @@ def precompile(cfg: RunConfig) -> None:
     gens = cfg.migration_period
     max_ep = (_pow2_floor(max(cfg.epochs_per_dispatch, 1))
               if cfg.generations >= cfg.migration_period else 0)
-    for g in ([gacfg] if gacfg_post is None else [gacfg, gacfg_post]):
+    for g in ([gacfg] if post_ga is None else [gacfg, post_ga]):
         g_spg_key = (_mesh_key(mesh), g, fingerprint)
         g_state = state_for[g]
         # dynamic runner FIRST: one generation is the smallest dispatch
@@ -707,6 +759,72 @@ def _polish_chunks(out, cfg, pa, polish, state, base_key, t_try, reserve,
     return state, sec_per_sweep
 
 
+def _lahc_loop(out, cfg, pa, mesh, state, base_key, t_try, reserve,
+               n_islands, best_seen, trial, gacfg_post, sig,
+               fingerprint):
+    """Late-Acceptance Hill Climbing endgame (--post-lahc): consume the
+    try's remaining wall-clock budget with LAHC walker chunks, then
+    return the best snapshots as a PopState for the endTry fetch.
+
+    Entered at the post-feasibility phase switch in place of the GA
+    generation loop: each elite row (after the post_pop_size shrink)
+    becomes an independent walker (ops/lahc.py). Chunks are sized from
+    the measured sec/step like every other dispatch (DISPATCH_CAP_S +
+    remaining-budget bound, schedule agreed across hosts via
+    _sync_vals); each chunk costs ONE (3, n_islands) stats fetch that
+    feeds the logEntry stream. No stall rule: late acceptance is the
+    diversification — a flat chunk means the history ring is still
+    draining, not a fixed point (the reference's phase-2 analogue is
+    running its scv walk until the clock, Solution.cpp:499/619-768)."""
+    init_r, run_r, fin_r = cached_lahc_runners(
+        mesh, gacfg_post, cfg.post_lahc, sig, n_islands)
+    lkey = _lahc_key(mesh, gacfg_post, cfg.post_lahc, fingerprint)
+    lstate = init_r(pa, state)
+    sec_per_step = _LAHC_SPS_CACHE.get(lkey)
+    # no cached estimate means precompile never probed this program, so
+    # the first chunk pays its XLA compile — discard that chunk's timing
+    # entirely (the _polish_chunks warm rule): recording it would poison
+    # the persisted estimate and shrink every later chunk to overhead-
+    # dominated slivers
+    warm = sec_per_step is not None
+    it = 0
+    while True:
+        remaining_t = (cfg.time_limit - reserve
+                       - (time.monotonic() - t_try))
+        if sec_per_step is not None and sec_per_step > 0:
+            n = int(min(remaining_t / 1.1, DISPATCH_CAP_S)
+                    / sec_per_step)
+        else:
+            # no estimate (--no-precompile): a small probe chunk, whose
+            # own timing seeds the estimate for the next chunk
+            n = 256 if remaining_t > 0 else 0
+        n, = _sync_vals(n)
+        if n < 1:
+            break
+        t0 = time.monotonic()
+        lstate, stats = run_r(pa, jax.random.fold_in(base_key, it),
+                              lstate, n)
+        stats = _fetch(stats)              # blocks on the dispatch
+        dt = time.monotonic() - t0
+        _phase(out, cfg.trace, "lahc", trial, dt, steps=n)
+        if warm:
+            sps = dt / n
+            sec_per_step = (sps if sec_per_step is None
+                            else 0.7 * sps + 0.3 * sec_per_step)
+            _LAHC_SPS_CACHE[lkey] = sec_per_step
+        warm = True
+        for i in range(n_islands):
+            rep = jsonl.reported_best(stats[1][i], stats[2][i])
+            if rep < best_seen[i]:
+                best_seen[i] = rep
+                jsonl.log_entry(out, i, 0, rep,
+                                time.monotonic() - t_try)
+        it += 1
+    state = fin_r(lstate)
+    jax.block_until_ready(state)
+    return state
+
+
 def _run_tries(cfg: RunConfig, out) -> int:
     t0 = time.monotonic()
     # Runners come from the module-level compiled-program cache (keyed on
@@ -806,6 +924,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
         # the global best reaches feasibility (both programs are warm —
         # precompile builds them together)
         cur, cur_key = gacfg, spg_key
+        lahc_done = False
         if (gacfg_post is not None
                 and min(best_seen) < FEASIBLE_LIMIT):
             # feasibility already reached during the init polish
@@ -818,6 +937,12 @@ def _run_tries(cfg: RunConfig, out) -> int:
                 state = cached_shrink_runner(
                     mesh, gacfg.pop_size, cur.pop_size, n_islands)(state)
             _phase(out, cfg.trace, "phase-switch", trial, 0.0, at_gen=0)
+            if cfg.post_lahc > 0:
+                key, k_lahc = jax.random.split(key)
+                state = _lahc_loop(
+                    out, cfg, pa, mesh, state, k_lahc, t_try, reserve,
+                    n_islands, best_seen, trial, cur, sig, fingerprint)
+                lahc_done = True
         sec_per_gen = _spg_for(cur_key, cur, gacfg, spg_key)
         time_stopped = False
         kick_stall = 0
@@ -828,7 +953,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
         #                     basin means the previous depth was too
         #                     shallow to escape it
         profiled = False
-        while gens_done < cfg.generations:
+        while not lahc_done and gens_done < cfg.generations:
             remaining_t = (cfg.time_limit - reserve
                            - (time.monotonic() - t_try))
             stop = remaining_t <= 0
@@ -1000,6 +1125,16 @@ def _run_tries(cfg: RunConfig, out) -> int:
                 sec_per_gen = _spg_for(cur_key, cur, gacfg, spg_key)
                 _phase(out, cfg.trace, "phase-switch", trial, 0.0,
                        at_gen=gens_done)
+                if cfg.post_lahc > 0:
+                    # the endgame leaves the GA entirely: the remaining
+                    # budget belongs to the LAHC walkers
+                    key, k_lahc = jax.random.split(key)
+                    state = _lahc_loop(
+                        out, cfg, pa, mesh, state, k_lahc, t_try,
+                        reserve, n_islands, best_seen, trial, cur, sig,
+                        fingerprint)
+                    lahc_done = True
+                    break
 
             # stall kick (VERDICT round-4 next #5): in the post phase —
             # the scv-polish endgame where small seed 43 sat pinned on a
