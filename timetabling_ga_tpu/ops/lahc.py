@@ -101,10 +101,24 @@ def init_lahc(pa, slots, rooms_arr, hist_len: int) -> LahcState:
 
 
 def lahc_steps(pa, key, state: LahcState, n_steps,
-               p1: float = 1.0, p2: float = 1.0, p3: float = 0.0):
+               p1: float = 1.0, p2: float = 1.0, p3: float = 0.0,
+               k_cands: int = 1):
     """Advance every walker `n_steps` LAHC steps (`n_steps` is a RUNTIME
     scalar — one compile serves every chunk size; the engine sizes
-    chunks to its wall-clock budget like every other dispatch)."""
+    chunks to its wall-clock budget like every other dispatch).
+
+    `k_cands` > 1 evaluates a block of K independent random candidates
+    per walker per step IN PARALLEL and applies the late-acceptance rule
+    to the lex-best of the block ("steepest-of-K LAHC"). At endgame
+    population sizes the chain is dispatch-latency-bound, so the K
+    extra delta evaluations ride along nearly free (vmap width, not
+    scan depth) — K× the candidate throughput per wall-second. A
+    uniform random single candidate is a very sparse sample of the
+    Move1/2/3 neighborhood; the measured single-candidate chain lost
+    to the sweep endgame ~25x on candidates/sec (BASELINE.md round 5),
+    and best-of-K closes exactly that gap while keeping the acceptance
+    semantics (when the block's best is uphill — a local optimum — the
+    rule still takes the controlled uphill step)."""
     cap_rank = capacity_rank(pa)
     P, Lh = state.hist_pen.shape
 
@@ -112,13 +126,31 @@ def lahc_steps(pa, key, state: LahcState, n_steps,
         keys = jax.random.split(jax.random.fold_in(key, i), P)
 
         def per_walker(k, s, r, att, occ, pen, hcv, scv, hp, hs, step):
-            evs, new_slots, active = sample_move(pa, k, s, p1, p2, p3)
-            d_hcv, d_scv, new_rooms = _delta_one(
-                pa, s, r, att, occ, evs, new_slots, active, cap_rank)
-            c_hcv = hcv + d_hcv
-            c_scv = scv + d_scv
-            c_pen = jnp.where(c_hcv == 0, c_scv,
-                              fitness.INFEASIBLE_OFFSET + c_hcv)
+            def one_cand(kc):
+                evs, new_slots, active = sample_move(pa, kc, s, p1, p2,
+                                                     p3)
+                d_hcv, d_scv, new_rooms = _delta_one(
+                    pa, s, r, att, occ, evs, new_slots, active,
+                    cap_rank)
+                return d_hcv, d_scv, evs, new_slots, new_rooms
+
+            if k_cands > 1:
+                dh, ds, evs_k, ns_k, nr_k = jax.vmap(one_cand)(
+                    jax.random.split(k, k_cands))
+                ch = hcv + dh
+                cs = scv + ds
+                cp = jnp.where(ch == 0, cs,
+                               fitness.INFEASIBLE_OFFSET + ch)
+                # lex-argmin over the block (exact integer arithmetic)
+                b = jnp.lexsort((cs, cp))[0]
+                evs, new_slots, new_rooms = evs_k[b], ns_k[b], nr_k[b]
+                c_hcv, c_scv, c_pen = ch[b], cs[b], cp[b]
+            else:
+                d_hcv, d_scv, evs, new_slots, new_rooms = one_cand(k)
+                c_hcv = hcv + d_hcv
+                c_scv = scv + d_scv
+                c_pen = jnp.where(c_hcv == 0, c_scv,
+                                  fitness.INFEASIBLE_OFFSET + c_hcv)
             v = step % Lh
             accept = (_lex_le(c_pen, c_scv, hp[v], hs[v])
                       | _lex_le(c_pen, c_scv, pen, scv))
@@ -161,7 +193,9 @@ def jit_init_lahc(pa, slots, rooms_arr, hist_len: int):
     return init_lahc(pa, slots, rooms_arr, hist_len)
 
 
-@functools.partial(jax.jit, static_argnames=("p1", "p2", "p3"))
+@functools.partial(jax.jit,
+                   static_argnames=("p1", "p2", "p3", "k_cands"))
 def jit_lahc_steps(pa, key, state: LahcState, n_steps,
-                   p1: float = 1.0, p2: float = 1.0, p3: float = 0.0):
-    return lahc_steps(pa, key, state, n_steps, p1, p2, p3)
+                   p1: float = 1.0, p2: float = 1.0, p3: float = 0.0,
+                   k_cands: int = 1):
+    return lahc_steps(pa, key, state, n_steps, p1, p2, p3, k_cands)

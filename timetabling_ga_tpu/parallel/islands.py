@@ -410,7 +410,7 @@ def _lahc_specs():
 
 
 def make_lahc_runners(mesh: Mesh, cfg: ga.GAConfig, hist_len: int,
-                      n_islands: int = None):
+                      k_cands: int = 1, n_islands: int = None):
     """Late-Acceptance Hill Climbing endgame programs (ops/lahc.py):
 
       init(pa, state)              -> lahc_state   (walkers = pop rows)
@@ -445,7 +445,7 @@ def make_lahc_runners(mesh: Mesh, cfg: ga.GAConfig, hist_len: int,
     def _run(pa, key, lstate, n_steps):
         my_key = jax.random.fold_in(key, lax.axis_index(AXIS))
         lstate = lahc_ops.lahc_steps(pa, my_key, lstate, n_steps,
-                                     cfg.p1, cfg.p2, cfg.p3)
+                                     cfg.p1, cfg.p2, cfg.p3, k_cands)
         # per-island lex-best over each island's walker block
         bp = lstate.best_pen.reshape(L, pop)
         bh = lstate.best_hcv.reshape(L, pop)

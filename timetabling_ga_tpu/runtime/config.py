@@ -118,6 +118,12 @@ class RunConfig:
     #                           late-acceptance rule — controlled uphill
     #                           acceptance where the sweep endgame only
     #                           descends/drifts. 0 = GA endgame (default)
+    post_lahc_k: int = 16     # random candidates evaluated per walker
+    #                           per LAHC step (lex-best of the block is
+    #                           the proposal — "steepest-of-K"): vmap
+    #                           width rides the latency-bound chain
+    #                           nearly free, multiplying candidate
+    #                           throughput
     ls_converge: bool = False  # sweep LS early-exits at the population-
     #                            wide local optimum (reference stopping
     #                            rule); ls_sweeps becomes the hard bound
@@ -298,6 +304,7 @@ _FLAG_MAP = {
     "--post-sideways": ("post_sideways", float),
     "--post-pop-size": ("post_pop_size", int),
     "--post-lahc": ("post_lahc", int),
+    "--post-lahc-k": ("post_lahc_k", int),
     "--init-sweeps": ("init_sweeps", int),
     "--rooms-mode": ("rooms_mode", str),
     "--checkpoint": ("checkpoint", str),
@@ -374,6 +381,9 @@ def parse_args(argv) -> RunConfig:
         # beyond this the allocation fails as an opaque XLA OOM
         raise SystemExit("--post-lahc history length is implausibly "
                          "large (max 1000000)")
+    if not 1 <= cfg.post_lahc_k <= 4096:
+        raise SystemExit("--post-lahc-k must be in [1, 4096] "
+                         "(candidates per walker per step)")
     if (cfg.post_pop_size is not None and "pop_size" in seen
             and cfg.post_pop_size > cfg.pop_size):
         # only checkable at parse time when the user pinned BOTH sides;

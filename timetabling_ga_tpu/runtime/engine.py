@@ -220,22 +220,24 @@ def cached_kick_runner(mesh, gacfg: ga.GAConfig, sig, n_islands: int):
 _LAHC_SPS_CACHE: dict = {}
 
 
-def _lahc_key(mesh, gacfg: ga.GAConfig, hist_len: int, fingerprint):
+def _lahc_key(mesh, gacfg: ga.GAConfig, hist_len: int, k_cands: int,
+              fingerprint):
     return ("lahc", _mesh_key(mesh), gacfg.pop_size, gacfg.p1, gacfg.p2,
-            gacfg.p3, hist_len, fingerprint)
+            gacfg.p3, hist_len, k_cands, fingerprint)
 
 
-def cached_lahc_runners(mesh, gacfg: ga.GAConfig, hist_len: int, sig,
-                        n_islands: int):
+def cached_lahc_runners(mesh, gacfg: ga.GAConfig, hist_len: int,
+                        k_cands: int, sig, n_islands: int):
     """(init, run, finalize) LAHC endgame programs
     (islands.make_lahc_runners). The traced programs depend only on
-    (pop_size, p1/p2/p3, hist_len) of `gacfg` — built from the POST
-    config, whose pop_size may be the shrunk one."""
+    (pop_size, p1/p2/p3, hist_len, k_cands) of the POST config, whose
+    pop_size may be the shrunk one."""
     k = ("lahc", _mesh_key(mesh), gacfg.pop_size, gacfg.p1, gacfg.p2,
-         gacfg.p3, hist_len, sig, n_islands)
+         gacfg.p3, hist_len, k_cands, sig, n_islands)
     r = _RUNNER_CACHE.get(k)
     if r is None:
-        r = islands.make_lahc_runners(mesh, gacfg, hist_len, n_islands)
+        r = islands.make_lahc_runners(mesh, gacfg, hist_len, k_cands,
+                                      n_islands)
         _RUNNER_CACHE[k] = r
     return r
 
@@ -519,18 +521,24 @@ def precompile(cfg: RunConfig) -> None:
     post_ga = gacfg_post if cfg.post_lahc <= 0 else None
     if cfg.post_lahc > 0 and gacfg_post is not None:
         init_r, run_r, fin_r = cached_lahc_runners(
-            mesh, gacfg_post, cfg.post_lahc, sig, n_islands)
-        lkey = _lahc_key(mesh, gacfg_post, cfg.post_lahc, fingerprint)
+            mesh, gacfg_post, cfg.post_lahc, cfg.post_lahc_k, sig,
+            n_islands)
+        lkey = _lahc_key(mesh, gacfg_post, cfg.post_lahc,
+                         cfg.post_lahc_k, fingerprint)
         ls0 = init_r(pa, state_for[gacfg_post])
-        jax.block_until_ready(ls0)
-        ls1, _ = run_r(pa, key, ls0, 64)       # compile
-        jax.block_until_ready(ls1)
+        ls1, stats0 = run_r(pa, key, ls0, 64)       # compile
+        # fences here MUST be data fetches, not block_until_ready: on
+        # the tunneled device block_until_ready can acknowledge before
+        # the computation completes (BASELINE.md round-5 fence audit),
+        # and a near-zero probe timing would size the first endgame
+        # chunk ~100x past the wall-clock budget
+        _fetch(stats0)
         if lkey not in _LAHC_SPS_CACHE:
             t0 = time.monotonic()
             ls2, stats = run_r(pa, jax.random.key(1), ls0, 256)
-            jax.block_until_ready(stats)
+            _fetch(stats)
             _LAHC_SPS_CACHE[lkey] = (time.monotonic() - t0) / 256
-        jax.block_until_ready(fin_r(ls1))
+        _fetch(fin_r(ls1).penalty)
     # polish runners for BOTH phase configs: the init polish uses the
     # repair config's, the budget-tail polish (see _run_tries) uses the
     # ACTIVE phase's — and neither may compile inside a timed budget
@@ -777,8 +785,10 @@ def _lahc_loop(out, cfg, pa, mesh, state, base_key, t_try, reserve,
     draining, not a fixed point (the reference's phase-2 analogue is
     running its scv walk until the clock, Solution.cpp:499/619-768)."""
     init_r, run_r, fin_r = cached_lahc_runners(
-        mesh, gacfg_post, cfg.post_lahc, sig, n_islands)
-    lkey = _lahc_key(mesh, gacfg_post, cfg.post_lahc, fingerprint)
+        mesh, gacfg_post, cfg.post_lahc, cfg.post_lahc_k, sig,
+        n_islands)
+    lkey = _lahc_key(mesh, gacfg_post, cfg.post_lahc, cfg.post_lahc_k,
+                     fingerprint)
     lstate = init_r(pa, state)
     sec_per_step = _LAHC_SPS_CACHE.get(lkey)
     # no cached estimate means precompile never probed this program, so

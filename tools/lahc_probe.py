@@ -1,0 +1,58 @@
+"""LAHC endgame tuning grid: quality-at-budget on one instance for a
+grid of (post_lahc history length, walker count) configs vs the shipped
+GA endgame, via the race harness's exact warm/timed flow.
+
+Usage: python tools/lahc_probe.py <instance> <budget> [seed [grid]]
+  grid = comma-separated entries "Lh:walkers[:K]" (0:0 = GA endgame
+  baseline; walkers 0 = keep the tuned post_pop_size; K = candidates
+  per walker per step, default the shipped post_lahc_k)
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.quality_race import make_instances, run_tpu, warm_tpu  # noqa: E402
+
+
+def main():
+    name = sys.argv[1] if len(sys.argv) > 1 else "comp01s"
+    budget = float(sys.argv[2]) if len(sys.argv) > 2 else 60.0
+    seed = int(sys.argv[3]) if len(sys.argv) > 3 else 42
+    grid_s = (sys.argv[4] if len(sys.argv) > 4
+              else "0:0,5000:0,5000:16,20000:16,1000:16")
+    grid = []
+    for ent in grid_s.split(","):
+        parts = ent.split(":")
+        lh, w = int(parts[0]), int(parts[1])
+        tune = {}
+        if lh > 0:
+            tune["post_lahc"] = lh
+        if w > 0:
+            tune["post_pop"] = w
+        if len(parts) > 2:
+            tune["post_lahc_k"] = int(parts[2])
+        grid.append(tune)
+
+    from timetabling_ga_tpu.problem import dump_tim
+    [(_name, problem)] = make_instances({name})
+    with tempfile.NamedTemporaryFile("w", suffix=".tim",
+                                     delete=False) as fh:
+        fh.write(dump_tim(problem))
+        path = fh.name
+    for tune in grid:
+        warm_tpu(path, budget, seed, tune, problem.n_events)
+        r = run_tpu(path, budget, seed, tune, problem.n_events)
+        r["post_lahc"] = tune.get("post_lahc", 0)
+        r["post_pop"] = tune.get("post_pop")
+        r["post_lahc_k"] = tune.get("post_lahc_k")
+        print(json.dumps({"instance": name, "seed": seed, **r}),
+              flush=True)
+    os.unlink(path)
+
+
+if __name__ == "__main__":
+    main()

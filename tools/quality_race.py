@@ -147,6 +147,9 @@ _TUNE_FIELDS = {"pop": "pop_size", "sweeps": "ls_sweeps",
                 "post_swap_block": "post_swap_block",
                 "post_hot_k": "post_hot_k",
                 "post_sideways": "post_sideways",
+                "post_lahc": "post_lahc",
+                "post_lahc_k": "post_lahc_k",
+                "post_pop": "post_pop_size",
                 "epochs_per_dispatch": "epochs_per_dispatch",
                 "tpu_islands": "islands",
                 "kick_stall": "kick_stall",
@@ -237,6 +240,9 @@ def main():
         "post_swap_block": opt("--post-swap-block", None, int),
         "post_hot_k": opt("--post-hot-k", None, int),
         "post_sideways": opt("--post-sideways", None, float),
+        "post_lahc": opt("--post-lahc", None, int),
+        "post_lahc_k": opt("--post-lahc-k", None, int),
+        "post_pop": opt("--post-pop", None, int),
         "epochs_per_dispatch": opt("--epochs-per-dispatch", None, int),
         "tpu_islands": opt("--tpu-islands", None, int),
         "kick_stall": opt("--kick-stall", None, int),
