@@ -42,6 +42,22 @@ POP = 4096
 ITERS = 100
 
 
+
+def _fence(out):
+    """Completion fence that can be trusted on the tunneled device:
+    fetch the smallest array leaf of the output pytree.
+    jax.block_until_ready can acknowledge BEFORE the computation
+    completes here (BASELINE.md round-5 fence audit: a 100k-step chain
+    "finished" in 0.000 s by block_until_ready vs 51.2 s by an actual
+    fetch, and two tuned-generation measures read 0 ms/gen in the same
+    session); an XLA computation's output buffers only materialize when
+    the whole dispatch has executed, so fetching any one of them is a
+    real fence while transferring almost nothing."""
+    import jax
+    leaves = [x for x in jax.tree.leaves(out) if hasattr(x, "size")]
+    jax.device_get(min(leaves, key=lambda a: a.size))
+    return out
+
 def _instance():
     from timetabling_ga_tpu.problem import random_instance
     return random_instance(1234, n_events=N_EVENTS, n_rooms=N_ROOMS,
@@ -57,10 +73,45 @@ def _small_instance():
                            n_students=80, attend_prob=0.05)
 
 
+
+def _make_eval_chain(pa, n_slots, pop, iters):
+    """THE protocol-critical dependent-evaluation chain, shared by the
+    headline and the scale row so a protocol fix cannot apply to one
+    and silently miss the other (round-5 audit: the `+ 1` that forbids
+    per-individual fixed points had to land in both). The final
+    iteration's penalty is carried OUT of the scan so the fence can
+    fetch a tiny leaf instead of the (pop, E) slots tensor; a post-scan
+    batch_penalty would be cheaper still but recompiles the whole loop
+    ~9x slower (BASELINE.md fence audit)."""
+    import jax
+    import jax.numpy as jnp
+    from timetabling_ga_tpu.ops import fitness
+
+    @jax.jit
+    def chain(s, r):
+        def step(carry, _):
+            s, r, _ = carry
+            pen, _, _ = fitness.batch_penalty(pa, s, r)
+            s = (s + pen[:, None] + 1) % n_slots
+            return (s, r, pen), None
+        (s, r, pen), _ = jax.lax.scan(
+            step, (s, r, jnp.zeros((pop,), jnp.int32)), None,
+            length=iters)
+        return s, pen
+    return chain
+
+
 def measure_tpu_evals(problem) -> float:
-    """Dependent-chain batched evaluation on the device (see BASELINE.md
-    methodology: identical dispatches get deduplicated by the tunnel, so
-    every iteration feeds on the previous output)."""
+    """Dependent-chain batched evaluation on the device, SLOPE-measured
+    (see BASELINE.md methodology): identical dispatches get deduplicated
+    by the tunnel, so every iteration feeds on the previous output; and
+    a single-point timing over-counts the fixed dispatch + fetch-fence
+    cost (~0.7 s — 4x inflation at 100 iterations), so the rate is the
+    slope between a short and a long chain, which cancels every fixed
+    term and is the steady-state throughput a long production dispatch
+    actually sees. The +1 in the mix forbids per-individual fixed
+    points (round-5 audit: the original `s + pen` mix absorbed into
+    fixed points, letting the tunnel dedupe long chains)."""
     import jax
     import numpy as np
     from timetabling_ga_tpu.ops import fitness
@@ -73,25 +124,34 @@ def measure_tpu_evals(problem) -> float:
     rooms = jax.device_put(rng.integers(0, N_ROOMS, size=(POP, N_EVENTS),
                                         dtype=np.int32))
 
-    @jax.jit
-    def chain(s, r):
-        def step(carry, _):
-            s, r = carry
-            pen, _, _ = fitness.batch_penalty(pa, s, r)
-            s = (s + pen[:, None]) % problem.n_slots
-            return (s, r), None
-        (s, r), _ = jax.lax.scan(step, (s, r), None, length=ITERS)
-        return s
+    def make_chain(iters):
+        return _make_eval_chain(pa, problem.n_slots, POP, iters)
 
-    warm = chain(slots, rooms)
-    jax.block_until_ready(warm)
-    t0 = time.perf_counter()
-    out = chain(warm, rooms)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-    rate = POP * ITERS / dt
-    print(f"# tpu evals: {rate:,.0f}/s ({dt / ITERS * 1e3:.2f} ms/batch "
-          f"of {POP})", file=sys.stderr)
+    # Slope lever arm must dwarf the fetch-cost run variance (~+-0.3 s
+    # on this tunnel — a 300-iteration lever measured 11M evals/s pure
+    # noise in the round-5 audit), and the result must clear a physics
+    # check: 27.6 MFLOP/eval means anything above ~5M evals/s would
+    # exceed the chip's bf16 peak — report the conservative long-chain
+    # single-point instead if the slope fails it.
+    short, long_ = ITERS, 16 * ITERS
+    times = {}
+    for iters in (short, long_):
+        chain = make_chain(iters)
+        warm, _pen = chain(slots, rooms)
+        _fence(_pen)
+        t0 = time.perf_counter()
+        _fence(chain(warm, rooms)[1])
+        times[iters] = time.perf_counter() - t0
+    dt = times[long_] - times[short]
+    rate = POP * (long_ - short) / dt if dt > 0 else float("inf")
+    kind = "slope"
+    if rate > 5e6:
+        rate = POP * long_ / times[long_]
+        kind = "single-point(long) — slope failed the physics check"
+    print(f"# tpu evals: {rate:,.0f}/s "
+          f"({POP / rate * 1e3:.2f} ms/batch of {POP}, {kind} over "
+          f"{short}/{long_} iters = {times[short]:.2f}s/"
+          f"{times[long_]:.2f}s)", file=sys.stderr)
     return rate
 
 
@@ -146,15 +206,15 @@ def measure_generation(problem, rooms_mode: str) -> dict:
     cfg = ga.GAConfig(pop_size=pop, ls_steps=25, ls_candidates=8,
                       rooms_mode=rooms_mode)
     state = ga.init_population(pa, jax.random.key(0), pop)
-    jax.block_until_ready(state)
+    _fence(state)
 
     run = jax.jit(lambda k, s: ga.run(pa, k, s, cfg, gens)[0],
                   static_argnums=())
     warm = run(jax.random.key(1), state)
-    jax.block_until_ready(warm)
+    _fence(warm)
     t0 = time.perf_counter()
     out = run(jax.random.key(2), warm)
-    jax.block_until_ready(out)
+    _fence(out)
     dt = time.perf_counter() - t0
     gps = gens / dt
     # candidate evaluations per generation: P children + P*K*rounds LS
@@ -184,14 +244,14 @@ def measure_generation_sweep(problem, pop: int) -> dict:
     cfg = ga.GAConfig(pop_size=pop, ls_mode="sweep", ls_sweeps=1,
                       ls_swap_block=8)
     state = ga.init_population(pa, jax.random.key(0), pop)
-    jax.block_until_ready(state)
+    _fence(state)
 
     run = jax.jit(lambda k, s: ga.run(pa, k, s, cfg, gens)[0])
     warm = run(jax.random.key(1), state)
-    jax.block_until_ready(warm)
+    _fence(warm)
     t0 = time.perf_counter()
     out = run(jax.random.key(2), warm)
-    jax.block_until_ready(out)
+    _fence(out)
     dt = time.perf_counter() - t0
     T = problem.n_slots
     evals_per_gen = pop * problem.n_events * (T + cfg.ls_swap_block)
@@ -227,7 +287,7 @@ def measure_generation_sweep_tuned(problem, label: str) -> dict:
            "hot_k": gacfg.ls_hot_k, "converge": gacfg.ls_converge,
            "sideways": gacfg.ls_sideways}
     state = ga.init_population(pa, jax.random.key(0), gacfg.pop_size)
-    jax.block_until_ready(state)
+    _fence(state)
     # post-phase generations are deep (measured ~8 s/gen at comp05s
     # scale): keep the fused measurement dispatch under the device's
     # long-kernel watchdog (engine.DISPATCH_CAP_S rationale)
@@ -241,9 +301,9 @@ def measure_generation_sweep_tuned(problem, label: str) -> dict:
         run = jax.jit(lambda k, s, g=g, gens=gens: ga.run(
             pa, k, s, g, gens)[0])
         warm = run(jax.random.key(1), st)
-        jax.block_until_ready(warm)
+        _fence(warm)
         t0 = time.perf_counter()
-        jax.block_until_ready(run(jax.random.key(2), warm))
+        _fence(run(jax.random.key(2), warm))
         dt = time.perf_counter() - t0
         out[name] = round(dt / gens * 1e3, 1)
         print(f"# tuned sweep generation [{label}] {name} "
@@ -276,16 +336,16 @@ def measure_ls_shootout_feasible(problem) -> dict:
     slots, rooms = sweep.jit_sweep_local_search(
         pa, jax.random.key(7), slots, rooms, 60, 8, converge=True,
         sideways=0.25, hot_k=48)
-    jax.block_until_ready((slots, rooms))
+    _fence((slots, rooms))
     pen0, hcv0, _ = fitness.batch_penalty(pa, slots, rooms)
     feas_frac = float((np.asarray(hcv0) == 0).mean())
 
     def timed(fn, *args, **kw):
         out = fn(pa, jax.random.key(8), slots, rooms, *args, **kw)
-        jax.block_until_ready(out)      # warm/compile
+        _fence(out)      # warm/compile
         t0 = time.perf_counter()
         out = fn(pa, jax.random.key(9), slots, rooms, *args, **kw)
-        jax.block_until_ready(out)
+        _fence(out)
         dt = time.perf_counter() - t0
         pen, _, _ = fitness.batch_penalty(pa, *out)
         return float(np.asarray(pen).mean()), dt
@@ -333,9 +393,9 @@ def measure_generation_nsga(problem) -> dict:
                           ls_swap_block=8, multi_objective=mo)
         state = ga.init_population(pa, jax.random.key(0), pop)
         run = jax.jit(lambda k, s, cfg=cfg: ga.run(pa, k, s, cfg, gens)[0])
-        jax.block_until_ready(run(jax.random.key(1), state))
+        _fence(run(jax.random.key(1), state))
         t0 = time.perf_counter()
-        jax.block_until_ready(run(jax.random.key(2), state))
+        _fence(run(jax.random.key(2), state))
         dt = time.perf_counter() - t0
         out[label] = round(dt / gens * 1e3, 1)
     out["nsga2_overhead_pct"] = round(
@@ -457,29 +517,26 @@ def measure_scale() -> dict:
     slots = jax.device_put(rng.integers(0, problem.n_slots, size=(P, E),
                                         dtype=np.int32))
     rooms = jax.device_put(rng.integers(0, R, size=(P, E), dtype=np.int32))
-    iters = 5
-
-    @jax.jit
-    def chain(s, r):
-        def step(carry, _):
-            s, r = carry
-            pen, _, _ = fitness.batch_penalty(pa, s, r)
-            s = (s + pen[:, None]) % problem.n_slots
-            return (s, r), None
-        (s, r), _ = jax.lax.scan(step, (s, r), None, length=iters)
-        return s
-
-    warm = chain(slots, rooms)
-    jax.block_until_ready(warm)
-    t0 = time.perf_counter()
-    out = chain(warm, rooms)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-    rate = P * iters / dt
+    # same slope protocol as the headline (shared chain, fixed costs
+    # cancel); shorter lever than the headline's because each length is
+    # its own multi-ten-second compile at this size
+    short, long_ = 4, 20
+    times = {}
+    for iters in (short, long_):
+        chain = _make_eval_chain(pa, problem.n_slots, P, iters)
+        warm, _pen = chain(slots, rooms)
+        _fence(_pen)
+        t0 = time.perf_counter()
+        _fence(chain(warm, rooms)[1])
+        times[iters] = time.perf_counter() - t0
+    dt = times[long_] - times[short]
+    rate = P * (long_ - short) / dt if dt > 0 else 0.0
     print(f"# scale E={E} R={R} pop={P}: {rate:,.0f} evals/s "
-          f"({dt / iters * 1e3:.1f} ms/batch), no OOM", file=sys.stderr)
+          f"({P / rate * 1e3:.1f} ms/batch, slope {short}/{long_} "
+          f"iters = {times[short]:.2f}s/{times[long_]:.2f}s), no OOM",
+          file=sys.stderr)
     return {"E": E, "R": R, "pop": P, "evals_per_sec": round(rate, 1),
-            "ms_per_batch": round(dt / iters * 1e3, 2)}
+            "ms_per_batch": round(P / rate * 1e3, 2) if rate else None}
 
 
 def measure_ls_shootout(problem) -> dict:
@@ -497,14 +554,14 @@ def measure_ls_shootout(problem) -> dict:
     slots = jax.random.randint(jax.random.key(3), (P, problem.n_events),
                                0, problem.n_slots, dtype=jnp.int32)
     rooms = batch_assign_rooms(pa, slots)
-    jax.block_until_ready((slots, rooms))
+    _fence((slots, rooms))
 
     def timed(fn, *args, **kw):
         out = fn(pa, jax.random.key(4), slots, rooms, *args, **kw)
-        jax.block_until_ready(out)      # warm/compile
+        _fence(out)      # warm/compile
         t0 = time.perf_counter()
         out = fn(pa, jax.random.key(5), slots, rooms, *args, **kw)
-        jax.block_until_ready(out)
+        _fence(out)
         dt = time.perf_counter() - t0
         pen, _, _ = fitness.batch_penalty(pa, *out)
         return float(np.asarray(pen).mean()), dt

@@ -8,6 +8,7 @@ random starts.
 
 import numpy as np
 import jax
+import pytest
 
 from timetabling_ga_tpu.ops import fitness, ga, local_search
 from timetabling_ga_tpu.problem import random_instance
@@ -50,6 +51,7 @@ def test_makes_progress(medium_problem):
     assert np.asarray(pen1).mean() < pen0
 
 
+@pytest.mark.slow
 def test_memetic_generation_beats_plain(request):
     """A memetic generation (GA + LS) must reach feasibility faster than
     plain GA on a small instance — the whole point of the memetic design

@@ -211,6 +211,7 @@ def test_engine_exact_generation_budget(tim_file):
     assert gens == 25
 
 
+@pytest.mark.slow
 def test_engine_trace_phases(tim_file):
     buf = io.StringIO()
     cfg = RunConfig(input=tim_file, seed=2, pop_size=8, islands=2,
@@ -243,6 +244,30 @@ def test_engine_multi_epoch_dispatch(tim_file):
     assert len(dispatches) == 1 and dispatches[0]["gens"] == 40
     kinds = [next(iter(x)) for x in lines]
     assert kinds.count("solution") == 2 and kinds.count("runEntry") == 2
+
+
+@pytest.mark.slow
+def test_engine_trace_profile(tim_file, tmp_path):
+    """--trace-profile captures ONE jax.profiler trace of a warm mid-run
+    dispatch (SURVEY section 5 tracing; the reference's only trace hook
+    is the disabled MPE flag, Makefile:3)."""
+    from timetabling_ga_tpu.runtime import engine as eng
+    prof_dir = str(tmp_path / "prof")
+    cfg = RunConfig(input=tim_file, seed=3, pop_size=4, islands=2,
+                    generations=20, migration_period=5,
+                    time_limit=30.0, auto_tune=False,
+                    trace_profile=prof_dir)
+    eng.precompile(cfg)               # warm: the capture needs a warm
+    buf = io.StringIO()               # dispatch to profile the program,
+    eng.run(cfg, out=buf)             # not its compile
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    profs = [x["phase"] for x in lines
+             if "phase" in x and x["phase"]["name"] == "profile"]
+    assert len(profs) == 1 and profs[0]["dir"] == prof_dir
+    # the capture actually wrote a trace artifact
+    found = [os.path.join(r, f) for r, _, fs in os.walk(prof_dir)
+             for f in fs]
+    assert found, "no profiler artifacts written"
 
 
 def test_engine_resume(tim_file, tmp_path):

@@ -125,6 +125,7 @@ def test_sweep_converge_reaches_local_optimum(inst):
     assert np.asarray(pen_c).mean() <= np.asarray(pen_f).mean()
 
 
+@pytest.mark.slow
 def test_sweep_beats_random_candidates_at_equal_depth(inst):
     """At equal SERIAL DEPTH — the TPU-relevant cost model: a sweep step
     evaluates P*(T+B) candidates in one wide fused step, while a K-random
@@ -214,6 +215,7 @@ def test_sideways_never_increases_and_stays_deterministic(small_problem):
     np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
 
 
+@pytest.mark.slow
 def test_sideways_escapes_plateau_that_strict_cannot():
     """A 3-event instance engineered so the strict sweep is stuck on an
     hcv plateau: correlated events in one slot whose every single-event

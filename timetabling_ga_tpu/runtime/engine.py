@@ -22,7 +22,7 @@ Timing semantics (Control/Timer parity):
     timestampable; the interpolation error is bounded by one dispatch).
 
 Observability (--trace, SURVEY section 5): per-phase host timings
-(init / dispatch / fetch / checkpoint) bracketed by block_until_ready are
+(init / dispatch / fetch / checkpoint) bracketed by data-fetch fences are
 emitted as {"phase": ...} JSONL records — an extension record type; the
 reference protocol's three record types are unchanged and remain
 byte-compatible.
@@ -547,11 +547,14 @@ def precompile(cfg: RunConfig) -> None:
             continue
         g_spg_key = (_mesh_key(mesh), g, fingerprint)
         polish, pwarm = cached_polish_runner(mesh, g, sig, n_islands)
-        jax.block_until_ready(polish(pa, key, state_for[g], 1))
+        # timing fences are data fetches of the stats output, not
+        # block_until_ready, which can early-ack on the tunneled device
+        # (BASELINE.md round-5 fence audit) — a near-zero sec/sweep
+        # would size polish chunks past the budget
+        _fetch(polish(pa, key, state_for[g], 1)[1])
         if not pwarm or g_spg_key not in _SPS_CACHE:
             t0 = time.monotonic()
-            jax.block_until_ready(
-                polish(pa, jax.random.key(1), state_for[g], 1))
+            _fetch(polish(pa, jax.random.key(1), state_for[g], 1)[1])
             sps = time.monotonic() - t0
             prev = _SPS_CACHE.get(g_spg_key)
             _SPS_CACHE[g_spg_key] = (sps if prev is None
@@ -582,12 +585,11 @@ def precompile(cfg: RunConfig) -> None:
         # shape; executing that shape to measure it is the bug)
         dyn, _ = cached_dynamic_runner(mesh, g, cfg.migration_period,
                                        sig, n_islands)
-        jax.block_until_ready(dyn(pa, key, g_state, 1))
+        _fetch(dyn(pa, key, g_state, 1)[1])
         spg_est = _SPG_CACHE.get(g_spg_key)
         if spg_est is None:
             t0 = time.monotonic()
-            jax.block_until_ready(dyn(pa, jax.random.key(1), g_state,
-                                      1))
+            _fetch(dyn(pa, jax.random.key(1), g_state, 1)[1])
             # 1 generation + dispatch/migration overhead: an
             # OVERESTIMATE of sec/gen, used only to gate the static
             # builds below (conservative = never builds a shape the
@@ -602,8 +604,8 @@ def precompile(cfg: RunConfig) -> None:
                 break
             runner, warm = cached_runner(mesh, g, n_ep, gens, sig,
                                          n_islands)
-            st2, _, _ = runner(pa, key, g_state)
-            jax.block_until_ready(st2)
+            st2, tr2, _ = runner(pa, key, g_state)
+            _fetch(tr2)
             if not warm:
                 # the timing call MUST differ from the compile call:
                 # tunneled devices deduplicate byte-identical repeat
@@ -611,8 +613,8 @@ def precompile(cfg: RunConfig) -> None:
                 # made this measure ~2e-5 s/gen and let a 146 s dispatch
                 # through a 60 s budget — so re-run with a different key
                 t0 = time.monotonic()
-                st2, _, _ = runner(pa, jax.random.key(1), g_state)
-                jax.block_until_ready(st2)
+                st2, tr2, _ = runner(pa, jax.random.key(1), g_state)
+                _fetch(tr2)
                 spg = (time.monotonic() - t0) / (n_ep * gens)
                 prev = _SPG_CACHE.get(g_spg_key)
                 _SPG_CACHE[g_spg_key] = (spg if prev is None
@@ -831,7 +833,7 @@ def _lahc_loop(out, cfg, pa, mesh, state, base_key, t_try, reserve,
                                 time.monotonic() - t_try)
         it += 1
     state = fin_r(lstate)
-    jax.block_until_ready(state)
+    _fetch(state.penalty)      # real fence (block_until_ready early-acks)
     return state
 
 
@@ -903,7 +905,10 @@ def _run_tries(cfg: RunConfig, out) -> int:
             t = time.monotonic()
             state = cached_init(mesh, cfg.pop_size, gacfg_init,
                                 n_islands)(pa, k_init)
-            jax.block_until_ready(state)
+            _fetch(state.penalty)   # real fence: the init phase record
+            #                         must not bleed into the polish
+            #                         bracket (block_until_ready
+            #                         early-acks on the tunnel)
             _phase(out, cfg.trace, "init", trial, time.monotonic() - t)
             # Initial-population LS polish (ga.cpp:429-434), CHUNKED so
             # the wall clock is checked between dispatches — one fused
@@ -1184,7 +1189,8 @@ def _run_tries(cfg: RunConfig, out) -> int:
                     key, k_kick = jax.random.split(key)
                     t = time.monotonic()
                     state = kicker(pa, k_kick, state, n_moves)
-                    jax.block_until_ready(state)
+                    _fetch(state.penalty)   # real fence for the phase
+                    #                         record (see init above)
                     # context key is at_gen, NOT gens: `gens` on a
                     # phase record means generations EXECUTED by
                     # that phase (budget accounting sums it)
