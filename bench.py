@@ -101,6 +101,27 @@ def _make_eval_chain(pa, n_slots, pop, iters):
     return chain
 
 
+
+def _slope_measure(pa, n_slots, pop, slots, rooms, short, long_):
+    """Shared slope-timing protocol around _make_eval_chain: time a
+    short and a long dependent chain (fresh warm per length, fence on
+    the penalty leaf) and return (rate, times). Degenerate levers
+    (a tunnel stall on either leg making dt <= 0) return rate 0.0 —
+    callers must handle it (the headline falls back to the long-chain
+    single-point; the scale row reports the fallback the same way)."""
+    times = {}
+    for iters in (short, long_):
+        chain = _make_eval_chain(pa, n_slots, pop, iters)
+        warm, _pen = chain(slots, rooms)
+        _fence(_pen)
+        t0 = time.perf_counter()
+        _fence(chain(warm, rooms)[1])
+        times[iters] = time.perf_counter() - t0
+    dt = times[long_] - times[short]
+    rate = pop * (long_ - short) / dt if dt > 0 else 0.0
+    return rate, times
+
+
 def measure_tpu_evals(problem) -> float:
     """Dependent-chain batched evaluation on the device, SLOPE-measured
     (see BASELINE.md methodology): identical dispatches get deduplicated
@@ -124,9 +145,6 @@ def measure_tpu_evals(problem) -> float:
     rooms = jax.device_put(rng.integers(0, N_ROOMS, size=(POP, N_EVENTS),
                                         dtype=np.int32))
 
-    def make_chain(iters):
-        return _make_eval_chain(pa, problem.n_slots, POP, iters)
-
     # Slope lever arm must dwarf the fetch-cost run variance (~+-0.3 s
     # on this tunnel — a 300-iteration lever measured 11M evals/s pure
     # noise in the round-5 audit), and the result must clear a physics
@@ -134,20 +152,15 @@ def measure_tpu_evals(problem) -> float:
     # exceed the chip's bf16 peak — report the conservative long-chain
     # single-point instead if the slope fails it.
     short, long_ = ITERS, 16 * ITERS
-    times = {}
-    for iters in (short, long_):
-        chain = make_chain(iters)
-        warm, _pen = chain(slots, rooms)
-        _fence(_pen)
-        t0 = time.perf_counter()
-        _fence(chain(warm, rooms)[1])
-        times[iters] = time.perf_counter() - t0
-    dt = times[long_] - times[short]
-    rate = POP * (long_ - short) / dt if dt > 0 else float("inf")
+    rate, times = _slope_measure(pa, problem.n_slots, POP, slots, rooms,
+                                 short, long_)
     kind = "slope"
-    if rate > 5e6:
+    if rate > 5e6 or rate <= 0:
+        # physics check (27.6 MFLOP/eval: >5M evals/s would exceed the
+        # bf16 peak) or a degenerate lever (tunnel stall on one leg):
+        # fall back to the conservative long-chain single-point
         rate = POP * long_ / times[long_]
-        kind = "single-point(long) — slope failed the physics check"
+        kind = "single-point(long) — slope failed the sanity checks"
     print(f"# tpu evals: {rate:,.0f}/s "
           f"({POP / rate * 1e3:.2f} ms/batch of {POP}, {kind} over "
           f"{short}/{long_} iters = {times[short]:.2f}s/"
@@ -517,26 +530,24 @@ def measure_scale() -> dict:
     slots = jax.device_put(rng.integers(0, problem.n_slots, size=(P, E),
                                         dtype=np.int32))
     rooms = jax.device_put(rng.integers(0, R, size=(P, E), dtype=np.int32))
-    # same slope protocol as the headline (shared chain, fixed costs
-    # cancel); shorter lever than the headline's because each length is
-    # its own multi-ten-second compile at this size
+    # same slope protocol as the headline (shared chain + shared
+    # timing loop, fixed costs cancel); shorter lever than the
+    # headline's because each length is its own multi-ten-second
+    # compile at this size. A degenerate lever (tunnel stall) falls
+    # back to the long-chain single-point, like the headline.
     short, long_ = 4, 20
-    times = {}
-    for iters in (short, long_):
-        chain = _make_eval_chain(pa, problem.n_slots, P, iters)
-        warm, _pen = chain(slots, rooms)
-        _fence(_pen)
-        t0 = time.perf_counter()
-        _fence(chain(warm, rooms)[1])
-        times[iters] = time.perf_counter() - t0
-    dt = times[long_] - times[short]
-    rate = P * (long_ - short) / dt if dt > 0 else 0.0
+    rate, times = _slope_measure(pa, problem.n_slots, P, slots, rooms,
+                                 short, long_)
+    kind = "slope"
+    if rate <= 0:
+        rate = P * long_ / times[long_]
+        kind = "single-point(long) — degenerate slope lever"
     print(f"# scale E={E} R={R} pop={P}: {rate:,.0f} evals/s "
-          f"({P / rate * 1e3:.1f} ms/batch, slope {short}/{long_} "
+          f"({P / rate * 1e3:.1f} ms/batch, {kind} {short}/{long_} "
           f"iters = {times[short]:.2f}s/{times[long_]:.2f}s), no OOM",
           file=sys.stderr)
     return {"E": E, "R": R, "pop": P, "evals_per_sec": round(rate, 1),
-            "ms_per_batch": round(P / rate * 1e3, 2) if rate else None}
+            "ms_per_batch": round(P / rate * 1e3, 2)}
 
 
 def measure_ls_shootout(problem) -> dict:

@@ -32,6 +32,7 @@ from timetabling_ga_tpu.ops.rooms import (
     assign_rooms, batch_assign_rooms, batch_parallel_assign_rooms)
 from timetabling_ga_tpu.ops.local_search import batch_local_search
 from timetabling_ga_tpu.ops.sweep import sweep_local_search
+from timetabling_ga_tpu.ops.lahc import init_lahc, lahc_steps
 from timetabling_ga_tpu.parallel import (
     make_mesh, init_island_population, make_island_runner)
 from timetabling_ga_tpu.runtime import RunConfig, parse_args, run
