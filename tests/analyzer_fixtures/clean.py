@@ -1,0 +1,56 @@
+"""Negative fixture: idiomatic JAX code that must produce ZERO findings
+under every rule — the analyzer's false-positive regression guard.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_RUNNER_CACHE: dict = {}
+
+
+@jax.jit
+def traced_ok(x, y):
+    # data-dependent selection the traced way
+    return jnp.where(x > 0, y, -y)
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def static_branch_ok(x, depth):
+    if depth > 2:            # static param: trace-time branch is fine
+        x = x * 2
+    n = x.shape[0]
+    if n > 16:               # shape-derived: static under tracing
+        x = x[:16]
+    return x
+
+
+def make_step(scale):
+    return jax.jit(lambda s: s * scale)
+
+
+def cached_step(scale):
+    k = (scale,)
+    r = _RUNNER_CACHE.get(k)
+    if r is None:
+        r = make_step(scale)
+        _RUNNER_CACHE[k] = r
+    return r
+
+
+def evolve(key, state, n):
+    def body(carry, k):
+        noise = jax.random.normal(k, carry.shape)
+        return carry + noise, noise.sum()
+
+    keys = jax.random.split(key, n)
+    state, trace = lax.scan(body, state, keys)
+    return state, trace
+
+
+def per_island(key, n_islands, state):
+    keys = jax.vmap(
+        lambda i: jax.random.fold_in(key, i))(jnp.arange(n_islands))
+    return jax.vmap(lambda k, s: s + jax.random.normal(k, s.shape))(
+        keys, state)

@@ -1,0 +1,20 @@
+"""TT501 fixture: JAX imports outside the pinned compatibility table.
+
+Not imported or executed — parsed by tests/test_analysis.py. This is
+the exact breakage class that killed the seed suite: `from jax import
+shard_map` does not exist on JAX 0.4.37.
+"""
+from jax import shard_map            # EXPECT TT501 (not in compat table)
+import jax.interpreters.xla          # EXPECT TT501 (undeclared module)
+import jax                           # OK: declared
+import jax.numpy as jnp              # OK: declared
+from jax import lax                  # OK: declared
+
+try:
+    from jax import tree_util_flatten_with_keys_v2   # OK: guarded
+except ImportError:
+    tree_util_flatten_with_keys_v2 = None
+
+from jax import pure_callback  # tt-analyze: ignore[TT501] (suppressed)
+
+_ = shard_map, jax, jnp, lax, pure_callback

@@ -1,0 +1,31 @@
+"""TT302 fixture: collective-bearing random ops in shard_map-executed
+code.
+
+Not imported or executed — parsed by tests/test_analysis.py (which
+registers this directory as a sharded module). These are the exact
+calls whose shuffle-by-sort lowering the SPMD partitioner replicates
+with cross-device all-reduces — the round-1 CPU deadlock and merged
+island RNG streams.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def shuffled_pivots(key, E):
+    return jax.random.permutation(key, E)          # EXPECT TT302
+
+
+def sample_events(key, E):
+    return jax.random.choice(key, E, shape=(3,),   # EXPECT TT302
+                             replace=False)
+
+
+def safe_equivalents(key, E):
+    k_a, k_b = jax.random.split(key)
+    # affine permutation: elementwise, partitions locally
+    b = jax.random.randint(k_a, (), 0, E)
+    perm = (jnp.arange(E) + b) % E
+    # ordered distinct triple via top_k of iid uniforms
+    evs = lax.top_k(jax.random.uniform(k_b, (E,)), 3)[1]
+    return perm, evs

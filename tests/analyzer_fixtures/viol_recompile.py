@@ -1,0 +1,50 @@
+"""TT201/TT202 fixture: recompile hazards.
+
+Not imported or executed — parsed by tests/test_analysis.py.
+"""
+import jax
+import numpy as np
+
+_PROGRAM_CACHE: dict = {}
+
+
+def heavy(x, cfg):
+    return x * cfg[0]
+
+
+jitted = jax.jit(heavy, static_argnums=(1,))
+
+
+def call_sites(x):
+    out = jitted(x, [2, 3])            # EXPECT TT201 (unhashable list)
+    out = out + jitted(x, np.array([1]))   # EXPECT TT201 (np array)
+    for step in range(10):
+        out = out + jitted(x, step)    # EXPECT TT201 (loop variable)
+    return out
+
+
+def make_runner(mesh, cfg, n_epochs, migration):
+    def run(x):
+        return x * n_epochs * migration
+    return run
+
+
+def cached_runner(mesh, cfg, n_epochs, migration):
+    # the key omits `migration`, which the factory bakes into the
+    # compiled program: two migration cadences collide on one entry
+    k = (mesh, cfg, n_epochs)
+    r = _PROGRAM_CACHE.get(k)
+    if r is None:
+        r = make_runner(mesh, cfg, n_epochs, migration)  # EXPECT TT202
+        _PROGRAM_CACHE[k] = r
+    return r
+
+
+def cached_complete(mesh, cfg, n_epochs, migration):
+    # complete key: no finding
+    k = (mesh, cfg, n_epochs, migration)
+    r = _PROGRAM_CACHE.get(k)
+    if r is None:
+        r = make_runner(mesh, cfg, n_epochs, migration)
+        _PROGRAM_CACHE[k] = r
+    return r

@@ -1,0 +1,40 @@
+"""TT401 fixture: PRNG key reuse.
+
+Not imported or executed — parsed by tests/test_analysis.py.
+"""
+import jax
+
+
+def double_consume(key, state):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))     # EXPECT TT401 (second consumer)
+    return a + b + state
+
+
+def fold_collision(key):
+    a = jax.random.normal(jax.random.fold_in(key, 7), (2,))
+    b = jax.random.normal(jax.random.fold_in(key, 7), (2,))  # EXPECT TT401
+    return a + b
+
+
+def disciplined(key):
+    k_a, k_b = jax.random.split(key)
+    a = jax.random.normal(k_a, (4,))
+    b = jax.random.uniform(k_b, (4,))     # OK: fresh subkeys
+    return a + b
+
+
+def branches_are_exclusive(key, flag):
+    if flag:
+        out = jax.random.normal(key, (2,))
+    else:
+        out = jax.random.uniform(key, (2,))  # OK: exclusive branch
+    return out
+
+
+def subkey_array_reuse(key):
+    ks = jax.random.split(key, 4)
+    a = jax.random.normal(ks[0], (2,))
+    b = jax.random.uniform(ks[0], (2,))   # EXPECT TT401 (same element)
+    c = jax.random.uniform(ks[1], (2,))   # OK: distinct element
+    return a + b + c

@@ -1,0 +1,37 @@
+"""TT301 fixture: hidden host-device syncs in dispatch loops.
+
+Not imported or executed — parsed by tests/test_analysis.py. The test
+registers this file as a dispatch module so the rule applies.
+"""
+import jax
+import numpy as np
+
+
+def _fetch(x):
+    return np.asarray(x)   # sanctioned helper: exempt
+
+
+def cached_step(n):
+    return jax.jit(lambda s: s + n)
+
+
+def epoch_loop(state, n_epochs):
+    step = cached_step(3)
+    total = 0.0
+    for _ in range(n_epochs):
+        state = step(state)
+        total += float(state)          # EXPECT TT301 (float() per epoch)
+        best = state.min().item()      # EXPECT TT301 (.item() per epoch)
+        host = np.asarray(state)       # EXPECT TT301 (asarray per epoch)
+        _ = best, host
+    return state, total
+
+
+def fetched_loop(state, n_epochs):
+    step = cached_step(3)
+    bests = []
+    for _ in range(n_epochs):
+        state = step(state)
+        host = _fetch(state)           # OK: the sanctioned fetch helper
+        bests.append(int(host.min()))  # OK: host memory already
+    return state, bests

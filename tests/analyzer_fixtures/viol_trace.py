@@ -1,0 +1,53 @@
+"""TT101 fixture: tracer-unsafe control flow inside jit targets.
+
+Not imported or executed — parsed by tests/test_analysis.py. Expected
+findings are marked with `# EXPECT TTxxx` comments the test reads.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def branch_on_traced(x, y):
+    if x > 0:            # EXPECT TT101
+        return y
+    return -y
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def static_param_is_fine(x, mode):
+    if mode == "fast":   # OK: mode is declared static
+        return x * 2
+    while x.sum() > 0:   # EXPECT TT101
+        x = x - 1
+    return x
+
+
+def scan_body_branch(carry, x):
+    assert carry >= 0    # EXPECT TT101
+    return carry + x, x
+
+
+def run_scan(xs):
+    # shape-derived bounds are static: no finding
+    def body(c, x):
+        n = xs.shape[0]
+        if n > 4:        # OK: shape access is trace-time static
+            return c + x, x
+        return c, x
+    c0 = jnp.zeros(())
+    c1, _ = lax.scan(scan_body_branch, c0, xs)
+    c2, _ = lax.scan(body, c1, xs)
+    return c2
+
+
+def vmapped_loop(v):
+    for item in v:       # EXPECT TT101
+        v = v + item
+    return v
+
+
+batched = jax.vmap(vmapped_loop)

@@ -1,0 +1,174 @@
+"""tt-analyze (timetabling_ga_tpu/analysis) tests.
+
+Every rule family must fire on its seeded-violation fixture at the
+expected file:line (the fixtures carry `# EXPECT TTxxx` markers that
+these tests read, so fixture and assertion cannot drift), the clean
+fixture must produce zero findings, and — the regression that matters
+most — the shipped package itself must be strict-clean.
+
+The analyzer is stdlib-only; no jax/device needed here.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from timetabling_ga_tpu.analysis import run_analysis
+from timetabling_ga_tpu.analysis.config import (
+    ALL_RULES, AnalyzerConfig, load_compat_table, load_config)
+from timetabling_ga_tpu.analysis.core import suppressions
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "analyzer_fixtures")
+
+_EXPECT_RE = re.compile(r"#\s*EXPECT\s+(TT\d{3})")
+
+
+def expected_findings(fixture: str) -> set[tuple[str, int]]:
+    """(rule, line) pairs the fixture's `# EXPECT TTxxx` markers declare."""
+    out = set()
+    with open(os.path.join(FIXTURES, fixture), encoding="utf-8") as f:
+        for lineno, line in enumerate(f, start=1):
+            for rule in _EXPECT_RE.findall(line):
+                out.add((rule, lineno))
+    return out
+
+
+def fixture_config() -> AnalyzerConfig:
+    cfg = load_config(REPO)
+    cfg.root = REPO
+    # the sync/collective rules only audit configured modules; opt the
+    # fixtures in
+    cfg.dispatch_modules = list(cfg.dispatch_modules) + ["viol_sync.py"]
+    cfg.sharded_modules = (list(cfg.sharded_modules)
+                           + ["viol_collective.py"])
+    return cfg
+
+
+def analyze_fixture(fixture: str):
+    path = os.path.join(FIXTURES, fixture)
+    return run_analysis([path], fixture_config())
+
+
+@pytest.mark.parametrize("fixture", [
+    "viol_trace.py",       # TT101 tracer-unsafe control flow
+    "viol_recompile.py",   # TT201/TT202 recompile hazards
+    "viol_sync.py",        # TT301 hidden host syncs
+    "viol_collective.py",  # TT302 collective-bearing random ops
+    "viol_rng.py",         # TT401 RNG key reuse
+    "viol_api.py",         # TT501 pinned API surface
+])
+def test_rule_fires_at_expected_lines(fixture):
+    """Each rule family fires exactly at the marked (rule, line) pairs —
+    no misses, no extras."""
+    expected = expected_findings(fixture)
+    assert expected, f"fixture {fixture} declares no EXPECT markers"
+    got = {(f.rule, f.line) for f in analyze_fixture(fixture)}
+    assert got == expected
+
+
+def test_clean_fixture_has_zero_findings():
+    assert analyze_fixture("clean.py") == []
+
+
+def test_shipped_package_is_strict_clean():
+    """`--strict` over the real package must stay at zero findings; a
+    new violation in ops/runtime/parallel fails here before it fails in
+    CI."""
+    cfg = load_config(REPO)
+    cfg.root = REPO
+    findings = run_analysis(["timetabling_ga_tpu"], cfg)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_compat_table_loads_without_jax():
+    cfg = load_config(REPO)
+    cfg.root = REPO
+    table = load_compat_table(cfg)
+    assert "jax" in table
+    assert "jax.numpy" in table
+    # the seed-breaking symbol must NOT be blessed at the top level
+    assert "shard_map" not in table["jax"]
+
+
+def test_suppression_parsing():
+    src = (
+        "x = 1  # tt-analyze: ignore[TT301]\n"
+        "# tt-analyze: ignore\n"
+        "y = 2\n"
+        "z = 3\n"
+    )
+    supp = suppressions(src)
+    assert supp[1] == {"TT301"}
+    assert supp[2] is None          # bare ignore: all rules
+    assert supp[3] is None          # comment line covers the line below
+    assert 4 not in supp
+
+
+def test_inline_suppression_filters_finding():
+    # viol_api.py line with `pure_callback` carries an inline ignore;
+    # without suppression handling it would be a TT501 finding
+    findings = analyze_fixture("viol_api.py")
+    assert not any("pure_callback" in f.message for f in findings)
+
+
+def test_cli_json_and_exit_codes(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    base = [sys.executable, "-m", "timetabling_ga_tpu.analysis",
+            "--root", REPO]
+
+    # strict over the shipped tree: exit 0
+    r = subprocess.run(base + ["--strict", "timetabling_ga_tpu"],
+                       capture_output=True, text=True, cwd=REPO, env=env,
+                       timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # strict over a violation fixture: exit nonzero, JSON report carries
+    # the findings
+    r = subprocess.run(
+        base + ["--strict", "--json",
+                os.path.join(FIXTURES, "viol_api.py")],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240)
+    assert r.returncode == 1
+    report = json.loads(r.stdout)
+    assert report["count"] == len(report["findings"]) > 0
+    assert all(f["rule"] == "TT501" for f in report["findings"])
+
+    # non-strict is advisory: findings reported, exit 0
+    r = subprocess.run(
+        base + [os.path.join(FIXTURES, "viol_api.py")],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240)
+    assert r.returncode == 0
+    assert "TT501" in r.stdout
+
+
+def test_rules_filter():
+    cfg = fixture_config()
+    cfg.rules = ["TT401"]
+    path = os.path.join(FIXTURES, "viol_api.py")
+    assert run_analysis([path], cfg) == []  # TT501 disabled
+
+
+def test_all_rules_registered():
+    from timetabling_ga_tpu.analysis import _rule_modules
+    assert set(_rule_modules()) == set(ALL_RULES)
+
+
+def test_minimal_toml_parser_on_repo_pyproject():
+    """The no-tomllib/no-tomli fallback must produce the same usable
+    config as the real parsers — in particular regex values must come
+    through with escapes DECODED (a literal '\\\\w' pattern would
+    silently disable TT301's device-producer matching)."""
+    from timetabling_ga_tpu.analysis.config import _parse_toml_minimal
+    with open(os.path.join(REPO, "pyproject.toml"), encoding="utf-8") as f:
+        data = _parse_toml_minimal(f.read())
+    section = data["tool"]["tt-analyze"]
+    assert section["paths"] == ["timetabling_ga_tpu"]
+    assert "TT302" in section["rules"]
+    pats = section["device-producers"]
+    assert any(re.match(p, "cached_runner") for p in pats), pats
+    assert any(re.match(p, "jax.jit") for p in pats), pats

@@ -1,0 +1,126 @@
+"""Compiled-program collective audit.
+
+The island programs may contain EXACTLY the collectives their design
+calls for: `lax.ppermute` migration (collective-permute) and the
+`lax.pmin` global best (one all-reduce at the epoch boundary). Anything
+else is XLA's SPMD partitioner "resolving" an op it cannot keep
+shard-local — the failure mode found in round 1: a traced-index gather
+on the sweep's shuffled pivot array made the partitioner replicate the
+shuffle via masked all-reduces INSIDE the converge while_loop, whose
+trip count is legitimately per-island varying. Consequences: every
+island silently shared one shuffle stream, and when islands' pass
+counts diverged one device exited the loop while the other waited at
+the collective rendezvous forever — the CPU-backend deadlock that hung
+the whole engine test tier.
+
+These tests compile each runner and count collectives in the optimized
+HLO, so a reintroduced hazard fails here with the op's source line
+instead of as a wall-clock hang. Static analysis (tt-analyze TT302)
+catches the known-bad *sources*; this audit catches the *lowering*,
+whatever the source.
+"""
+
+import re
+
+import jax
+import pytest
+
+from timetabling_ga_tpu.ops import ga
+from timetabling_ga_tpu.parallel import islands
+from timetabling_ga_tpu.problem import random_instance
+
+pytestmark = pytest.mark.slow  # compiles ~6 programs (minutes on CPU)
+
+
+def _collectives(compiled_text: str) -> dict[str, list[str]]:
+    """op kind -> [source annotations] for every collective DEFINITION
+    in the HLO (a `kind(`-call on the line; operand references to a
+    collective's result don't count)."""
+    kinds = ("all-reduce", "all-gather", "collective-permute",
+             "all-to-all", "reduce-scatter", "all-reduce-start",
+             "all-gather-start", "collective-permute-start")
+    out: dict[str, list[str]] = {}
+    for line in compiled_text.splitlines():
+        for kind in kinds:
+            if f" {kind}(" in line or f"{kind}-done(" in line:
+                src = re.search(r'op_name="([^"]*)"', line)
+                out.setdefault(kind, []).append(
+                    src.group(1) if src else line.strip()[:120])
+    return out
+
+
+@pytest.fixture(scope="module")
+def setup():
+    p = random_instance(1, n_events=30, n_rooms=4, n_features=3,
+                        n_students=20, attend_prob=0.15)
+    pa = p.device_arrays()
+    mesh = islands.make_mesh(2)
+    cfg = ga.GAConfig(pop_size=8, ls_mode="sweep", ls_sweeps=2,
+                      init_sweeps=4, ls_converge=True)
+    key = jax.random.key(0)
+    state = islands.init_island_population(pa, key, mesh, 8,
+                                           ga.GAConfig(pop_size=8),
+                                           n_islands=2)
+    return p, pa, mesh, cfg, key, state
+
+
+def test_polish_runner_has_no_collectives(setup):
+    """The polish program is island-local by design AND contains the
+    per-island-varying converge while_loop: ANY collective inside it is
+    both a correctness bug and a deadlock (round-1 hang)."""
+    _, pa, mesh, cfg, key, state = setup
+    polish = islands.make_polish_runner(mesh, cfg, n_islands=2)
+    txt = polish.lower(pa, key, state, 4).compile().as_text()
+    assert _collectives(txt) == {}, _collectives(txt)
+
+
+def test_init_runner_has_no_collectives(setup):
+    _, pa, mesh, cfg, key, _ = setup
+    init = jax.jit(lambda pa_, k_: islands.init_island_population(
+        pa_, k_, mesh, 8, cfg, n_islands=2))
+    txt = init.lower(pa, key).compile().as_text()
+    assert _collectives(txt) == {}, _collectives(txt)
+
+
+def test_kick_runner_has_no_collectives(setup):
+    _, pa, mesh, cfg, key, state = setup
+    kick = islands.make_kick_runner(mesh, cfg, n_islands=2)
+    txt = kick.lower(pa, key, state, 3).compile().as_text()
+    assert _collectives(txt) == {}, _collectives(txt)
+
+
+def test_lahc_runners_have_no_collectives(setup):
+    _, pa, mesh, cfg, key, state = setup
+    init_r, run_r, fin_r = islands.make_lahc_runners(mesh, cfg, 16,
+                                                     n_islands=2)
+    lstate = init_r(pa, state)
+    for prog, args in ((init_r, (pa, state)),
+                       (run_r, (pa, key, lstate, 8)),
+                       (fin_r, (lstate,))):
+        txt = prog.lower(*args).compile().as_text()
+        assert _collectives(txt) == {}, _collectives(txt)
+
+
+def test_island_runner_has_only_designed_collectives(setup):
+    """Migration (ppermute) and the global best (pmin) are the design's
+    collectives; anything else — especially an all-reduce whose op_name
+    is NOT the pmin — is partitioner fallout."""
+    _, pa, mesh, cfg, key, state = setup
+    runner = islands.make_island_runner(mesh, cfg, n_epochs=1,
+                                        gens_per_epoch=2, n_islands=2)
+    txt = runner.lower(pa, key, state).compile().as_text()
+    col = _collectives(txt)
+    assert set(col) <= {"all-reduce", "collective-permute"}, col
+    for src in col.get("all-reduce", []):
+        assert "pmin" in src or "min" in src, col
+
+
+def test_dynamic_runner_has_only_designed_collectives(setup):
+    _, pa, mesh, cfg, key, state = setup
+    runner = islands.make_island_runner_dynamic(mesh, cfg, max_gens=4,
+                                                n_islands=2)
+    txt = runner.lower(pa, key, state, 2).compile().as_text()
+    col = _collectives(txt)
+    assert set(col) <= {"all-reduce", "collective-permute"}, col
+    for src in col.get("all-reduce", []):
+        assert "pmin" in src or "min" in src, col

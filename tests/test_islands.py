@@ -26,7 +26,7 @@ def migrate_sharded(mesh, state):
     sharding (shared by every migration test in this file)."""
     import functools
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from timetabling_ga_tpu.compat import shard_map
 
     spec = ga.PopState(slots=P(islands.AXIS), rooms=P(islands.AXIS),
                        penalty=P(islands.AXIS), hcv=P(islands.AXIS),
@@ -226,7 +226,7 @@ def test_local_islands_init_and_migration(mesh):
     boundaries via ppermute and local-island boundaries via rolls."""
     import functools
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from timetabling_ga_tpu.compat import shard_map
 
     NI = 2 * N_ISLANDS
     problem = random_instance(31, n_events=20, n_rooms=5, n_features=2,

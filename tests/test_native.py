@@ -148,3 +148,38 @@ def test_tt_cpu_islands_protocol(tmp_path, algo):
     for s in sols:
         if s["feasible"]:
             assert oracle_hcv(problem, s["timeslots"], s["rooms"]) == 0
+
+
+@pytest.mark.slow
+def test_sanitized_build_runs_clean_on_fixtures():
+    """`make -C native asan` builds the ASan+UBSan-instrumented binary,
+    and a short end-to-end solve on each committed fixtures/ instance
+    produces ZERO sanitizer reports (leaks included) while still
+    emitting the JSONL protocol. Memory bugs in the C++ backend
+    (OpenMP races aside) surface here instead of as corrupt fitness
+    values in the cross-implementation equality tests above."""
+    build = subprocess.run(["make", "-C", os.path.join(REPO, "native"),
+                            "asan"],
+                           capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stdout + build.stderr
+    binary = os.path.join(REPO, "native", "tt_cpu_asan")
+    assert os.path.exists(binary)
+
+    env = dict(os.environ,
+               ASAN_OPTIONS="halt_on_error=1:detect_leaks=1",
+               UBSAN_OPTIONS="halt_on_error=1:print_stacktrace=1")
+    for fixture in ("comp01s.tim", "comp05s.tim"):
+        inst = os.path.join(REPO, "fixtures", fixture)
+        out = subprocess.run(
+            [binary, "-i", inst, "-s", "3", "-c", "2",
+             "--pop-size", "8", "--generations", "5", "-t", "10"],
+            capture_output=True, text=True, timeout=300, env=env)
+        assert out.returncode == 0, (
+            f"{fixture}: sanitized run failed\n{out.stderr[-4000:]}")
+        for marker in ("AddressSanitizer", "LeakSanitizer",
+                       "runtime error:"):
+            assert marker not in out.stderr, (
+                f"{fixture}: sanitizer report\n{out.stderr[-4000:]}")
+        lines = [json.loads(x) for x in out.stdout.splitlines()]
+        kinds = [next(iter(x)) for x in lines]
+        assert kinds.count("runEntry") == 2

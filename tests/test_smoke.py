@@ -1,0 +1,47 @@
+"""API-drift smoke tests: the one clear failure you get instead of a
+wall of collection errors.
+
+The seed spent its whole life broken by a single import
+(`from jax import shard_map` on JAX 0.4.37) that surfaced as an
+ImportError in every test module's collection. These tests pin the two
+entry points that must ALWAYS work — package import and CLI --help —
+with no device access, so the next API drift fails here with a readable
+message.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_package_imports():
+    import timetabling_ga_tpu
+    assert timetabling_ga_tpu.__version__
+
+
+def test_compat_shard_map_resolves():
+    """The version-tolerant resolver must hand back a callable on the
+    installed JAX, whichever home shard_map lives in."""
+    from timetabling_ga_tpu.compat import shard_map
+    assert callable(shard_map)
+
+
+def test_cli_help_runs_without_device():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "timetabling_ga_tpu", "--help"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240)
+    assert r.returncode == 0, r.stderr
+    assert "-i" in r.stdout
+
+
+def test_analysis_cli_runs_without_device():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "timetabling_ga_tpu.analysis",
+         "--list-rules"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240)
+    assert r.returncode == 0, r.stderr
+    assert "TT501" in r.stdout

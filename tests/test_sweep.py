@@ -398,3 +398,22 @@ def test_move3_superset_neighborhood_property():
         means_a.append(np.asarray(pen_a).mean())
         means_b.append(np.asarray(pen_b).mean())
     assert np.mean(means_b) <= np.mean(means_a) + 1.0, (means_a, means_b)
+
+
+def test_sweep_hot_block_wider_than_hot_k(small_problem):
+    """block_events > 2*hot_k: the pivot block is wider than two wraps
+    of the hot-pivot list, so the wrap padding must tile (a single
+    concat pad under-fills and the block slice fails at trace time).
+    Both knobs are CLI-settable; this traced+ran fine with the old
+    modular gather and must keep working with the sliced form."""
+    from tests.conftest import random_assignment
+    pa = small_problem.device_arrays()
+    rng = np.random.default_rng(11)
+    slots, rooms = random_assignment(rng, small_problem, 4)
+    key = jax.random.key(0)
+    s2, r2 = sweep.sweep_local_search(pa, key, jnp.asarray(slots),
+                                      jnp.asarray(rooms), n_sweeps=1,
+                                      block_events=8, hot_k=3)
+    pen0 = fitness.batch_penalty(pa, slots, rooms)[0]
+    pen1 = fitness.batch_penalty(pa, np.asarray(s2), np.asarray(r2))[0]
+    assert (np.asarray(pen1) <= np.asarray(pen0)).all()

@@ -1,0 +1,161 @@
+"""tt-analyze — JAX-aware static analysis for this codebase.
+
+Usage:
+    python -m timetabling_ga_tpu.analysis [--strict] [--json] [paths...]
+
+Rules (see README "Static analysis & sanitizers"):
+
+  TT101  tracer-unsafe control flow in jit/vmap/shard_map/scan targets
+  TT201  jax.jit static arguments receiving unhashable/run-varying values
+  TT202  compile-cache dict keys omitting a value the program closes over
+  TT301  hidden host-device syncs inside dispatch loops
+  TT302  collective-bearing random ops (permutation/shuffle/choice) in
+         shard_map-executed code — replicated-sort all-reduces that
+         merge island RNG streams and deadlock varying while_loops
+  TT401  PRNG key reuse (two consumers, no split/fold_in between)
+  TT501  JAX imports outside the pinned compatibility table (compat.py)
+
+Suppress one finding inline with `# tt-analyze: ignore[TT301]` (on the
+line, or on a comment line directly above). Configure via
+`[tool.tt-analyze]` in pyproject.toml. Exit status: 0, or 1 under
+--strict when findings remain.
+
+Stdlib-only by design: linting must not require JAX or a device.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+
+from timetabling_ga_tpu.analysis.config import (
+    ALL_RULES, AnalyzerConfig, load_compat_table, load_config)
+from timetabling_ga_tpu.analysis.core import Finding, filter_suppressed
+
+__all__ = ["Finding", "AnalyzerConfig", "run_analysis", "main",
+           "ALL_RULES"]
+
+
+class _Context:
+    """Per-run state shared by the rules."""
+
+    def __init__(self, config: AnalyzerConfig):
+        self.config = config
+        self.compat_table = load_compat_table(config)
+
+
+def _rule_modules():
+    from timetabling_ga_tpu.analysis import (
+        rules_api, rules_recompile, rules_rng, rules_sync, rules_trace)
+    return {
+        "TT101": rules_trace,
+        "TT201": rules_recompile,
+        "TT202": rules_recompile,
+        "TT301": rules_sync,
+        "TT302": rules_sync,
+        "TT401": rules_rng,
+        "TT501": rules_api,
+    }
+
+
+def _iter_py_files(paths, root):
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full):
+            yield full
+        else:
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if not d.startswith((".", "__pycache")))
+                for fname in sorted(filenames):
+                    if fname.endswith(".py"):
+                        yield os.path.join(dirpath, fname)
+
+
+def analyze_file(path: str, ctx: _Context) -> list[Finding]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("TT000", path, e.lineno or 0, e.offset or 0,
+                        f"syntax error: {e.msg}")]
+    rel = os.path.relpath(path, ctx.config.root)
+    if rel.startswith(".."):
+        rel = path
+    findings: list[Finding] = []
+    seen_modules = []
+    for rule in ctx.config.rules:
+        mod = _rule_modules().get(rule)
+        if mod is None or mod in seen_modules:
+            continue
+        seen_modules.append(mod)
+        findings.extend(mod.check(tree, src, rel, ctx))
+    # rules sharing a module (TT201/TT202) can duplicate; dedupe exactly
+    findings = sorted(set(findings),
+                      key=lambda f: (f.path, f.line, f.col, f.rule))
+    findings = [f for f in findings if f.rule in ctx.config.rules
+                or f.rule == "TT000"]
+    return filter_suppressed(findings, src)
+
+
+def run_analysis(paths=None, config: AnalyzerConfig | None = None
+                 ) -> list[Finding]:
+    """Analyze `paths` (files or directories); returns all findings."""
+    if config is None:
+        config = load_config(".")
+    ctx = _Context(config)
+    findings: list[Finding] = []
+    for path in _iter_py_files(paths or config.paths, config.root):
+        findings.extend(analyze_file(path, ctx))
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tt-analyze",
+        description="JAX-aware static analysis (tracer safety, recompile "
+                    "hazards, host syncs, RNG discipline, pinned API)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: [tool.tt-analyze] "
+                         "paths)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 if any finding remains")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable JSON report on stdout")
+    ap.add_argument("--root", default=".",
+                    help="project root holding pyproject.toml")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to enable "
+                         f"(default: all of {','.join(ALL_RULES)})")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print rule ids and one-line summaries")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, mod in sorted(_rule_modules().items()):
+            doc = (mod.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule}  {doc}")
+        return 0
+
+    config = load_config(args.root)
+    if args.rules:
+        config.rules = [r.strip() for r in args.rules.split(",")]
+    findings = run_analysis(args.paths or None, config)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in findings],
+            "count": len(findings),
+            "strict": args.strict,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(f"tt-analyze: {n} finding{'s' if n != 1 else ''}",
+              file=sys.stderr)
+    return 1 if (args.strict and findings) else 0

@@ -1,0 +1,7 @@
+"""`python -m timetabling_ga_tpu.analysis` — the tt-analyze CLI."""
+
+import sys
+
+from timetabling_ga_tpu.analysis import main
+
+sys.exit(main())

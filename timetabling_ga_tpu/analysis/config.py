@@ -1,0 +1,164 @@
+"""Analyzer configuration: `[tool.tt-analyze]` in pyproject.toml plus
+the pinned JAX compatibility table (extracted from compat.py by AST, so
+the analyzer never has to import JAX).
+
+Python 3.10 has no tomllib; we fall back to tomli when present and to a
+minimal line parser (enough for our own table-free key = value / list
+entries) when neither library exists — the analyzer must never be the
+thing that breaks on a missing dependency.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+ALL_RULES = ("TT101", "TT201", "TT202", "TT301", "TT302", "TT401",
+             "TT501")
+
+
+@dataclasses.dataclass
+class AnalyzerConfig:
+    # default scan roots when the CLI gives no paths
+    paths: list[str] = dataclasses.field(
+        default_factory=lambda: ["timetabling_ga_tpu"])
+    rules: list[str] = dataclasses.field(
+        default_factory=lambda: list(ALL_RULES))
+    # module (file) holding JAX_COMPAT_TABLE for TT501
+    compat_table: str = "timetabling_ga_tpu/compat.py"
+    # files whose host loops TT301 audits (path suffix match)
+    dispatch_modules: list[str] = dataclasses.field(
+        default_factory=lambda: ["runtime/engine.py", "parallel/islands.py"])
+    # sanctioned device->host fetch helpers: calls to these are THE sync
+    # points, and their bodies are exempt
+    sync_helpers: list[str] = dataclasses.field(
+        default_factory=lambda: ["_fetch", "_fetch_final"])
+    # paths (substring match) whose code executes inside shard_map
+    # bodies — TT302 bans collective-bearing random ops there
+    sharded_modules: list[str] = dataclasses.field(
+        default_factory=lambda: ["ops/", "parallel/"])
+    # callee patterns whose results are compiled programs (calling one
+    # yields device arrays) for TT301's taint seeding
+    device_producers: list[str] = dataclasses.field(
+        default_factory=lambda: [r"^cached_\w+$", r"^jax\.jit$", r"^jit$"])
+    # module-level compile-cache dict names for TT202
+    cache_name_pattern: str = r"^_?[A-Z0-9_]*CACHES?$"
+    # factory callees whose results get cached (TT202 key completeness)
+    factory_pattern: str = r"^(make_\w+|jit)$"
+    # parameter names treated as PRNG keys by TT401
+    rng_param_pattern: str = r"(^key$|^rng(_key)?$|_key$|^key_|^k_[a-z]$)"
+    # callees that may receive a key without consuming randomness
+    # (checkpointing, serialization)
+    rng_exempt_callees: list[str] = dataclasses.field(
+        default_factory=lambda: ["save", "key_data", "log_entry"])
+
+    root: str = "."
+
+
+def _parse_toml(text: str) -> dict:
+    try:
+        import tomllib  # Python >= 3.11
+        return tomllib.loads(text)
+    except ModuleNotFoundError:
+        pass
+    try:
+        import tomli
+        return tomli.loads(text)
+    except ModuleNotFoundError:
+        pass
+    return _parse_toml_minimal(text)
+
+
+def _toml_unescape(s: str) -> str:
+    """Decode TOML basic-string escapes (the subset our config uses).
+    Without this, a pattern like "^cached_\\\\w+$" reaches the analyzer
+    with a literal double backslash and silently never matches."""
+    return (s.replace("\\\\", "\0").replace('\\"', '"')
+            .replace("\\n", "\n").replace("\\t", "\t")
+            .replace("\0", "\\"))
+
+
+def _parse_toml_minimal(text: str) -> dict:
+    """Tiny fallback parser: tables, string/bool/int scalars, and flat
+    string lists — the subset [tool.tt-analyze] uses."""
+    out: dict = {}
+    cur = out
+    buf = None  # (key, accumulated-list-text) while a [...] spans lines
+
+    def strings(chunk: str) -> list[str]:
+        return [_toml_unescape(s)
+                for s in re.findall(r'"([^"]*)"', chunk)]
+
+    for raw in text.splitlines():
+        line = raw.strip()
+        if buf is not None:
+            buf = (buf[0], buf[1] + " " + line)
+            if line.endswith("]"):
+                cur[buf[0]] = strings(buf[1])
+                buf = None
+            continue
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            cur = out
+            for part in line[1:-1].strip().split("."):
+                part = part.strip().strip('"')
+                cur = cur.setdefault(part, {})
+            continue
+        if "=" not in line:
+            continue
+        key, _, val = line.partition("=")
+        key, val = key.strip().strip('"'), val.strip()
+        if val.startswith("[") and not val.endswith("]"):
+            buf = (key, val)
+        elif val.startswith("["):
+            cur[key] = strings(val)
+        elif val.startswith('"'):
+            cur[key] = _toml_unescape(val.strip('"'))
+        elif val in ("true", "false"):
+            cur[key] = val == "true"
+        else:
+            try:
+                cur[key] = int(val)
+            except ValueError:
+                cur[key] = val
+    return out
+
+
+def load_config(root: str = ".") -> AnalyzerConfig:
+    cfg = AnalyzerConfig(root=root)
+    pyproject = os.path.join(root, "pyproject.toml")
+    if not os.path.exists(pyproject):
+        return cfg
+    with open(pyproject, encoding="utf-8") as f:
+        data = _parse_toml(f.read())
+    section = data.get("tool", {}).get("tt-analyze", {})
+    for key, val in section.items():
+        field = key.replace("-", "_")
+        if hasattr(cfg, field) and field != "root":
+            setattr(cfg, field, val)
+    return cfg
+
+
+def load_compat_table(cfg: AnalyzerConfig) -> dict[str, list[str]]:
+    """Extract JAX_COMPAT_TABLE from the configured module by AST —
+    lint-time must not import jax (or anything else)."""
+    path = cfg.compat_table
+    if not os.path.isabs(path):
+        path = os.path.join(cfg.root, path)
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Name)
+                        and tgt.id == "JAX_COMPAT_TABLE"):
+                    try:
+                        return ast.literal_eval(node.value)
+                    except ValueError:
+                        return {}
+    return {}

@@ -1,0 +1,163 @@
+"""Shared infrastructure for the tt-analyze rules: findings, the
+inline-suppression protocol, and small AST utilities every rule uses.
+
+Deliberately stdlib-only — the analyzer must run (in CI, pre-commit,
+editors) without importing JAX or touching a device.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+    rule: str          # e.g. "TT101"
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# `# tt-analyze: ignore` suppresses every rule on that line;
+# `# tt-analyze: ignore[TT301]` / `ignore[TT301,TT401]` only those.
+_SUPPRESS_RE = re.compile(
+    r"#\s*tt-analyze:\s*ignore(?:\[([A-Za-z0-9_,\s]+)\])?")
+
+
+def suppressions(src: str) -> dict[int, set[str] | None]:
+    """Map 1-based line number -> suppressed rule ids (None = all rules).
+
+    A marker suppresses findings on its own line; a marker on a
+    comment-only line also suppresses findings on the line below it.
+    """
+    out: dict[int, set[str] | None] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = (None if m.group(1) is None
+                 else {r.strip() for r in m.group(1).split(",") if r.strip()})
+
+        def merge(ln: int, rules=rules):
+            cur = out.get(ln, set())
+            out[ln] = None if (rules is None or cur is None) else cur | rules
+
+        merge(i)
+        if line.lstrip().startswith("#"):
+            merge(i + 1)
+    return out
+
+
+def filter_suppressed(findings: list[Finding], src: str) -> list[Finding]:
+    supp = suppressions(src)
+    kept = []
+    for f in findings:
+        rules = supp.get(f.line, set())
+        if rules is None or (rules and f.rule in rules):
+            continue
+        kept.append(f)
+    return kept
+
+
+def qualname(node: ast.AST) -> str | None:
+    """Dotted name of a Name/Attribute chain ('jax.random.split'), else
+    None for anything not a plain attribute path."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def qual_matches(qn: str | None, names: set[str]) -> bool:
+    """True if the dotted name's tail matches any entry: 'jax.lax.scan'
+    matches both 'lax.scan' and 'scan' entries."""
+    if qn is None:
+        return False
+    parts = qn.split(".")
+    for i in range(len(parts)):
+        if ".".join(parts[i:]) in names:
+            return True
+    return False
+
+
+def target_names(target: ast.AST):
+    """Bound names of an assignment target (handles tuple/list/star)."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, ast.Starred):
+        yield from target_names(target.value)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from target_names(elt)
+
+
+def name_ids(node: ast.AST) -> set[str]:
+    """Every Name id appearing anywhere under `node`."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def func_params(fn: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda
+                ) -> list[str]:
+    a = fn.args
+    params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        params.append(a.vararg.arg)
+    if a.kwarg:
+        params.append(a.kwarg.arg)
+    return params
+
+
+def decorator_static_params(fn: ast.FunctionDef) -> set[str]:
+    """Param names declared static via static_argnames/static_argnums in
+    a jit-ish decorator (plain or functools.partial-wrapped)."""
+    static: set[str] = set()
+    params = func_params(fn)
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                        static.add(n.value)
+            elif kw.arg == "static_argnums":
+                for n in ast.walk(kw.value):
+                    if (isinstance(n, ast.Constant)
+                            and isinstance(n.value, int)
+                            and 0 <= n.value < len(params)):
+                        static.add(params[n.value])
+    return static
+
+
+class ParentedVisitor(ast.NodeVisitor):
+    """NodeVisitor that tracks the stack of enclosing nodes."""
+
+    def __init__(self):
+        self.stack: list[ast.AST] = []
+
+    def generic_visit(self, node):
+        self.stack.append(node)
+        try:
+            super().generic_visit(node)
+        finally:
+            self.stack.pop()
+
+    def enclosing(self, *types):
+        for n in reversed(self.stack):
+            if isinstance(n, types):
+                return n
+        return None

@@ -1,0 +1,95 @@
+"""TT501 — pinned JAX API surface.
+
+Every `import jax...` in the package must be declared in the
+compatibility table (`JAX_COMPAT_TABLE` in timetabling_ga_tpu/compat.py
+by default): the table is the set of JAX symbols known to exist on every
+JAX version we support. An import of an undeclared symbol is exactly how
+`from jax import shard_map` (a 0.6+ export) broke the whole suite on the
+installed JAX 0.4.37 — this rule fails that at lint time instead.
+
+Imports inside a `try:` whose handler catches ImportError are exempt:
+that is the sanctioned version-tolerance idiom (see compat.py), where a
+missing symbol is handled, not fatal.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from timetabling_ga_tpu.analysis.core import Finding, qualname
+
+RULE = "TT501"
+
+_IMPORT_ERRORS = {"ImportError", "ModuleNotFoundError", "Exception",
+                  "BaseException"}
+
+
+def _guarded_lines(tree: ast.Module) -> set[int]:
+    """Line numbers inside try/except-ImportError bodies and their
+    handlers (the whole construct is version-tolerant by design)."""
+    lines: set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        catches_import = False
+        for h in node.handlers:
+            types = []
+            if h.type is None:
+                catches_import = True
+            elif isinstance(h.type, ast.Tuple):
+                types = h.type.elts
+            else:
+                types = [h.type]
+            for t in types:
+                qn = qualname(t)
+                if qn and qn.rsplit(".", 1)[-1] in _IMPORT_ERRORS:
+                    catches_import = True
+        if not catches_import:
+            continue
+        for part in ([node.body] + [h.body for h in node.handlers]
+                     + [node.orelse]):
+            for st in part:
+                lines.update(range(st.lineno,
+                                   (st.end_lineno or st.lineno) + 1))
+    return lines
+
+
+def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
+    table = ctx.compat_table
+    if not table:
+        return []
+    findings: list[Finding] = []
+    guarded = _guarded_lines(tree)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                mod = alias.name
+                if mod != "jax" and not mod.startswith("jax."):
+                    continue
+                if node.lineno in guarded:
+                    continue
+                if mod not in table:
+                    findings.append(Finding(
+                        RULE, path, node.lineno, node.col_offset,
+                        f"`import {mod}` is outside the pinned JAX API "
+                        f"surface — declare it in JAX_COMPAT_TABLE "
+                        f"(compat.py) or resolve it through compat"))
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if node.level or (mod != "jax" and not mod.startswith("jax.")):
+                continue
+            if node.lineno in guarded:
+                continue
+            allowed = table.get(mod)
+            for alias in node.names:
+                if allowed is not None and (
+                        "*" in allowed or alias.name in allowed):
+                    continue
+                findings.append(Finding(
+                    RULE, path, node.lineno, node.col_offset,
+                    f"`from {mod} import {alias.name}` is outside the "
+                    f"pinned JAX API surface — not every supported JAX "
+                    f"version exports it; declare it in JAX_COMPAT_TABLE "
+                    f"or add a guarded resolver in compat.py"))
+    return findings

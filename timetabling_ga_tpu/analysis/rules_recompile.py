@@ -1,0 +1,251 @@
+"""TT201 / TT202 — recompile hazards.
+
+TT201: a `jax.jit` static argument (static_argnums / static_argnames)
+receiving an unhashable value (list/dict/set display, np/jnp array) —
+a TypeError at call time — or a run-varying value (the loop variable of
+an enclosing Python `for`), which recompiles the program every
+iteration.
+
+TT202: compile-cache completeness. A hand-rolled compiled-program cache
+(`_RUNNER_CACHE`-style module dict) must key on EVERY value the traced
+program closes over: a factory argument that does not appear in the
+cache-key tuple means two configs that differ only in that value
+collide on one cache entry — the cached program silently runs with the
+first config's constant baked in.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from timetabling_ga_tpu.analysis.core import (
+    Finding, func_params, qual_matches, qualname, target_names)
+
+RULE_STATIC = "TT201"
+RULE_CACHE = "TT202"
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_UNHASHABLE_CALLS = {"np.array", "np.asarray", "numpy.array",
+                     "numpy.asarray", "jnp.array", "jnp.asarray",
+                     "jax.numpy.array", "jax.numpy.asarray", "list",
+                     "dict", "set"}
+
+
+def _jit_static_spec(call: ast.Call):
+    """(static_positions, static_names) from a jax.jit(...) call, or
+    None when it declares no statics."""
+    nums, names = [], []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.append(n.value)
+        elif kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.append(n.value)
+    return (nums, names) if (nums or names) else None
+
+
+def _is_unhashable(expr: ast.AST) -> bool:
+    if isinstance(expr, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(expr, ast.Call):
+        return qual_matches(qualname(expr.func), _UNHASHABLE_CALLS)
+    return False
+
+
+def _check_static_args(tree: ast.Module, path: str) -> list[Finding]:
+    findings: list[Finding] = []
+    # jitted-name -> (static positions, static names, param names or None)
+    jitted: dict[str, tuple[list[int], list[str], list[str] | None]] = {}
+
+    for node in ast.walk(tree):
+        # g = jax.jit(f, static_argnums=...)
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if qual_matches(qualname(call.func), _JIT_NAMES):
+                spec = _jit_static_spec(call)
+                if spec:
+                    for tgt in node.targets:
+                        for name in target_names(tgt):
+                            jitted[name] = (spec[0], spec[1], None)
+        # @jax.jit(static_argnums=...) / @partial(jax.jit, static_...=)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                is_jit = qual_matches(qualname(dec.func), _JIT_NAMES)
+                is_partial_jit = (
+                    qual_matches(qualname(dec.func),
+                                 {"functools.partial", "partial"})
+                    and dec.args
+                    and qual_matches(qualname(dec.args[0]), _JIT_NAMES))
+                if is_jit or is_partial_jit:
+                    spec = _jit_static_spec(dec)
+                    if spec:
+                        jitted[node.name] = (spec[0], spec[1],
+                                             func_params(node))
+
+    if not jitted:
+        return findings
+
+    # walk call sites with the enclosing-for-loop-variable set in scope
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.loop_vars: list[set[str]] = []
+
+        def visit_For(self, node: ast.For):
+            self.loop_vars.append(set(target_names(node.target)))
+            self.generic_visit(node)
+            self.loop_vars.pop()
+
+        def _flag(self, expr, name, what):
+            findings.append(Finding(
+                RULE_STATIC, path, expr.lineno, expr.col_offset,
+                f"static argument of jitted `{name}` receives {what} — "
+                f"unhashable statics raise at call time; run-varying "
+                f"statics recompile on every call"))
+
+        def _check_expr(self, expr, name):
+            if _is_unhashable(expr):
+                self._flag(expr, name, "an unhashable value")
+            elif (isinstance(expr, ast.Name)
+                  and any(expr.id in lv for lv in self.loop_vars)):
+                self._flag(expr, name, f"loop variable `{expr.id}`")
+
+        def visit_Call(self, node: ast.Call):
+            fname = qualname(node.func)
+            if fname in jitted:
+                nums, names, params = jitted[fname]
+                for pos in nums:
+                    if pos < len(node.args):
+                        self._check_expr(node.args[pos], fname)
+                for kw in node.keywords:
+                    if kw.arg in names:
+                        self._check_expr(kw.value, fname)
+                    elif (kw.arg is None and params is None):
+                        pass
+                # positional args bound to static_argnames params
+                if params:
+                    for pos, arg in enumerate(node.args):
+                        if pos < len(params) and params[pos] in names:
+                            self._check_expr(arg, fname)
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return findings
+
+
+def _value_names(node: ast.AST) -> set[str]:
+    """Data names an expression depends on: Name ids excluding callee
+    chains (`islands.make_runner(mesh)` depends on `mesh`, not
+    `islands`) and lambda-bound parameters."""
+    names: set[str] = set()
+
+    def rec(n, bound: frozenset):
+        if isinstance(n, ast.Call):
+            for a in list(n.args) + [kw.value for kw in n.keywords]:
+                rec(a, bound)      # skip n.func: callee, not data
+        elif isinstance(n, ast.Lambda):
+            rec(n.body, bound | frozenset(func_params(n)))
+        elif isinstance(n, ast.Name):
+            if n.id not in bound:
+                names.add(n.id)
+        else:
+            for c in ast.iter_child_nodes(n):
+                rec(c, bound)
+
+    rec(node, frozenset())
+    return names
+
+
+def _factory_arg_names(call: ast.Call) -> set[str]:
+    """Names a compiled-program factory closes over: every data name in
+    its arguments; lambda arguments contribute their free names."""
+    names: set[str] = set()
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        names |= _value_names(arg)
+    return names
+
+
+def _walk_scope(scope: ast.AST):
+    """Walk a scope's own statements, not those of nested functions or
+    classes (they are separate scopes with their own analysis)."""
+    todo = list(ast.iter_child_nodes(scope))
+    while todo:
+        node = todo.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            todo.extend(ast.iter_child_nodes(node))
+
+
+def _check_cache_keys(tree: ast.Module, path: str, ctx) -> list[Finding]:
+    findings: list[Finding] = []
+    cache_re = re.compile(ctx.config.cache_name_pattern)
+    factory_re = re.compile(ctx.config.factory_pattern)
+
+    scopes = [tree] + [n for n in ast.walk(tree)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+    for scope in scopes:
+        # gather per-scope: key tuples, factory-call assignments, and
+        # cache stores — one linear pass over the scope's own statements
+        key_tuples: dict[str, ast.Tuple] = {}
+        factory_calls: dict[str, ast.Call] = {}
+        stores: list[tuple[str, ast.AST]] = []  # (key var, value expr)
+
+        for node in _walk_scope(scope):
+            if isinstance(node, ast.Assign):
+                val = node.value
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        if isinstance(val, ast.Tuple):
+                            key_tuples[tgt.id] = val
+                        elif isinstance(val, ast.Call):
+                            fq = qualname(val.func)
+                            last = fq.rsplit(".", 1)[-1] if fq else ""
+                            if factory_re.match(last):
+                                factory_calls[tgt.id] = val
+                    elif (isinstance(tgt, ast.Subscript)
+                          and isinstance(tgt.value, ast.Name)
+                          and cache_re.match(tgt.value.id)
+                          and isinstance(tgt.slice, ast.Name)):
+                        stores.append((tgt.slice.id, val))
+
+        for key_var, value in stores:
+            key_node = key_tuples.get(key_var)
+            if key_node is None:
+                continue
+            call = None
+            if isinstance(value, ast.Call):
+                fq = qualname(value.func)
+                last = fq.rsplit(".", 1)[-1] if fq else ""
+                if factory_re.match(last):
+                    call = value
+            elif isinstance(value, ast.Name):
+                call = factory_calls.get(value.id)
+            if call is None:
+                continue
+            key_names = _value_names(key_node)
+            missing = sorted(_factory_arg_names(call) - key_names)
+            for name in missing:
+                findings.append(Finding(
+                    RULE_CACHE, path, call.lineno, call.col_offset,
+                    f"compile-cache key `{key_var}` omits `{name}`, which "
+                    f"the cached program is built from — two configs "
+                    f"differing only in `{name}` collide on one compiled "
+                    f"program"))
+    return findings
+
+
+def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
+    out = []
+    if "TT201" in ctx.config.rules:
+        out += _check_static_args(tree, path)
+    if "TT202" in ctx.config.rules:
+        out += _check_cache_keys(tree, path, ctx)
+    return out

@@ -1,0 +1,207 @@
+"""TT401 — PRNG key reuse.
+
+A JAX PRNG key passed to two consumers without an intervening
+`jax.random.split` / `fold_in` gives both consumers IDENTICAL
+randomness — island populations that mirror each other, mutation
+streams that repeat — with no runtime error to catch it.
+
+The analysis is a linear per-function scan. Key names are seeded from
+`jax.random.key/PRNGKey/split/fold_in` results and key-looking
+parameters. Consumption sites are call sites (a loop re-executing ONE
+site with varying fold_in data is the sanctioned pattern and does not
+flag). `x, key = jax.random.split(key)` consumes and rebinds
+atomically. `fold_in(key, c)` derives rather than consumes, but two
+fold_in sites folding the SAME literal constant collide and flag.
+Subscripts of split-produced key arrays (`keys[3]`) are tracked per
+literal index. Callees in `rng_exempt_callees` (checkpoint writers)
+receive keys without consuming randomness.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from timetabling_ga_tpu.analysis.core import (
+    Finding, func_params, qualname, target_names)
+
+RULE = "TT401"
+
+_KEY_MAKERS = {"key", "PRNGKey", "split", "fold_in", "wrap_key_data"}
+
+
+def _rng_call_kind(call: ast.Call) -> str | None:
+    """'split' | 'fold_in' | 'make' for jax.random.* calls, else None."""
+    qn = qualname(call.func)
+    if qn is None:
+        return None
+    parts = qn.split(".")
+    tail = parts[-1]
+    if tail not in _KEY_MAKERS:
+        return None
+    # accept jax.random.split / random.split / jr.split / bare PRNGKey
+    if len(parts) >= 2 and parts[-2] not in ("random", "jax", "jr",
+                                             "jrandom"):
+        return None
+    if tail in ("split", "fold_in"):
+        return tail
+    return "make"
+
+
+class _Scan:
+    def __init__(self, fn, path, ctx, findings):
+        self.fn = fn
+        self.path = path
+        self.findings = findings
+        self.exempt = set(ctx.config.rng_exempt_callees)
+        param_re = re.compile(ctx.config.rng_param_pattern)
+        params = func_params(fn) if not isinstance(fn, ast.Module) else []
+        # name -> True once consumed since last (re)bind
+        self.consumed: dict[str, bool] = {
+            p: False for p in params if param_re.search(p)}
+        # (name, fold literal) and (name, subscript literal) seen
+        self.folds: set[tuple[str, object]] = set()
+        self.subs: set[tuple[str, object]] = set()
+
+    def is_key(self, name: str) -> bool:
+        return name in self.consumed
+
+    def _flag(self, node, name, why):
+        self.findings.append(Finding(
+            RULE, self.path, node.lineno, node.col_offset,
+            f"PRNG key `{name}` {why} — split/fold_in a fresh subkey "
+            f"per consumer (reused keys give identical randomness)"))
+
+    def _bind(self, target_name: str):
+        self.consumed[target_name] = False
+
+    def _consume(self, node, name):
+        if self.consumed.get(name):
+            self._flag(node, name,
+                       "passed to a second consumer without an "
+                       "intervening jax.random.split/fold_in")
+        self.consumed[name] = True
+
+    def _handle_call(self, call: ast.Call, rebound: set[str]):
+        kind = _rng_call_kind(call)
+        qn = qualname(call.func) or ""
+        callee_tail = qn.rsplit(".", 1)[-1]
+        if kind is None and callee_tail in self.exempt:
+            return
+        args = list(call.args) + [kw.value for kw in call.keywords]
+        for i, arg in enumerate(args):
+            if isinstance(arg, ast.Name) and self.is_key(arg.id):
+                if kind == "fold_in" and i == 0:
+                    data = args[1] if len(args) > 1 else None
+                    if isinstance(data, ast.Constant):
+                        fk = (arg.id, repr(data.value))
+                        if fk in self.folds:
+                            self._flag(
+                                call, arg.id,
+                                f"folded with the same constant "
+                                f"{data.value!r} at a second site")
+                        self.folds.add(fk)
+                    # non-constant fold data: derivation, assumed fresh
+                elif kind == "split" and i == 0:
+                    if arg.id in rebound:
+                        # `k2, key = split(key)`: atomic consume+rebind
+                        pass
+                    else:
+                        self._consume(call, arg.id)
+                else:
+                    self._consume(call, arg.id)
+            elif (isinstance(arg, ast.Subscript)
+                  and isinstance(arg.value, ast.Name)
+                  and self.is_key(arg.value.id)
+                  and isinstance(arg.slice, ast.Constant)):
+                sk = (arg.value.id, repr(arg.slice.value))
+                if sk in self.subs:
+                    self._flag(call, arg.value.id,
+                               f"element [{arg.slice.value!r}] consumed "
+                               f"at a second site")
+                self.subs.add(sk)
+
+    def _visit_calls(self, node, rebound: set[str]):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._handle_call(sub, rebound)
+
+    def run(self):
+        body = self.fn.body if isinstance(self.fn.body, list) else []
+        self._stmts(body)
+
+    def _stmts(self, stmts):
+        for st in stmts:
+            self._stmt(st)
+
+    def _stmt(self, st):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # each function is scanned in its own scope
+        if isinstance(st, ast.Assign):
+            rebound = set()
+            for tgt in st.targets:
+                rebound |= set(target_names(tgt))
+            self._visit_calls(st.value, rebound & set(self.consumed))
+            is_rng = (isinstance(st.value, ast.Call)
+                      and _rng_call_kind(st.value) is not None)
+            for name in rebound:
+                if is_rng:
+                    self._bind(name)
+                elif name in self.consumed:
+                    # rebound to a non-key value: stop tracking
+                    del self.consumed[name]
+                    self.folds = {f for f in self.folds if f[0] != name}
+                    self.subs = {s for s in self.subs if s[0] != name}
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign, ast.Expr,
+                             ast.Return, ast.Raise)):
+            if getattr(st, "value", None) is not None:
+                self._visit_calls(st.value, set())
+        elif isinstance(st, ast.If):
+            # mutually exclusive branches each get the pre-branch state;
+            # afterwards a key counts consumed if EITHER branch consumed
+            # it (so later reuse still flags, but one consumption per
+            # exclusive branch does not)
+            self._visit_calls(st.test, set())
+            saved = (dict(self.consumed), set(self.folds), set(self.subs))
+            self._stmts(st.body)
+            after_body = (self.consumed, self.folds, self.subs)
+            self.consumed, self.folds, self.subs = (
+                dict(saved[0]), set(saved[1]), set(saved[2]))
+            self._stmts(st.orelse)
+            merged = {}
+            for name in set(after_body[0]) & set(self.consumed):
+                merged[name] = after_body[0][name] or self.consumed[name]
+            self.consumed = merged
+            self.folds |= after_body[1]
+            self.subs |= after_body[2]
+        elif isinstance(st, ast.While):
+            self._visit_calls(st.test, set())
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, ast.For):
+            self._visit_calls(st.iter, set())
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, ast.With):
+            for item in st.items:
+                self._visit_calls(item.context_expr, set())
+            self._stmts(st.body)
+        elif isinstance(st, ast.Try):
+            self._stmts(st.body)
+            for h in st.handlers:
+                self._stmts(h.body)
+            self._stmts(st.orelse)
+            self._stmts(st.finalbody)
+        elif isinstance(st, ast.Assert):
+            self._visit_calls(st.test, set())
+
+
+def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
+    findings: list[Finding] = []
+    scopes = [tree] + [n for n in ast.walk(tree)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+    for scope in scopes:
+        _Scan(scope, path, ctx, findings).run()
+    return findings

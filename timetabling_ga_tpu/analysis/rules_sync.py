@@ -1,0 +1,230 @@
+"""TT301/TT302 — hidden host-device syncs and hidden collectives.
+
+TT301 — hidden host-device synchronization in dispatch loops.
+
+Inside the host orchestration loops of the configured dispatch modules
+(runtime/engine.py, parallel/islands.py by default), `.item()`,
+`.tolist()`, `float()`, `int()`, `bool()`, `np.asarray()` / `np.array()`
+on a device array each cost a full device round trip — multi-second on
+tunneled devices — and serialize the dispatch pipeline. All readbacks
+must route through the sanctioned fetch helpers (`_fetch` /
+`_fetch_final`), which batch the round trip and are exempt.
+
+Device-value taint is seeded from compiled-program producers (callees
+matching `device_producers`, default `cached_*` / `jax.jit`): a name
+bound from calling such a program is a device array; `_fetch(x)` clears
+the taint (its result is host memory).
+
+TT302 — hidden cross-device collectives from shuffle-by-sort random
+ops. In code that runs inside `shard_map` bodies (the configured
+`sharded_modules`, default ops/ and parallel/), `jax.random.
+permutation` / `shuffle` / `choice` lower through a sort whose operand
+XLA's SPMD partitioner replicates across the mesh with masked
+all-reduces — collectives inside per-island programs that silently
+merge the islands' random streams AND deadlock the CPU backend when a
+surrounding data-dependent while_loop's trip counts diverge (one device
+exits, the other waits at the rendezvous forever). Use elementwise
+constructions instead: affine index permutations, `lax.top_k` over iid
+uniforms, `jax.random.categorical`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from timetabling_ga_tpu.analysis.core import (
+    Finding, qual_matches, qualname, target_names)
+
+RULE = "TT301"
+RULE_COLLECTIVE = "TT302"
+
+_COLLECTIVE_RANDOM_CALLS = {
+    "jax.random.permutation", "random.permutation",
+    "jax.random.shuffle", "random.shuffle",
+    "jax.random.choice", "random.choice",
+}
+
+_CONVERT_CALLS = {"float", "int", "bool"}
+_NUMPY_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                "jax.device_get"}
+_SYNC_METHODS = {"item", "tolist"}
+
+
+class _FuncChecker:
+    def __init__(self, fn, path, ctx, findings):
+        self.fn = fn
+        self.path = path
+        self.findings = findings
+        self.sync_helpers = set(ctx.config.sync_helpers)
+        self.producer_res = [re.compile(p)
+                             for p in ctx.config.device_producers]
+        self.programs: set[str] = set()   # names of compiled programs
+        self.device: set[str] = set()     # names holding device arrays
+
+    def _is_producer(self, call: ast.Call) -> bool:
+        qn = qualname(call.func)
+        if qn is not None and any(r.match(qn) for r in self.producer_res):
+            return True
+        # nested: cached_init(...)(args) — calling a producer's result
+        if isinstance(call.func, ast.Call):
+            return self._is_producer(call.func)
+        return False
+
+    def _is_sync_helper_call(self, call: ast.Call) -> bool:
+        qn = qualname(call.func)
+        return (qn is not None
+                and qn.rsplit(".", 1)[-1] in self.sync_helpers)
+
+    def value_kind(self, node: ast.AST) -> str:
+        """'device' | 'host' for an expression."""
+        if isinstance(node, ast.Name):
+            return "device" if node.id in self.device else "host"
+        if isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+            return self.value_kind(node.value)
+        if isinstance(node, ast.Call):
+            if self._is_sync_helper_call(node):
+                return "host"
+            qn = qualname(node.func)
+            if (self._is_producer(node)
+                    or (qn is not None
+                        and qn.rsplit(".", 1)[-1] in self.programs)
+                    or (isinstance(node.func, ast.Name)
+                        and node.func.id in self.programs)):
+                return "device"
+            if any(self.value_kind(a) == "device"
+                   for a in list(node.args)
+                   + [kw.value for kw in node.keywords]):
+                return "device"
+            if (isinstance(node.func, ast.Attribute)
+                    and self.value_kind(node.func.value) == "device"):
+                return "device"   # method call on a device array
+            return "host"
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return self.value_kind(node.elt)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                if self.value_kind(child) == "device":
+                    return "device"
+        return "host"
+
+    def _bind(self, target: ast.AST, kind_device: bool, program: bool):
+        for name in target_names(target):
+            if program:
+                self.programs.add(name)
+                self.device.discard(name)
+            elif kind_device:
+                self.device.add(name)
+                self.programs.discard(name)
+            else:
+                self.device.discard(name)
+                self.programs.discard(name)
+
+    def _flag(self, node, what):
+        self.findings.append(Finding(
+            RULE, self.path, node.lineno, node.col_offset,
+            f"hidden host-device sync: {what} on a device array inside a "
+            f"dispatch loop — batch the readback through the sanctioned "
+            f"fetch helper instead"))
+
+    def _check_expr_for_syncs(self, node: ast.AST, in_loop: bool):
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            qn = qualname(sub.func)
+            if (isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _SYNC_METHODS
+                    and self.value_kind(sub.func.value) == "device"):
+                self._flag(sub, f"`.{sub.func.attr}()`")
+            elif (qn in _CONVERT_CALLS and in_loop and sub.args
+                    and self.value_kind(sub.args[0]) == "device"):
+                self._flag(sub, f"`{qn}()`")
+            elif (qual_matches(qn, _NUMPY_CALLS) and in_loop and sub.args
+                    and self.value_kind(sub.args[0]) == "device"):
+                self._flag(sub, f"`{qn}()`")
+
+    def run(self):
+        self._stmts(self.fn.body, in_loop=False)
+
+    def _stmts(self, stmts, in_loop):
+        for st in stmts:
+            self._stmt(st, in_loop)
+
+    def _stmt(self, st, in_loop):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return
+        if isinstance(st, ast.Assign):
+            self._check_expr_for_syncs(st.value, in_loop)
+            if isinstance(st.value, ast.Call) and self._is_producer(
+                    st.value) and not isinstance(st.value.func, ast.Call):
+                for tgt in st.targets:
+                    self._bind(tgt, False, program=True)
+            else:
+                kind = self.value_kind(st.value)
+                for tgt in st.targets:
+                    self._bind(tgt, kind == "device", program=False)
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            if st.value is not None:
+                self._check_expr_for_syncs(st.value, in_loop)
+        elif isinstance(st, (ast.Expr, ast.Return)):
+            if st.value is not None:
+                self._check_expr_for_syncs(st.value, in_loop)
+        elif isinstance(st, (ast.If, ast.While)):
+            self._check_expr_for_syncs(st.test, in_loop)
+            inner = in_loop or isinstance(st, ast.While)
+            self._stmts(st.body, inner)
+            self._stmts(st.orelse, inner)
+        elif isinstance(st, ast.For):
+            self._check_expr_for_syncs(st.iter, in_loop)
+            self._stmts(st.body, True)
+            self._stmts(st.orelse, in_loop)
+        elif isinstance(st, ast.With):
+            self._stmts(st.body, in_loop)
+        elif isinstance(st, ast.Try):
+            self._stmts(st.body, in_loop)
+            for h in st.handlers:
+                self._stmts(h.body, in_loop)
+            self._stmts(st.orelse, in_loop)
+            self._stmts(st.finalbody, in_loop)
+        elif isinstance(st, (ast.Raise, ast.Assert)):
+            pass
+
+
+def _check_collective_randoms(tree: ast.Module, path: str, ctx
+                              ) -> list[Finding]:
+    norm = path.replace("\\", "/")
+    if not any(part in norm for part in ctx.config.sharded_modules):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and qual_matches(qualname(node.func),
+                                 _COLLECTIVE_RANDOM_CALLS)):
+            name = qualname(node.func)
+            findings.append(Finding(
+                RULE_COLLECTIVE, path, node.lineno, node.col_offset,
+                f"`{name}` in shard_map-executed code lowers through a "
+                f"sort the SPMD partitioner replicates with cross-device "
+                f"all-reduces — merged island RNG streams and a CPU-"
+                f"backend deadlock under varying while_loop trip counts; "
+                f"use an affine permutation / lax.top_k of uniforms / "
+                f"jax.random.categorical instead"))
+    return findings
+
+
+def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
+    findings: list[Finding] = []
+    if "TT302" in ctx.config.rules:
+        findings += _check_collective_randoms(tree, path, ctx)
+    norm = path.replace("\\", "/")
+    if "TT301" in ctx.config.rules and any(
+            norm.endswith(suffix)
+            for suffix in ctx.config.dispatch_modules):
+        sync_helpers = set(ctx.config.sync_helpers)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name in sync_helpers:
+                    continue  # the sanctioned sync points themselves
+                _FuncChecker(node, path, ctx, findings).run()
+    return findings

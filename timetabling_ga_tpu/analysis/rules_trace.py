@@ -1,0 +1,189 @@
+"""TT101 — tracer-unsafe control flow.
+
+Python `if` / `while` / `assert` / `for` statements whose condition (or
+iterable) derives from a parameter of a function that is a jit / vmap /
+shard_map / lax-control-flow target execute at TRACE time: at best they
+bake one branch into the compiled program, at worst they raise
+TracerBoolConversionError at runtime. Inside traced code the data-
+dependent forms are `lax.cond` / `lax.while_loop` / `jnp.where`.
+
+Shape- and dtype-derived values (`x.shape`, `x.ndim`, `x.dtype`,
+`len(x)`) are static under tracing and do not taint; neither do params
+declared static via `static_argnums` / `static_argnames`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from timetabling_ga_tpu.analysis.core import (
+    Finding, decorator_static_params, func_params, qual_matches, qualname,
+    target_names)
+
+RULE = "TT101"
+
+# callees whose function-valued arguments are traced
+_TRACING_CALLEES = {
+    "jax.jit", "jit", "jax.vmap", "vmap", "jax.pmap", "pmap",
+    "shard_map", "jax.experimental.shard_map.shard_map",
+    "lax.scan", "jax.lax.scan", "lax.fori_loop", "jax.lax.fori_loop",
+    "lax.while_loop", "jax.lax.while_loop", "lax.cond", "jax.lax.cond",
+    "lax.switch", "jax.lax.switch", "jax.checkpoint", "jax.remat",
+    "jax.grad", "grad", "jax.value_and_grad",
+}
+
+# attribute reads that yield static (trace-time Python) values
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+# calls that yield static values regardless of argument taint
+_STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "range"}
+
+
+def _collect_targets(tree: ast.Module):
+    """FunctionDef/Lambda nodes that are trace targets in this module."""
+    defs_by_name: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    targets: list[ast.AST] = []
+    seen: set[int] = set()
+
+    def add(node):
+        if id(node) not in seen:
+            seen.add(id(node))
+            targets.append(node)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                inner = dec
+                if isinstance(dec, ast.Call):
+                    # @functools.partial(jax.jit, ...) / @jax.jit(...)
+                    inner = dec.func
+                    if qual_matches(qualname(inner),
+                                    {"functools.partial", "partial"}):
+                        if dec.args and qual_matches(
+                                qualname(dec.args[0]), _TRACING_CALLEES):
+                            add(node)
+                        continue
+                if qual_matches(qualname(inner), _TRACING_CALLEES):
+                    add(node)
+        elif isinstance(node, ast.Call):
+            if not qual_matches(qualname(node.func), _TRACING_CALLEES):
+                continue
+            # any function-valued argument (incl. inside list literals,
+            # e.g. lax.switch branch lists) becomes a trace target
+            cands = list(node.args) + [kw.value for kw in node.keywords]
+            for arg in list(cands):
+                if isinstance(arg, (ast.List, ast.Tuple)):
+                    cands.extend(arg.elts)
+            for arg in cands:
+                if isinstance(arg, ast.Lambda):
+                    add(arg)
+                elif isinstance(arg, ast.Name) and arg.id in defs_by_name:
+                    for fn in defs_by_name[arg.id]:
+                        add(fn)
+    return targets
+
+
+class _TaintChecker:
+    def __init__(self, fn, path: str, findings: list[Finding]):
+        self.path = path
+        self.findings = findings
+        self.fn = fn
+        static = (decorator_static_params(fn)
+                  if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  else set())
+        self.tainted: set[str] = {p for p in func_params(fn)
+                                  if p not in static}
+
+    def is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            if qual_matches(qualname(node.func), _STATIC_CALLS):
+                return False
+            parts = ([node.func] if not isinstance(node.func, ast.Name)
+                     else [])
+            return any(self.is_tainted(a)
+                       for a in parts + list(node.args)
+                       + [kw.value for kw in node.keywords])
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.keyword)):
+                if self.is_tainted(child):
+                    return True
+        return False
+
+    def flag(self, node: ast.AST, what: str):
+        name = getattr(self.fn, "name", "<lambda>")
+        self.findings.append(Finding(
+            RULE, self.path, node.lineno, node.col_offset,
+            f"Python `{what}` on a traced value inside jit/vmap/shard_map "
+            f"target `{name}` — use lax.cond/lax.while_loop/jnp.where "
+            f"(or hoist the value to a static argument)"))
+
+    def run(self):
+        body = (self.fn.body if isinstance(self.fn.body, list)
+                else [ast.Expr(self.fn.body)])
+        self._stmts(body)
+
+    def _stmts(self, stmts):
+        for st in stmts:
+            self._stmt(st)
+
+    def _stmt(self, st: ast.stmt):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # nested defs are analyzed iff they are targets
+        if isinstance(st, ast.Assign):
+            t = self.is_tainted(st.value)
+            for tgt in st.targets:
+                for name in target_names(tgt):
+                    (self.tainted.add if t
+                     else self.tainted.discard)(name)
+        elif isinstance(st, ast.AugAssign):
+            if self.is_tainted(st.value) and isinstance(st.target, ast.Name):
+                self.tainted.add(st.target.id)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None and isinstance(st.target, ast.Name):
+                (self.tainted.add if self.is_tainted(st.value)
+                 else self.tainted.discard)(st.target.id)
+        elif isinstance(st, ast.If):
+            if self.is_tainted(st.test):
+                self.flag(st, "if")
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, ast.While):
+            if self.is_tainted(st.test):
+                self.flag(st, "while")
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, ast.Assert):
+            if self.is_tainted(st.test):
+                self.flag(st, "assert")
+        elif isinstance(st, ast.For):
+            if self.is_tainted(st.iter):
+                self.flag(st, "for")
+                for name in target_names(st.target):
+                    self.tainted.add(name)
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, (ast.With,)):
+            self._stmts(st.body)
+        elif isinstance(st, ast.Try):
+            self._stmts(st.body)
+            for h in st.handlers:
+                self._stmts(h.body)
+            self._stmts(st.orelse)
+            self._stmts(st.finalbody)
+
+
+def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
+    findings: list[Finding] = []
+    for fn in _collect_targets(tree):
+        _TaintChecker(fn, path, findings).run()
+    return findings

@@ -95,9 +95,16 @@ def sample_move(pa, key, slots,
     E = slots.shape[0]
     k_type, k_ev, k_slot = jax.random.split(key, 3)
     probs = jnp.array([p1, p2, p3], dtype=jnp.float32)
-    probs = probs / jnp.sum(probs)
-    mtype = jax.random.choice(k_type, 3, p=probs)
-    evs = jax.random.choice(k_ev, E, shape=(3,), replace=False)
+    # categorical + top_k of uniforms, NOT jax.random.choice: choice's
+    # replace=False path shuffles via an internal jit(_shuffle) whose
+    # sort escapes shard_map's manual sharding on JAX 0.4.x and emits
+    # cross-device all-reduces inside the per-island program — a CPU-
+    # backend collective deadlock (tt-analyze TT302; same hazard as the
+    # sweep shuffle). top_k over iid uniforms yields a uniformly random
+    # ORDERED triple of distinct events, exactly choice's semantics.
+    mtype = jax.random.categorical(k_type, jnp.log(probs))
+    evs = lax.top_k(jax.random.uniform(k_ev, (E,)), 3)[1].astype(
+        slots.dtype)
     t = jax.random.randint(k_slot, (), 0, pa.n_slots, dtype=slots.dtype)
 
     cur = slots[evs]                                   # (3,)

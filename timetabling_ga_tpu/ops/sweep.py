@@ -49,6 +49,7 @@ still span all events.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -261,9 +262,30 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
     n_steps = (K + B - 1) // B
 
     k_perm, k_tie, k_side, k_hot = jax.random.split(key, 4)
-    perm_keys = jax.random.split(k_perm, P)
-    perms = jax.vmap(
-        lambda k: jax.random.permutation(k, E).astype(jnp.int32))(perm_keys)
+    # Sort-free pseudo-shuffle: per-individual affine permutation
+    # j -> (a*j + b) mod E with a drawn from E's coprime residues (a
+    # trace-time constant table) and b uniform. NOT jax.random.
+    # permutation (or any argsort of random bits): a sort here sits
+    # inside the converge while_loop, whose trip count is legitimately
+    # per-island varying — and XLA's SPMD partitioner resolves the
+    # shuffle's sort under shard_map by replicating its operand with
+    # masked cross-device all-reduces, which (a) silently merge every
+    # island's shuffle into one stream and (b) DEADLOCK when islands'
+    # trip counts diverge (one device exits the loop, the other waits
+    # at the rendezvous forever — the round-1 CPU-backend hang;
+    # tt-analyze TT302). Elementwise arithmetic partitions locally, so
+    # nothing here can be turned into a collective. Affine perms span
+    # only E*phi(E) of E! orderings, but pivot-order decorrelation
+    # across passes is all the sweep needs (the reference uses ONE
+    # fixed order, Solution.cpp:508).
+    coprimes = jnp.asarray(
+        [a for a in range(1, max(E, 2)) if math.gcd(a, E) == 1],
+        dtype=jnp.int32)
+    k_pa, k_pb = jax.random.split(k_perm)
+    a = coprimes[jax.random.randint(k_pa, (P, 1), 0, coprimes.shape[0])]
+    b = jax.random.randint(k_pb, (P, 1), 0, E)
+    perms = ((a * jnp.arange(E, dtype=jnp.int32)[None, :] + b)
+             % E).astype(jnp.int32)
 
     if use_hot:
         heat = jax.vmap(lambda s, r, a, o, h: event_heat(
@@ -274,11 +296,31 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
         noise = jax.random.uniform(k_hot, heat.shape, maxval=0.9)
         hot_idx = lax.top_k(heat + noise, K)[1].astype(jnp.int32)
 
+    # Pivot blocks and Move2/3 partner windows are taken with scalar-
+    # start dynamic slices on wrap-padded copies, NOT index-array
+    # gathers (`pivots[:, idx]` with a traced idx): under shard_map,
+    # XLA's SPMD partitioner resolves a traced-index gather by
+    # REPLICATING the gathered operand across the mesh — masked
+    # all-reduces inside the per-island program that (a) silently merge
+    # every island's shuffle into one replicated permutation and (b)
+    # deadlock the CPU backend's collective rendezvous (tt-analyze
+    # TT302). Scalar-start dynamic slices partition cleanly; the padded
+    # copies reproduce the old modular wrap exactly.
+    pivots = hot_idx if use_hot else perms
+    # tile (period K) rather than a single concat: B may exceed 2*K in
+    # hot mode (--ls-block-events > 2*--ls-hot-k), where one wrap of
+    # padding is too narrow for the B-wide slice
+    reps_p = -(-(n_steps * B) // K)                        # static ceil
+    pivots_pad = jnp.tile(pivots, (1, reps_p))[:, :n_steps * B]
+    if swap_block > 0:
+        w_len = B - 1 + swap_block
+        reps = -(-(n_steps * B + swap_block) // E) + 1     # static ceil
+        perms_tiled = jnp.tile(perms, (1, reps))
+
     def step(st, pos):
         # block of B pivot positions (wraps at the tail when B ∤ K;
         # duplicate candidates are harmless — only one move is applied)
-        idx = (pos * B + jnp.arange(B)) % K                # (B,)
-        e_blk = (hot_idx if use_hot else perms)[:, idx]    # (P, B)
+        e_blk = lax.dynamic_slice_in_dim(pivots_pad, pos * B, B, axis=1)
 
         def per_e(e_i, s, r, att, occ):
             # Move1: all T targets
@@ -320,9 +362,15 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
             # those candidates are masked unacceptable (a self-swap's
             # duplicate event indices would corrupt _apply_move's
             # occupancy bookkeeping if ever accepted).
-            offs = (pos * B + jnp.arange(B)[:, None] + 1
-                    + jnp.arange(swap_block)[None, :]) % E  # (B, SB)
-            partners = perms[:, offs]                       # (P, B, SB)
+            # partner window [pos*B+1, pos*B+B-1+SB] of the wrapped
+            # permutation: one scalar-start dynamic slice, then static
+            # column slices — value-identical to the old modular gather
+            # offs = (pos*B + j + 1 + k) % E (see pivot-block comment)
+            window = lax.dynamic_slice_in_dim(
+                perms_tiled, pos * B + 1, w_len, axis=1)    # (P, w_len)
+            partners = jnp.stack(
+                [lax.slice_in_dim(window, j, j + swap_block, axis=1)
+                 for j in range(B)], axis=1)                # (P, B, SB)
             BIG = jnp.int32(1 << 20)
 
             def swap_one(e_i, q, s, r, att, occ):

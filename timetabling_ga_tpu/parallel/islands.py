@@ -37,7 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from timetabling_ga_tpu.compat import shard_map
 
 from timetabling_ga_tpu.ops import fitness, ga
 

@@ -325,6 +325,18 @@ _NEG_BOOL_FLAGS = {"--no-auto-tune": "auto_tune",
                    "--no-precompile": "precompile"}
 
 
+def _usage() -> str:
+    lines = ["usage: python -m timetabling_ga_tpu -i <instance.tim> "
+             "[flags]", "",
+             "reference-style flags (Control.cpp parsing model):"]
+    for flag, (field, typ) in _FLAG_MAP.items():
+        lines.append(f"  {flag} <{typ.__name__}>".ljust(28) + field)
+    for flag, field in {**_BOOL_FLAGS, **_NEG_BOOL_FLAGS}.items():
+        lines.append(f"  {flag}".ljust(28) + field)
+    lines.append("  -h, --help".ljust(28) + "show this message and exit")
+    return "\n".join(lines)
+
+
 def parse_args(argv) -> RunConfig:
     """Parse `-key value` pairs (Control.cpp:14-16 parsing model).
 
@@ -335,6 +347,11 @@ def parse_args(argv) -> RunConfig:
     i = 0
     while i < len(argv):
         a = argv[i]
+        if a in ("-h", "--help"):
+            # exit 0, like every CLI's help path — the smoke tier checks
+            # this runs with no device access (API-drift canary)
+            print(_usage())
+            raise SystemExit(0)
         if a in _BOOL_FLAGS:
             setattr(cfg, _BOOL_FLAGS[a], True)
             seen.add(_BOOL_FLAGS[a])

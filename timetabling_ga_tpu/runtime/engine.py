@@ -476,9 +476,13 @@ def precompile(cfg: RunConfig) -> None:
     sig = _shape_sig(problem)
 
     key = jax.random.key(0)
+    # one subkey per warm-up program: the compile calls' outputs are
+    # discarded, but reusing one key across consumers is exactly the
+    # pattern tt-analyze TT401 bans — the lint gate runs over this file
+    wk = jax.random.split(key, 6)
     gacfg_init = dataclasses.replace(gacfg, init_sweeps=0)
     state = cached_init(mesh, cfg.pop_size, gacfg_init,
-                        n_islands)(pa, key)
+                        n_islands)(pa, wk[0])
     jax.block_until_ready(state)
     # measure the endTry fetch cost (the packed single-round-trip
     # readback) so timed runs can reserve it out of the dispatch
@@ -526,7 +530,7 @@ def precompile(cfg: RunConfig) -> None:
         lkey = _lahc_key(mesh, gacfg_post, cfg.post_lahc,
                          cfg.post_lahc_k, fingerprint)
         ls0 = init_r(pa, state_for[gacfg_post])
-        ls1, stats0 = run_r(pa, key, ls0, 64)       # compile
+        ls1, stats0 = run_r(pa, wk[1], ls0, 64)     # compile
         # fences here MUST be data fetches, not block_until_ready: on
         # the tunneled device block_until_ready can acknowledge before
         # the computation completes (BASELINE.md round-5 fence audit),
@@ -551,7 +555,7 @@ def precompile(cfg: RunConfig) -> None:
         # block_until_ready, which can early-ack on the tunneled device
         # (BASELINE.md round-5 fence audit) — a near-zero sec/sweep
         # would size polish chunks past the budget
-        _fetch(polish(pa, key, state_for[g], 1)[1])
+        _fetch(polish(pa, wk[2], state_for[g], 1)[1])
         if not pwarm or g_spg_key not in _SPS_CACHE:
             t0 = time.monotonic()
             _fetch(polish(pa, jax.random.key(1), state_for[g], 1)[1])
@@ -566,7 +570,7 @@ def precompile(cfg: RunConfig) -> None:
     if (cfg.kick_stall > 0 and post_ga is not None
             and post_ga.pop_size >= 2):
         kicker, _ = cached_kick_runner(mesh, post_ga, sig, n_islands)
-        jax.block_until_ready(kicker(pa, key, state_for[post_ga], 3))
+        jax.block_until_ready(kicker(pa, wk[3], state_for[post_ga], 3))
     # static dispatches always run gens = migration_period (shorter
     # remainders go through the dynamic runner), at pow2 n_ep; compile
     # exactly those — for BOTH phase configs when a post-feasibility
@@ -585,7 +589,7 @@ def precompile(cfg: RunConfig) -> None:
         # shape; executing that shape to measure it is the bug)
         dyn, _ = cached_dynamic_runner(mesh, g, cfg.migration_period,
                                        sig, n_islands)
-        _fetch(dyn(pa, key, g_state, 1)[1])
+        _fetch(dyn(pa, wk[4], g_state, 1)[1])
         spg_est = _SPG_CACHE.get(g_spg_key)
         if spg_est is None:
             t0 = time.monotonic()
@@ -604,7 +608,7 @@ def precompile(cfg: RunConfig) -> None:
                 break
             runner, warm = cached_runner(mesh, g, n_ep, gens, sig,
                                          n_islands)
-            st2, tr2, _ = runner(pa, key, g_state)
+            st2, tr2, _ = runner(pa, wk[5], g_state)
             _fetch(tr2)
             if not warm:
                 # the timing call MUST differ from the compile call:
@@ -870,7 +874,12 @@ def _run_tries(cfg: RunConfig, out) -> int:
     for trial in range(cfg.tries):
         t_try = time.monotonic()   # per-try clock (beginTry, ga.cpp:163)
         key = jax.random.key(seed + trial)
-        k_init, key = jax.random.split(key)
+        # k_init and k_polish are SEPARATE subkeys: init folds island
+        # indices into its key and the polish loop folds chunk offsets
+        # into its key, so sharing one key makes fold_in(k, island=0)
+        # collide with fold_in(k, done=0) — correlated streams
+        # (tt-analyze TT401 caught the original shared-key version)
+        k_init, k_polish, key = jax.random.split(key, 3)
 
         gens_done = 0
         best_seen = None
@@ -925,7 +934,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
                 polish, pwarm = cached_polish_runner(mesh, gacfg, sig,
                                                      n_islands)
                 state, _ = _polish_chunks(
-                    out, cfg, pa, polish, state, k_init, t_try, reserve,
+                    out, cfg, pa, polish, state, k_polish, t_try, reserve,
                     _SPS_CACHE.get(spg_key), n_islands, best_seen,
                     trial, "polish", gacfg.init_sweeps,
                     gacfg.ls_sideways, pwarm, sps_cache_key=spg_key)

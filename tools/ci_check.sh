@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Pre-PR gate: run this before every commit that touches the package.
+#
+#   tools/ci_check.sh          # full gate: lint + compile + tier-1 tests
+#   tools/ci_check.sh --fast   # lint + compile only (seconds, not minutes)
+#
+# Steps (each failure is fatal):
+#   1. tt-analyze --strict over timetabling_ga_tpu/ — the JAX-aware
+#      static rules (tracer safety, recompile hazards, host syncs, RNG
+#      discipline, pinned API surface; README "Static analysis &
+#      sanitizers")
+#   2. python -m compileall — syntax across every tree we ship
+#   3. the tier-1 pytest command from ROADMAP.md
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+fail=0
+step() {
+    echo "== ci_check: $1" >&2
+}
+
+step "tt-analyze --strict timetabling_ga_tpu/"
+JAX_PLATFORMS=cpu python -m timetabling_ga_tpu.analysis --strict \
+    timetabling_ga_tpu/ || fail=1
+
+step "compileall"
+python -m compileall -q timetabling_ga_tpu tests tools bench.py || fail=1
+
+if [ "${1:-}" = "--fast" ]; then
+    [ "$fail" -eq 0 ] && step "OK (fast mode: tests skipped)"
+    exit $fail
+fi
+
+step "tier-1 pytest (ROADMAP.md)"
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+[ "$rc" -ne 0 ] && fail=1
+
+if [ "$fail" -eq 0 ]; then
+    step "OK"
+else
+    step "FAILED"
+fi
+exit $fail
