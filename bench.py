@@ -550,6 +550,114 @@ def measure_scale() -> dict:
             "ms_per_batch": round(P / rate * 1e3, 2)}
 
 
+def measure_pipeline(problem, pop: int = 1024, gens: int = 40) -> dict:
+    """ISSUE 2 tentpole leg: the engine's depth-2 asynchronous dispatch
+    pipeline, A/B against the strictly serial loop in the SAME session
+    (shared compile caches via precompile, identical seeds/shapes/keys).
+
+    Reported per mode: the generation loop's wall time (the engine's
+    `gen-loop` trace record), host gap per dispatch / per generation,
+    and device-busy fraction. Device time is taken from the SERIAL
+    leg's enqueue-to-fence dispatch brackets — the trusted measurement
+    path; the pipelined leg runs byte-identical device work (same
+    programs, same key sequence; `records_identical_modulo_timing`
+    asserts it from the JSONL protocol itself), so the serial bracket
+    is the right denominator for both. The pipelined host gap must sit
+    measurably below the serial one — that delta is the host I/O the
+    pipeline hides behind device compute."""
+    import dataclasses
+    import io
+    import json as _json
+    import tempfile
+
+    from timetabling_ga_tpu.problem import dump_tim
+    from timetabling_ga_tpu.runtime import engine, jsonl
+    from timetabling_ga_tpu.runtime.config import RunConfig
+
+    with tempfile.NamedTemporaryFile("w", suffix=".tim",
+                                     delete=False) as f:
+        f.write(dump_tim(problem))
+        tim = f.name
+    try:
+        base = RunConfig(input=tim, seed=1234, pop_size=pop, islands=1,
+                         generations=gens, migration_period=5,
+                         epochs_per_dispatch=1, ls_mode="sweep",
+                         ls_sweeps=1, init_sweeps=0,
+                         time_limit=100000.0, auto_tune=False,
+                         trace=True)
+        engine.precompile(base)
+        # both legs start from the SAME sec/gen estimate: the serial
+        # leg's EWMA updates land in the shared _SPG_CACHE and could
+        # otherwise push the pipelined leg's dispatch sizing across a
+        # pow2/watchdog threshold — a different shape sequence means
+        # different key splits and records_identical=False for a
+        # timing reason, not a pipelining one. (With this config n_ep
+        # is pinned at 1 and the budget unbounded, so sizing thresholds
+        # stay out of play; the identity field REPORTS the comparison
+        # rather than assuming it.)
+        spg_snapshot = dict(engine._SPG_CACHE)
+
+        def leg(pipeline):
+            engine._SPG_CACHE.clear()
+            engine._SPG_CACHE.update(spg_snapshot)
+            cfg = dataclasses.replace(base, pipeline=pipeline)
+            buf = io.StringIO()
+            best = engine.run(cfg, out=buf)
+            lines = [_json.loads(x) for x in
+                     buf.getvalue().splitlines()]
+            disp = [x["phase"] for x in lines
+                    if "phase" in x and x["phase"]["name"] == "dispatch"]
+            loop = [x["phase"] for x in lines
+                    if "phase" in x and x["phase"]["name"] == "gen-loop"]
+            return {"best": best, "loop_s": loop[0]["seconds"],
+                    "dispatches": loop[0]["dispatches"],
+                    "active": loop[0]["pipelined"],
+                    "disp_s": sum(d["seconds"] for d in disp),
+                    "gens": sum(d["gens"] for d in disp),
+                    "recs": jsonl.strip_timing(lines)}
+
+        serial = leg(False)
+        piped = leg(True)
+    finally:
+        os.unlink(tim)
+    device_s = serial["disp_s"]
+    nd, gens = serial["dispatches"], serial["gens"]
+    gap_s = serial["loop_s"] - device_s
+    # the serial bracket includes per-dispatch fetch overhead the
+    # pipeline hides entirely, and device time varies a few percent
+    # between the two runs — a fully-hidden host gap can therefore
+    # compute slightly NEGATIVE; clamp to 0 (the magnitude lives in
+    # loop_speedup / the loop_s pair)
+    gap_p = max(0.0, piped["loop_s"] - device_s)
+    out = {
+        "pop": pop, "gens": gens, "dispatches": nd,
+        "pipelined_active": bool(piped["active"]),
+        "serial_loop_s": round(serial["loop_s"], 3),
+        "pipelined_loop_s": round(piped["loop_s"], 3),
+        "device_s_serial_bracket": round(device_s, 3),
+        "host_gap_ms_per_dispatch_serial": round(gap_s / nd * 1e3, 3),
+        "host_gap_ms_per_dispatch_pipelined": round(gap_p / nd * 1e3, 3),
+        "host_gap_ms_per_gen_serial": round(gap_s / gens * 1e3, 3),
+        "host_gap_ms_per_gen_pipelined": round(gap_p / gens * 1e3, 3),
+        "device_busy_frac_serial":
+            round(min(1.0, device_s / serial["loop_s"]), 4),
+        "device_busy_frac_pipelined":
+            round(min(1.0, device_s / piped["loop_s"]), 4),
+        "loop_speedup": round(serial["loop_s"] / piped["loop_s"], 4),
+        "records_identical_modulo_timing":
+            serial["recs"] == piped["recs"],
+    }
+    print(f"# pipeline A/B (pop {pop}, {nd} dispatches, {gens} gens): "
+          f"serial loop {serial['loop_s']:.3f}s vs pipelined "
+          f"{piped['loop_s']:.3f}s (x{out['loop_speedup']}); host gap "
+          f"{out['host_gap_ms_per_gen_serial']} -> "
+          f"{out['host_gap_ms_per_gen_pipelined']} ms/gen; device busy "
+          f"{out['device_busy_frac_serial']:.1%} -> "
+          f"{out['device_busy_frac_pipelined']:.1%}; records identical="
+          f"{out['records_identical_modulo_timing']}", file=sys.stderr)
+    return out
+
+
 def measure_ls_shootout(problem) -> dict:
     """VERDICT item 2: systematic sweep vs K-random local search, equal
     wall clock, same start population. Reports mean penalty reached —
@@ -605,12 +713,12 @@ def main() -> None:
     problem = _instance()
     # retry the headline through device sick windows (shared policy,
     # timetabling_ga_tpu/runtime/retry.py) instead of zeroing the round
-    from timetabling_ga_tpu.runtime.retry import retry_unavailable
-    tpu = retry_unavailable(measure_tpu_evals, problem)
+    from timetabling_ga_tpu.runtime.retry import retry_transient
+    tpu, tpu_attempts = retry_transient(measure_tpu_evals, problem)
     cpu = measure_cpu_native(problem)
     vs_baseline = tpu / cpu if cpu > 0 else 0.0
 
-    extra = {}
+    extra = {"headline_attempts": tpu_attempts}
     for name, fn in (
             ("generation_scan", lambda: measure_generation(problem, "scan")),
             ("generation_parallel",
@@ -629,15 +737,24 @@ def main() -> None:
             ("lahc_chain", lambda: measure_lahc_chain(problem)),
             ("kernel_cost",
              lambda: measure_kernel_cost(problem, tpu)),
+            ("pipeline", lambda: measure_pipeline(problem)),
             ("scale_2000ev", measure_scale),
             ("ls_shootout", lambda: measure_ls_shootout(problem)),
             ("ls_shootout_feasible",
              lambda: measure_ls_shootout_feasible(problem))):
+        # every leg retries through transient tunnel windows (the
+        # BENCH_r05 scale_2000ev 'response body closed' failure class)
+        # instead of poisoning the round; attempts land in the leg JSON
         try:
-            extra[name] = fn()
+            result, attempts = retry_transient(fn, attempts=3,
+                                               wait_s=60.0)
+            if isinstance(result, dict):
+                result["attempts"] = attempts
+            extra[name] = result
         except Exception as e:  # pragma: no cover - defensive
             print(f"# {name} failed: {e}", file=sys.stderr)
-            extra[name] = {"error": str(e)[:200]}
+            extra[name] = {"error": str(e)[:200],
+                           "attempts": getattr(e, "tt_attempts", 1)}
     extra["cpu_native_evals_per_sec"] = round(cpu, 1)
     extra["cpu_threads"] = os.cpu_count() or 1
     # honesty note (VERDICT round-2 weak 5): the denominator runs on

@@ -1,0 +1,67 @@
+"""TT203 fixture: donated-buffer reuse.
+
+Not imported or executed — parsed by tests/test_analysis.py. Donation
+deletes the input buffer at dispatch; every read below the donating
+call is a runtime `Array has been deleted` waiting for the backend
+that enforces it.
+"""
+import functools
+
+import jax
+
+
+def _step(pa, key, state):
+    return state
+
+
+runner = jax.jit(_step, donate_argnums=(2,))
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def polish(pa, state):
+    return state
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def kick(pa, key, state):
+    return state
+
+
+def read_after_donate(pa, key, state):
+    out = runner(pa, key, state)
+    best = state.penalty            # EXPECT TT203 (donated, then read)
+    return out, best
+
+
+def read_in_later_call(pa, state):
+    new = polish(pa, state)
+    return new, polish(pa, state)   # EXPECT TT203 (donated, reused)
+
+
+def argnames_resolve_positionally(pa, key, state):
+    out = kick(pa, key, state)
+    return out + state              # EXPECT TT203 (donate_argnames)
+
+
+def _step2(pa, state):
+    return state
+
+
+sweeper = jax.jit(_step2, donate_argnames=("state",))
+
+
+def argnames_assignment_form(pa, state):
+    out = sweeper(pa, state)
+    return out, state.rooms         # EXPECT TT203 (argnames via assign)
+
+
+def rebind_is_clean(pa, key_a, key_b, state):
+    state = runner(pa, key_a, state)  # OK: donate + rebind, one statement
+    state = runner(pa, key_b, state)  # OK: consumes the previous output
+    return state.penalty              # OK: reads the live output
+
+
+def clone_before_donate(pa, key, state):
+    import jax.numpy as jnp
+    probe = runner(pa, key, jax.tree.map(jnp.copy, state))
+    return probe, state.penalty     # OK: the clone was donated, not state

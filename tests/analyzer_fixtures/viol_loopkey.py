@@ -1,0 +1,63 @@
+"""TT402 fixture: loop-carried PRNG key reuse.
+
+Not imported or executed — parsed by tests/test_analysis.py. Each
+violation is ONE call site (so TT401's per-site model stays silent)
+that consumes the same key on every `for` iteration.
+"""
+import jax
+
+
+def restart_loop(pa, key, n):
+    outs = []
+    for _ in range(n):
+        outs.append(jax.random.normal(key, (4,)))   # EXPECT TT402
+    return outs
+
+
+def fold_constant_loop(key, n):
+    outs = []
+    for _ in range(n):
+        k = jax.random.fold_in(key, 7)              # EXPECT TT402
+        outs.append(jax.random.normal(k, (2,)))
+    return outs
+
+
+def unchained_split_loop(key, items):
+    outs = []
+    for it in items:
+        ks = jax.random.split(key, 4)               # EXPECT TT402
+        outs.append(ks[0])
+        _ = it
+    return outs
+
+
+def fold_on_index_ok(key, n):
+    outs = []
+    for i in range(n):
+        k = jax.random.fold_in(key, i)        # OK: loop-indexed stream
+        outs.append(jax.random.normal(k, (2,)))
+    return outs
+
+
+def fold_on_derived_ok(key, n):
+    outs = []
+    for i in range(n):
+        step = i * 2 + 1              # derived from the loop variable
+        k = jax.random.fold_in(key, step)   # OK: varies per iteration
+        outs.append(jax.random.normal(k, (2,)))
+    return outs
+
+
+def chained_rebind_ok(key, n):
+    outs = []
+    for _ in range(n):
+        key, k = jax.random.split(key)        # OK: the chain advances
+        outs.append(jax.random.normal(k, (2,)))
+    return outs
+
+
+def loop_target_is_fresh_ok(key, n):
+    outs = []
+    for key in jax.random.split(key, n):      # OK: target varies per
+        outs.append(jax.random.normal(key, (2,)))   # iteration
+    return outs

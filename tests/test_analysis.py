@@ -57,9 +57,11 @@ def analyze_fixture(fixture: str):
 @pytest.mark.parametrize("fixture", [
     "viol_trace.py",       # TT101 tracer-unsafe control flow
     "viol_recompile.py",   # TT201/TT202 recompile hazards
+    "viol_donate.py",      # TT203 donated-buffer reuse
     "viol_sync.py",        # TT301 hidden host syncs
     "viol_collective.py",  # TT302 collective-bearing random ops
     "viol_rng.py",         # TT401 RNG key reuse
+    "viol_loopkey.py",     # TT402 loop-carried key reuse
     "viol_api.py",         # TT501 pinned API surface
 ])
 def test_rule_fires_at_expected_lines(fixture):

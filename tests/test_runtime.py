@@ -707,6 +707,182 @@ def test_post_pop_size_elite_shrink(tim_file):
     assert best == min(s["totalBest"] for s in sols)
 
 
+# ---------------------------------------------------- dispatch pipeline
+
+def test_pipeline_depth2_matches_serial(tim_file, tmp_path):
+    """Tier-1 pipeline determinism (fast, single device, 3 chunks): the
+    depth-2 pipelined engine must emit protocol records byte-identical
+    to the serial engine's modulo timing fields — pipelining reorders
+    WHEN telemetry is processed, never WHAT is dispatched — and the
+    checkpoint written mid-pipeline (a control fence on the in-flight
+    chunk + writer-thread serialization) must land on disk."""
+    from timetabling_ga_tpu.runtime import engine as eng
+    ck = str(tmp_path / "pipe.ck.npz")
+
+    def go(pipeline, checkpoint=None):
+        buf = io.StringIO()
+        cfg = RunConfig(input=tim_file, seed=3, pop_size=8, islands=1,
+                        generations=30, migration_period=10,
+                        max_steps=8, time_limit=300, backend="cpu",
+                        auto_tune=False, trace=True, pipeline=pipeline,
+                        checkpoint=checkpoint)
+        best = eng.run(cfg, out=buf)
+        return best, [json.loads(x) for x in buf.getvalue().splitlines()]
+
+    b_serial, l_serial = go(False)
+    b_piped, l_piped = go(True, checkpoint=ck)
+    assert b_serial == b_piped
+    assert jsonl.strip_timing(l_serial) == jsonl.strip_timing(l_piped)
+    # the pipelined leg really ran pipelined, depth 2 over 3 chunks
+    loops = [x["phase"] for x in l_piped
+             if "phase" in x and x["phase"]["name"] == "gen-loop"]
+    assert loops and loops[0]["pipelined"] is True
+    assert loops[0]["dispatches"] == 3
+    loops0 = [x["phase"] for x in l_serial
+              if "phase" in x and x["phase"]["name"] == "gen-loop"]
+    assert loops0 and loops0[0]["pipelined"] is False
+    # mid-pipeline checkpoint is durable and loadable
+    assert os.path.exists(ck)
+    with np.load(ck, allow_pickle=False) as z:
+        assert int(z["generation"]) == 30
+
+
+def test_pipeline_auto_disables_on_control_paths(tim_file):
+    """A post config makes the phase switch a between-dispatch CONTROL
+    read, so the engine must fall back to the serial loop even with
+    pipeline=True (module docstring's control-vs-telemetry rule)."""
+    from timetabling_ga_tpu.runtime import engine as eng
+    buf = io.StringIO()
+    cfg = RunConfig(input=tim_file, seed=3, pop_size=8, islands=1,
+                    generations=20, migration_period=10,
+                    ls_mode="sweep", ls_sweeps=1, init_sweeps=0,
+                    post_ls_sweeps=2, max_steps=8, time_limit=300,
+                    backend="cpu", auto_tune=False, trace=True,
+                    pipeline=True)
+    eng.run(cfg, out=buf)
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    loops = [x["phase"] for x in lines
+             if "phase" in x and x["phase"]["name"] == "gen-loop"]
+    assert loops and loops[0]["pipelined"] is False
+
+
+def test_async_writer_order_jobs_and_error_propagation():
+    """jsonl.AsyncWriter: record order is preserved, submitted jobs run
+    in queue order, close() drains, and a worker-side write error
+    surfaces on the main thread instead of vanishing."""
+    buf = io.StringIO()
+    w = jsonl.AsyncWriter(buf)
+    for i in range(200):
+        jsonl.log_entry(w, 0, 0, 10_000 - i, 0.5)
+    ran = []
+    w.submit(lambda: ran.append(len(buf.getvalue().splitlines())))
+    jsonl.run_entry(w, 1, True)
+    w.close()
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    assert len(lines) == 201
+    bests = [x["logEntry"]["best"] for x in lines if "logEntry" in x]
+    assert bests == sorted(bests, reverse=True)   # FIFO order held
+    assert ran == [200]          # job saw every earlier record flushed
+    assert "runEntry" in lines[-1]
+
+    class _Boom(io.StringIO):
+        def write(self, s):
+            raise IOError("disk full")
+
+    w2 = jsonl.AsyncWriter(_Boom())
+    w2.write("{}\n")
+    with pytest.raises(IOError):
+        w2.close()
+    w2.close()           # idempotent: no deadlock on a second close
+    with pytest.raises(RuntimeError):
+        w2.write("{}\n")   # records must never be silently dropped
+    # close(raise_error=False): the exception-path form swallows the
+    # worker error instead of masking the run's own failure
+    w3 = jsonl.AsyncWriter(_Boom())
+    w3.write("{}\n")
+    w3.close(raise_error=False)
+
+
+@pytest.mark.slow
+def test_checkpoint_survives_sigkill_and_jsonl_stays_line_atomic(
+        tim_file, tmp_path):
+    """ISSUE 2 satellite: kill the run mid-stream (SIGKILL — no atexit,
+    no drain) and assert (a) the last fsynced checkpoint round-trips
+    through _reshard_state bit-exact, and (b) the JSONL output holds
+    only whole records — the writer thread hands each record to the OS
+    in one write, so a kill can truncate at most the final line."""
+    import signal
+    import subprocess
+    import sys as _sys
+    import time as _time
+    from timetabling_ga_tpu.parallel import islands as isl
+    from timetabling_ga_tpu.runtime import engine as eng
+    ck = str(tmp_path / "kill.ck.npz")
+    outfile = str(tmp_path / "kill.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    args = [_sys.executable, "-m", "timetabling_ga_tpu.cli",
+            "-i", tim_file, "-s", "5", "--backend", "cpu",
+            "--pop-size", "8", "--islands", "2",
+            "--generations", "1000000", "--migration-period", "5",
+            "--no-auto-tune", "--no-precompile", "-m", "8",
+            "-t", "100000", "--checkpoint", ck,
+            "--checkpoint-every", "1", "-o", outfile]
+    proc = subprocess.Popen(args, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+    try:
+        deadline = _time.monotonic() + 240
+        # let it checkpoint at least twice so the kill lands mid-stream,
+        # beyond the first save
+        saves = 0
+        last_mtime = None
+        while _time.monotonic() < deadline and saves < 2:
+            if os.path.exists(ck):
+                m = os.path.getmtime(ck)
+                if m != last_mtime:
+                    saves += 1
+                    last_mtime = m
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "run exited early: "
+                    + proc.stderr.read().decode()[-2000:])
+            _time.sleep(0.05)
+        assert saves >= 2, "never reached a second checkpoint"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=60)
+
+    # (a) checkpoint integrity: load -> reshard onto a live mesh ->
+    # fetch back, bit-exact against the file's own arrays
+    with np.load(ck, allow_pickle=False) as z:
+        fp = str(z["fingerprint"])
+        saved = {k: np.array(z[k]) for k in
+                 ("slots", "rooms", "penalty", "hcv", "scv")}
+    state, key, gen, best_seen, seed = ckpt.load(ck, fp)
+    assert gen >= 1 and seed == 5 and best_seen is not None
+    mesh = isl.make_mesh(2)
+    resharded = eng._reshard_state(state, mesh)
+    for name, arr in saved.items():
+        np.testing.assert_array_equal(
+            np.asarray(getattr(resharded, name)), arr,
+            err_msg=f"{name} not bit-exact through _reshard_state")
+
+    # (b) line atomicity: every line but (at most) the torn final one
+    # parses as exactly one record
+    with open(outfile) as fh:
+        raw = fh.read()
+    lines = raw.splitlines()
+    if lines and not raw.endswith("\n"):
+        lines = lines[:-1]          # a SIGKILL may tear the final line
+    assert lines, "no JSONL output before the kill"
+    for ln in lines:
+        rec = json.loads(ln)        # no spliced/interleaved records
+        assert len(rec) == 1
+
+
 def test_post_pop_size_flag_validation():
     with pytest.raises(SystemExit):
         parse_args(["-i", "x.tim", "--post-pop-size", "4",

@@ -8,11 +8,15 @@ Rules (see README "Static analysis & sanitizers"):
   TT101  tracer-unsafe control flow in jit/vmap/shard_map/scan targets
   TT201  jax.jit static arguments receiving unhashable/run-varying values
   TT202  compile-cache dict keys omitting a value the program closes over
+  TT203  donated-buffer reuse (donate_argnums args read after the
+         jitted call — the buffer is deleted at dispatch)
   TT301  hidden host-device syncs inside dispatch loops
   TT302  collective-bearing random ops (permutation/shuffle/choice) in
          shard_map-executed code — replicated-sort all-reduces that
          merge island RNG streams and deadlock varying while_loops
   TT401  PRNG key reuse (two consumers, no split/fold_in between)
+  TT402  loop-carried key reuse (one call site consuming the same key
+         across `for` iterations without fold_in on the loop index)
   TT501  JAX imports outside the pinned compatibility table (compat.py)
 
 Suppress one finding inline with `# tt-analyze: ignore[TT301]` (on the
@@ -49,14 +53,17 @@ class _Context:
 
 def _rule_modules():
     from timetabling_ga_tpu.analysis import (
-        rules_api, rules_recompile, rules_rng, rules_sync, rules_trace)
+        rules_api, rules_donate, rules_recompile, rules_rng, rules_sync,
+        rules_trace)
     return {
         "TT101": rules_trace,
         "TT201": rules_recompile,
         "TT202": rules_recompile,
+        "TT203": rules_donate,
         "TT301": rules_sync,
         "TT302": rules_sync,
         "TT401": rules_rng,
+        "TT402": rules_rng,
         "TT501": rules_api,
     }
 

@@ -1,0 +1,213 @@
+"""TT203 — donated-buffer reuse.
+
+`jax.jit(f, donate_argnums=...)` DELETES the donated input buffers at
+dispatch so XLA can alias them into the outputs (the engine's
+population states ride this between dispatches). Reading a donated
+array afterwards raises `Array has been deleted` — but only at runtime,
+only on backends that implement donation, and only on the code path
+that actually reuses it; the canonical failure is code that passes
+tests on one backend and dies on the device.
+
+The analysis is a linear per-function scan, like TT401's:
+
+  - donating callables are seeded from `g = jax.jit(f, donate_argnums=
+    (2,))` assignments and `@jax.jit(donate_argnums=...)` /
+    `@functools.partial(jax.jit, donate_argnums=...)` decorated
+    functions; `donate_argnames` resolve to positions through the
+    wrapped function's parameter list (the decorated def, or `f`'s def
+    when the assignment form wraps a function of this module);
+  - at a call site of a donating callable, every bare-Name positional
+    argument in a donated slot becomes DEAD;
+  - any later load of a dead name — including attribute reads like
+    `state.penalty` — flags, until an assignment rebinds it (so the
+    engine's `state = runner(pa, k, state)` pattern, which donates and
+    rebinds in one statement, is clean by construction).
+
+Interprocedural donation (a runner built by a factory in another module
+— the engine's `cached_*` programs) is invisible here by design; that
+is the TT303 device-taint work (ROADMAP). This rule is the local guard
+that keeps the donation discipline honest where the jit is in view.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from timetabling_ga_tpu.analysis.core import (
+    Finding, func_params, qual_matches, qualname, target_names)
+
+RULE = "TT203"
+
+_JIT_NAMES = {"jax.jit", "jit"}
+
+
+def _donate_spec(call: ast.Call):
+    """(donated_argnums, donated_argnames) declared by a jit-ish call,
+    or None when it donates nothing."""
+    nums, names = [], []
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.append(n.value)
+        elif kw.arg == "donate_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.append(n.value)
+    return (nums, names) if (nums or names) else None
+
+
+def _collect_donators(tree: ast.Module) -> dict[str, list[int]]:
+    """name -> donated positional indices, for every donating callable
+    visible at module scope or bound by assignment anywhere."""
+    donators: dict[str, list[int]] = {}
+    # parameter lists of every visible function def, so donate_argnames
+    # resolve to positions in BOTH forms — the decorator form (via the
+    # decorated def itself) and the assignment form `g = jax.jit(f,
+    # donate_argnames=...)` (via f's def, when it is in this module)
+    fn_params = {n.name: func_params(n) for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for node in ast.walk(tree):
+        # g = jax.jit(f, donate_argnums=(2,) / donate_argnames=(...))
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if qual_matches(qualname(call.func), _JIT_NAMES):
+                spec = _donate_spec(call)
+                if spec:
+                    nums = list(spec[0])
+                    wrapped = (qualname(call.args[0])
+                               if call.args else None)
+                    params = fn_params.get((wrapped or "").rsplit(
+                        ".", 1)[-1], [])
+                    for pname in spec[1]:
+                        if pname in params:
+                            nums.append(params.index(pname))
+                    if nums:
+                        for tgt in node.targets:
+                            for name in target_names(tgt):
+                                donators[name] = sorted(set(nums))
+        # @jax.jit(donate_argnums=...) / @partial(jax.jit, donate_...)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                is_jit = qual_matches(qualname(dec.func), _JIT_NAMES)
+                is_partial_jit = (
+                    qual_matches(qualname(dec.func),
+                                 {"functools.partial", "partial"})
+                    and dec.args
+                    and qual_matches(qualname(dec.args[0]), _JIT_NAMES))
+                if not (is_jit or is_partial_jit):
+                    continue
+                spec = _donate_spec(dec)
+                if not spec:
+                    continue
+                nums = list(spec[0])
+                params = func_params(node)
+                for pname in spec[1]:
+                    if pname in params:
+                        nums.append(params.index(pname))
+                if nums:
+                    donators[node.name] = sorted(set(nums))
+    return donators
+
+
+class _Scan:
+    """Linear statement walk of one scope: donated names die at the
+    donating call, revive on rebind, and flag on any read in between."""
+
+    def __init__(self, fn, path, donators, findings):
+        self.fn = fn
+        self.path = path
+        self.donators = donators
+        self.findings = findings
+        self.dead: dict[str, int] = {}   # name -> donating call lineno
+
+    def _flag(self, node, name):
+        self.findings.append(Finding(
+            RULE, self.path, node.lineno, node.col_offset,
+            f"`{name}` was donated to a jitted call on line "
+            f"{self.dead[name]} (donate_argnums) and read again — the "
+            f"donated buffer is deleted at dispatch; use the call's "
+            f"output or clone before donating"))
+
+    def _check_reads(self, node: ast.AST):
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
+                    and sub.id in self.dead):
+                self._flag(sub, sub.id)
+                # one report per death: rebirth via flag keeps a single
+                # misuse from cascading into a finding per read
+                del self.dead[sub.id]
+
+    def _handle_donations(self, node: ast.AST):
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            qn = qualname(sub.func)
+            name = qn.rsplit(".", 1)[-1] if qn else None
+            positions = self.donators.get(name)
+            if not positions:
+                continue
+            for pos in positions:
+                if pos < len(sub.args) and isinstance(sub.args[pos],
+                                                      ast.Name):
+                    self.dead[sub.args[pos].id] = sub.lineno
+
+    def _stmt(self, st):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # nested scopes are scanned separately
+        if isinstance(st, ast.Assign):
+            self._check_reads(st.value)
+            self._handle_donations(st.value)
+            for tgt in st.targets:
+                for name in target_names(tgt):
+                    self.dead.pop(name, None)   # rebind revives
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign, ast.Expr,
+                             ast.Return, ast.Raise, ast.Assert)):
+            val = getattr(st, "value", None) or getattr(st, "test", None)
+            if val is not None:
+                self._check_reads(val)
+                self._handle_donations(val)
+        elif isinstance(st, (ast.If, ast.While)):
+            self._check_reads(st.test)
+            self._handle_donations(st.test)
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, ast.For):
+            self._check_reads(st.iter)
+            self._handle_donations(st.iter)
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, ast.With):
+            for item in st.items:
+                self._check_reads(item.context_expr)
+                self._handle_donations(item.context_expr)
+            self._stmts(st.body)
+        elif isinstance(st, ast.Try):
+            self._stmts(st.body)
+            for h in st.handlers:
+                self._stmts(h.body)
+            self._stmts(st.orelse)
+            self._stmts(st.finalbody)
+
+    def _stmts(self, stmts):
+        for st in stmts:
+            self._stmt(st)
+
+    def run(self):
+        self._stmts(self.fn.body if isinstance(self.fn.body, list) else [])
+
+
+def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
+    donators = _collect_donators(tree)
+    if not donators:
+        return []
+    findings: list[Finding] = []
+    scopes = [tree] + [n for n in ast.walk(tree)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+    for scope in scopes:
+        _Scan(scope, path, donators, findings).run()
+    return findings

@@ -1,9 +1,9 @@
-"""TT401 — PRNG key reuse.
+"""TT401 / TT402 — PRNG key discipline.
 
-A JAX PRNG key passed to two consumers without an intervening
-`jax.random.split` / `fold_in` gives both consumers IDENTICAL
-randomness — island populations that mirror each other, mutation
-streams that repeat — with no runtime error to catch it.
+TT401 — key reuse. A JAX PRNG key passed to two consumers without an
+intervening `jax.random.split` / `fold_in` gives both consumers
+IDENTICAL randomness — island populations that mirror each other,
+mutation streams that repeat — with no runtime error to catch it.
 
 The analysis is a linear per-function scan. Key names are seeded from
 `jax.random.key/PRNGKey/split/fold_in` results and key-looking
@@ -15,6 +15,17 @@ fold_in sites folding the SAME literal constant collide and flag.
 Subscripts of split-produced key arrays (`keys[3]`) are tracked per
 literal index. Callees in `rng_exempt_callees` (checkpoint writers)
 receive keys without consuming randomness.
+
+TT402 — loop-carried key reuse: the blind spot TT401's per-site model
+leaves open. ONE call site consuming the same key name across `for`
+iterations executes many times, but is a single site, so TT401 never
+fires — yet every iteration draws identical randomness (N "independent"
+restarts that are all the same restart). Sanctioned forms: the key is
+rebound inside the loop body by a split/fold_in chain (`key, k =
+split(key)`), or the consumption is `fold_in(key, i)` with data that
+depends on a loop variable. Only bare key NAMES are tracked — warm-up
+code deliberately replaying a subkey array element (`wk[4]`) across
+config variants is compile warm-up, not a randomness bug.
 """
 
 from __future__ import annotations
@@ -181,6 +192,13 @@ class _Scan:
             self._stmts(st.orelse)
         elif isinstance(st, ast.For):
             self._visit_calls(st.iter, set())
+            if (isinstance(st.iter, ast.Call)
+                    and _rng_call_kind(st.iter) is not None):
+                # `for key in jax.random.split(key, n):` — the target
+                # is a fresh subkey every iteration; treat it as a
+                # rebind so body consumption does not read as reuse
+                for name in target_names(st.target):
+                    self._bind(name)
             self._stmts(st.body)
             self._stmts(st.orelse)
         elif isinstance(st, ast.With):
@@ -197,11 +215,122 @@ class _Scan:
             self._visit_calls(st.test, set())
 
 
+RULE_LOOP = "TT402"
+
+
+def _scope_key_names(scope, ctx) -> set[str]:
+    """Key-looking names in one scope: parameters matching the
+    configured pattern plus names bound from rng make/split/fold_in
+    calls (same seeding as TT401's scan, without the linear state)."""
+    param_re = re.compile(ctx.config.rng_param_pattern)
+    names = {p for p in (func_params(scope)
+                         if not isinstance(scope, ast.Module) else [])
+             if param_re.search(p)}
+    for node in _scope_walk(scope):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _rng_call_kind(node.value) is not None):
+            for tgt in node.targets:
+                names |= set(target_names(tgt))
+    return names
+
+
+def _scope_walk(scope):
+    """Walk a scope's nodes without descending into nested functions
+    (they are their own scopes)."""
+    todo = list(ast.iter_child_nodes(scope))
+    while todo:
+        node = todo.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            todo.extend(ast.iter_child_nodes(node))
+
+
+def _names_under(node) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _check_loop_keys(scope, path, ctx, findings):
+    keys = _scope_key_names(scope, ctx)
+    if not keys:
+        return
+    exempt = set(ctx.config.rng_exempt_callees)
+    for loop in _scope_walk(scope):
+        if not isinstance(loop, ast.For):
+            continue
+        loop_vars = set(target_names(loop.target))
+        # keys the body rebinds from an rng chain are sanctioned: every
+        # iteration advances the stream before consuming it
+        rebound: set[str] = set()
+        for node in ast.walk(loop):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and _rng_call_kind(node.value) is not None):
+                for tgt in node.targets:
+                    rebound |= set(target_names(tgt))
+        # names DERIVED from a loop variable (`step = i * 2 + 1`) vary
+        # per iteration just like the loop variable itself: fold_in on
+        # one is the sanctioned pattern too. Transitive closure over
+        # the body's assignments, to a fixpoint.
+        derived = set(loop_vars)
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(loop):
+                if (isinstance(node, ast.Assign)
+                        and _names_under(node.value) & derived):
+                    for tgt in node.targets:
+                        for nm in target_names(tgt):
+                            if nm not in derived:
+                                derived.add(nm)
+                                changed = True
+        flagged: set[str] = set()
+        for call in ast.walk(loop):
+            if not isinstance(call, ast.Call):
+                continue
+            kind = _rng_call_kind(call)
+            if kind == "make":
+                continue              # fresh key construction
+            qn = qualname(call.func) or ""
+            if kind is None and qn.rsplit(".", 1)[-1] in exempt:
+                continue
+            args = list(call.args) + [kw.value for kw in call.keywords]
+            for i, arg in enumerate(args):
+                if not (isinstance(arg, ast.Name) and arg.id in keys):
+                    continue
+                name = arg.id
+                if name in rebound or name in flagged:
+                    continue
+                if name in loop_vars:
+                    # `for key in jax.random.split(key, n):` — the loop
+                    # target is a fresh value every iteration by
+                    # construction
+                    continue
+                if kind == "fold_in" and i == 0:
+                    data = args[1] if len(args) > 1 else None
+                    if data is not None and (_names_under(data)
+                                             & derived):
+                        continue      # fold_in on the loop index (or a
+                        #               value derived from it): THE
+                        #               sanctioned pattern
+                flagged.add(name)
+                findings.append(Finding(
+                    RULE_LOOP, path, call.lineno, call.col_offset,
+                    f"PRNG key `{name}` consumed at this site on every "
+                    f"iteration of the enclosing `for` loop without "
+                    f"fold_in on the loop variable or a split rebind — "
+                    f"each iteration draws identical randomness"))
+
+
 def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
     findings: list[Finding] = []
     scopes = [tree] + [n for n in ast.walk(tree)
                        if isinstance(n, (ast.FunctionDef,
                                          ast.AsyncFunctionDef))]
     for scope in scopes:
-        _Scan(scope, path, ctx, findings).run()
+        if "TT401" in ctx.config.rules:
+            _Scan(scope, path, ctx, findings).run()
+        if "TT402" in ctx.config.rules:
+            _check_loop_keys(scope, path, ctx, findings)
     return findings

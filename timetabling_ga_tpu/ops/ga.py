@@ -88,7 +88,16 @@ class GAConfig:
 class PopState(NamedTuple):
     """Device-resident population: the dense replacement for the
     reference's `Solution* pop[]` (ga.cpp:60). Sorted by penalty
-    ascending after every generation (best first, like ga.cpp:583)."""
+    ascending after every generation (best first, like ga.cpp:583).
+
+    Buffer lifetime: the engine's cached runners are jitted with
+    `donate_argnums` on their PopState argument (parallel/islands.py
+    `_donate`), so a state handed to a dispatch is CONSUMED — its
+    buffers are deleted and aliased into the output. Treat every
+    dispatched state as moved-from: read the returned state, or clone
+    first (engine._clone) if the input must survive. tt-analyze TT203
+    lints the read-after-donation mistake where the donating jit is in
+    view."""
 
     slots: jnp.ndarray    # (P, E) int32
     rooms: jnp.ndarray    # (P, E) int32

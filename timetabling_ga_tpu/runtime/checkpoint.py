@@ -45,9 +45,28 @@ def config_fingerprint(problem, cfg, n_islands: int) -> str:
             f"|I{n_islands}")
 
 
+def key_data(key) -> np.ndarray:
+    """Host copy of a PRNG key's raw data. The engine snapshots this on
+    the MAIN thread (it is a device fetch — a control-path fence) before
+    handing serialization to the background writer; `save` accepts the
+    resulting ndarray in place of the key so the writer thread never
+    touches the device."""
+    if isinstance(key, np.ndarray):
+        return key
+    return np.asarray(jax.random.key_data(key))
+
+
 def save(path: str, state: ga.PopState, key, generation: int,
          fingerprint: str, best_seen=None, seed: int = None) -> None:
-    """Atomic snapshot (write temp + rename, like any sane checkpointer).
+    """Atomic DURABLE snapshot: write temp, fsync, rename, fsync dir.
+
+    The fsync pair is what makes 'the last checkpoint on disk' a
+    guarantee rather than a hope: serialization now runs on the async
+    writer thread while the engine keeps dispatching, so the process can
+    be killed at any moment — a rename alone could leave the new name
+    pointing at pages the kernel never flushed. `state` may be a device
+    PopState or a host (numpy) snapshot; `key` a JAX key or its
+    key_data ndarray (see `key_data`).
 
     `best_seen` is the per-island best reported value already emitted to
     the JSONL stream; persisting it keeps the logEntry stream monotone
@@ -59,7 +78,7 @@ def save(path: str, state: ga.PopState, key, generation: int,
         "penalty": np.asarray(state.penalty),
         "hcv": np.asarray(state.hcv),
         "scv": np.asarray(state.scv),
-        "key": np.asarray(jax.random.key_data(key)),
+        "key": key_data(key),
         "generation": np.asarray(generation),
         "fingerprint": np.asarray(fingerprint),
     }
@@ -73,7 +92,14 @@ def save(path: str, state: ga.PopState, key, generation: int,
     try:
         with os.fdopen(fd, "wb") as fh:
             np.savez(fh, **arrays)
+            fh.flush()
+            os.fsync(fh.fileno())
         os.replace(tmp, path)
+        dirfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)    # the rename itself must be durable too
+        finally:
+            os.close(dirfd)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)

@@ -154,6 +154,24 @@ class RunConfig:
     #                           --no-precompile skips the probe
     #                           dispatches; first dispatches then compile
     #                           inside -t)
+    pipeline: bool = True     # depth-2 asynchronous dispatch pipeline:
+    #                           enqueue dispatch N+1 before fencing
+    #                           dispatch N's telemetry trace, so the
+    #                           device never idles through host-side
+    #                           logging (engine docstring, "Dispatch
+    #                           pipeline"). Auto-disabled whenever a
+    #                           control path must fence between
+    #                           dispatches (post config, multi-host,
+    #                           --trace-profile); --no-pipeline forces
+    #                           the strictly serial loop (the A/B
+    #                           baseline bench.py measures against)
+    donate: bool = True       # donate population buffers to each
+    #                           dispatch (jit donate_argnums): the
+    #                           (pop x events) state tensors are aliased
+    #                           between dispatches instead of copied.
+    #                           --no-donate keeps the copying engine
+    #                           (debugging aid: donated inputs read
+    #                           after dispatch raise 'Array deleted')
     # ---- multi-host (the reference's MPI_Init role, ga.cpp:373-380):
     # jax.distributed.initialize is called before any device use when
     # --distributed or --coordinator is given; the island mesh then spans
@@ -322,7 +340,9 @@ _BOOL_FLAGS = {"--resume": "resume", "--nsga2": "nsga2",
                "--ls-converge": "ls_converge",
                "--distributed": "distributed"}
 _NEG_BOOL_FLAGS = {"--no-auto-tune": "auto_tune",
-                   "--no-precompile": "precompile"}
+                   "--no-precompile": "precompile",
+                   "--no-pipeline": "pipeline",
+                   "--no-donate": "donate"}
 
 
 def _usage() -> str:

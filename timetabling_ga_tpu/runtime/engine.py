@@ -26,10 +26,39 @@ Observability (--trace, SURVEY section 5): per-phase host timings
 emitted as {"phase": ...} JSONL records — an extension record type; the
 reference protocol's three record types are unchanged and remain
 byte-compatible.
+
+Dispatch pipeline (the control-vs-telemetry fence rule). Every host-side
+read of device data in this loop is one of two kinds:
+
+  CONTROL — its value decides WHAT the engine dispatches next (the
+  post-feasibility phase switch, the stall-kick trigger, a checkpoint
+  snapshot, every timing probe that feeds the budget predictor). These
+  MUST be real data-fetch fences (BASELINE.md round-5 fence audit:
+  block_until_ready can early-ack on the tunneled device), and the
+  engine may not run ahead of them.
+
+  TELEMETRY — its value is only REPORTED (logEntry emission from the
+  per-generation trace, phase records, checkpoint npz serialization).
+  These must NOT stall the dispatch stream: the device idling through a
+  log write is the host gap BENCH_r05 measured.
+
+The run loop is a depth-2 asynchronous pipeline built on that split:
+dispatch N+1 is enqueued immediately after chunk N's trace transfer is
+started (`copy_to_host_async`), and chunk N's telemetry is processed
+while N+1 executes; JSONL emission and checkpoint serialization run on a
+background writer thread (jsonl.AsyncWriter) behind a bounded queue,
+drained on exit and on error. Pipelining engages only when every
+control path is a no-op for the run (single process, no post config, no
+profiler bracket) — otherwise the loop stays serial, because control
+reads must fence. Population buffers are donated between dispatches
+(`donate` — islands._donate), so the big state tensors are aliased
+rather than copied; tt-analyze TT203 guards the
+no-read-after-donation discipline.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import os
 import sys
@@ -86,33 +115,50 @@ def _shape_sig(problem):
             problem.n_days, problem.slots_per_day)
 
 
+def _clone(state):
+    """Fresh device copy of a state pytree, sharding preserved.
+
+    precompile's warm-up calls run through the DONATING runners (timed
+    runs reuse exactly these compiled programs, so the warmed programs
+    must be the donating ones), and donation DELETES its input buffers
+    at dispatch. Every state a warm-up consumes is therefore either a
+    clone of a state that is needed again, or the previous warm-up
+    call's output — never a buffer someone else still holds."""
+    import jax.numpy as jnp
+    return jax.tree.map(jnp.copy, state)
+
+
 def cached_runner(mesh, gacfg: ga.GAConfig, n_epochs: int, gens: int,
-                  sig, n_islands: int):
+                  sig, n_islands: int, donate: bool = False):
     """Returns (runner, was_cached). was_cached=False means this
     (program, instance shape) pair is fresh, so its first call will pay
-    an XLA compile."""
-    k = (_mesh_key(mesh), gacfg, n_epochs, gens, sig, n_islands)
+    an XLA compile. `donate` is part of the cache key (as in every
+    cached_* factory here): the donating and non-donating jits are
+    DIFFERENT executables, and colliding them would hand a
+    buffer-deleting program to a caller that reuses its input."""
+    k = (_mesh_key(mesh), gacfg, n_epochs, gens, sig, n_islands, donate)
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
     r = islands.make_island_runner(mesh, gacfg, n_epochs=n_epochs,
                                    gens_per_epoch=gens,
-                                   n_islands=n_islands)
+                                   n_islands=n_islands, donate=donate)
     _RUNNER_CACHE[k] = r
     return r, False
 
 
 def cached_dynamic_runner(mesh, gacfg: ga.GAConfig, max_gens: int, sig,
-                          n_islands: int):
+                          n_islands: int, donate: bool = False):
     """Tail-dispatch runner with a RUNTIME generation count (one compile
     serves every n_gens <= max_gens), used to spend the last slice of a
     wall-clock budget instead of idling through it."""
-    k = ("dyn", _mesh_key(mesh), gacfg, max_gens, sig, n_islands)
+    k = ("dyn", _mesh_key(mesh), gacfg, max_gens, sig, n_islands, donate)
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
     r = islands.make_island_runner_dynamic(mesh, gacfg, max_gens,
-                                           n_islands=n_islands)
+                                           n_islands=n_islands,
+                                           donate=donate)
     _RUNNER_CACHE[k] = r
     return r, False
 
@@ -198,18 +244,20 @@ def _sync_vals(*vals):
     return tuple(int(v) for v in vals)
 
 
-def cached_kick_runner(mesh, gacfg: ga.GAConfig, sig, n_islands: int):
+def cached_kick_runner(mesh, gacfg: ga.GAConfig, sig, n_islands: int,
+                       donate: bool = False):
     """Stall-kick program (islands.make_kick_runner): reseed the worst
     half of each island from mutated copies of its best. The traced
     program depends only on (pop_size, p1/p2/p3) of `gacfg`; the kick
     fires in the POST phase, so callers build it from the post config —
     whose pop_size may be the shrunk one (post_pop_size)."""
     k = ("kick", _mesh_key(mesh), gacfg.pop_size, gacfg.p1, gacfg.p2,
-         gacfg.p3, sig, n_islands)
+         gacfg.p3, sig, n_islands, donate)
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
-    r = islands.make_kick_runner(mesh, gacfg, n_islands=n_islands)
+    r = islands.make_kick_runner(mesh, gacfg, n_islands=n_islands,
+                                 donate=donate)
     _RUNNER_CACHE[k] = r
     return r, False
 
@@ -227,17 +275,18 @@ def _lahc_key(mesh, gacfg: ga.GAConfig, hist_len: int, k_cands: int,
 
 
 def cached_lahc_runners(mesh, gacfg: ga.GAConfig, hist_len: int,
-                        k_cands: int, sig, n_islands: int):
+                        k_cands: int, sig, n_islands: int,
+                        donate: bool = False):
     """(init, run, finalize) LAHC endgame programs
     (islands.make_lahc_runners). The traced programs depend only on
     (pop_size, p1/p2/p3, hist_len, k_cands) of the POST config, whose
     pop_size may be the shrunk one."""
     k = ("lahc", _mesh_key(mesh), gacfg.pop_size, gacfg.p1, gacfg.p2,
-         gacfg.p3, hist_len, k_cands, sig, n_islands)
+         gacfg.p3, hist_len, k_cands, sig, n_islands, donate)
     r = _RUNNER_CACHE.get(k)
     if r is None:
         r = islands.make_lahc_runners(mesh, gacfg, hist_len, k_cands,
-                                      n_islands)
+                                      n_islands, donate=donate)
         _RUNNER_CACHE[k] = r
     return r
 
@@ -255,14 +304,15 @@ def cached_shrink_runner(mesh, pop_in: int, pop_out: int,
 
 
 def cached_polish_runner(mesh, gacfg: ga.GAConfig, sig,
-                         n_islands: int):
+                         n_islands: int, donate: bool = False):
     """Init-polish runner with a RUNTIME sweep count (one compile serves
     every chunk size); see islands.make_polish_runner."""
-    k = ("polish", _mesh_key(mesh), gacfg, sig, n_islands)
+    k = ("polish", _mesh_key(mesh), gacfg, sig, n_islands, donate)
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
-    r = islands.make_polish_runner(mesh, gacfg, n_islands=n_islands)
+    r = islands.make_polish_runner(mesh, gacfg, n_islands=n_islands,
+                                   donate=donate)
     _RUNNER_CACHE[k] = r
     return r, False
 
@@ -324,6 +374,12 @@ def build_post_config(cfg: RunConfig, gacfg: ga.GAConfig):
         return post
     return None if post == gacfg else post
 
+
+# one dispatched-but-not-yet-retired chunk of the pipelined run loop
+# (see _run_tries): `trace` is the chunk's DEVICE-side telemetry array,
+# fenced only when the chunk is retired by _process
+_Chunk = collections.namedtuple(
+    "_Chunk", "td0 n_ep gens_run dyn_gens trace warm do_prof")
 
 _DISTRIBUTED_DONE = False
 
@@ -400,6 +456,24 @@ def _fetch_final(state, n_islands: int, pop: int):
     return slots, rooms, hcv, scv
 
 
+def _fetch_state(state) -> ga.PopState:
+    """Host (numpy) snapshot of a PopState as ONE device round trip —
+    the checkpoint-path sibling of `_fetch_final` (each separate fetch
+    is a multi-second round trip on tunneled devices, VERDICT round-3
+    weak #3, and this fetch sits on the pipelined dispatch path):
+    concatenate slots/rooms/penalty/hcv/scv into a single
+    (N*P, 2E+3) int32 array, fetch once, slice apart."""
+    import jax.numpy as jnp
+    packed = _fetch(jnp.concatenate(
+        [state.slots, state.rooms, state.penalty[:, None],
+         state.hcv[:, None], state.scv[:, None]], axis=1))
+    E = (packed.shape[1] - 3) // 2
+    return ga.PopState(
+        slots=packed[:, :E], rooms=packed[:, E:2 * E],
+        penalty=packed[:, 2 * E], hcv=packed[:, 2 * E + 1],
+        scv=packed[:, 2 * E + 2])
+
+
 def _setup(cfg: RunConfig):
     """Shared run setup: load the instance, build mesh + breeding config
     + cache keys. precompile and _run_tries MUST agree on these (the
@@ -474,6 +548,7 @@ def precompile(cfg: RunConfig) -> None:
     (problem, pa, mesh, n_islands, gacfg, gacfg_post, fingerprint,
      spg_key) = _setup(cfg)
     sig = _shape_sig(problem)
+    donate = cfg.donate
 
     key = jax.random.key(0)
     # one subkey per warm-up program: the compile calls' outputs are
@@ -526,11 +601,14 @@ def precompile(cfg: RunConfig) -> None:
     if cfg.post_lahc > 0 and gacfg_post is not None:
         init_r, run_r, fin_r = cached_lahc_runners(
             mesh, gacfg_post, cfg.post_lahc, cfg.post_lahc_k, sig,
-            n_islands)
+            n_islands, donate)
         lkey = _lahc_key(mesh, gacfg_post, cfg.post_lahc,
                          cfg.post_lahc_k, fingerprint)
-        ls0 = init_r(pa, state_for[gacfg_post])
-        ls1, stats0 = run_r(pa, wk[1], ls0, 64)     # compile
+        # donating programs: state_for's entry is needed again below, so
+        # init consumes a clone, and each later call consumes the
+        # previous call's output (never a buffer donation already ate)
+        ls1 = init_r(pa, _clone(state_for[gacfg_post]))
+        ls1, stats0 = run_r(pa, wk[1], ls1, 64)     # compile
         # fences here MUST be data fetches, not block_until_ready: on
         # the tunneled device block_until_ready can acknowledge before
         # the computation completes (BASELINE.md round-5 fence audit),
@@ -539,7 +617,7 @@ def precompile(cfg: RunConfig) -> None:
         _fetch(stats0)
         if lkey not in _LAHC_SPS_CACHE:
             t0 = time.monotonic()
-            ls2, stats = run_r(pa, jax.random.key(1), ls0, 256)
+            ls1, stats = run_r(pa, jax.random.key(1), ls1, 256)
             _fetch(stats)
             _LAHC_SPS_CACHE[lkey] = (time.monotonic() - t0) / 256
         _fetch(fin_r(ls1).penalty)
@@ -550,15 +628,17 @@ def precompile(cfg: RunConfig) -> None:
         if gacfg.init_sweeps <= 0 and g.ls_mode != "sweep":
             continue
         g_spg_key = (_mesh_key(mesh), g, fingerprint)
-        polish, pwarm = cached_polish_runner(mesh, g, sig, n_islands)
+        polish, pwarm = cached_polish_runner(mesh, g, sig, n_islands,
+                                             donate)
         # timing fences are data fetches of the stats output, not
         # block_until_ready, which can early-ack on the tunneled device
         # (BASELINE.md round-5 fence audit) — a near-zero sec/sweep
         # would size polish chunks past the budget
-        _fetch(polish(pa, wk[2], state_for[g], 1)[1])
+        st_p, pstats = polish(pa, wk[2], _clone(state_for[g]), 1)
+        _fetch(pstats)
         if not pwarm or g_spg_key not in _SPS_CACHE:
             t0 = time.monotonic()
-            _fetch(polish(pa, jax.random.key(1), state_for[g], 1)[1])
+            _fetch(polish(pa, jax.random.key(1), st_p, 1)[1])
             sps = time.monotonic() - t0
             prev = _SPS_CACHE.get(g_spg_key)
             _SPS_CACHE[g_spg_key] = (sps if prev is None
@@ -569,8 +649,10 @@ def precompile(cfg: RunConfig) -> None:
     # population may be the shrunk one
     if (cfg.kick_stall > 0 and post_ga is not None
             and post_ga.pop_size >= 2):
-        kicker, _ = cached_kick_runner(mesh, post_ga, sig, n_islands)
-        jax.block_until_ready(kicker(pa, wk[3], state_for[post_ga], 3))
+        kicker, _ = cached_kick_runner(mesh, post_ga, sig, n_islands,
+                                       donate)
+        jax.block_until_ready(
+            kicker(pa, wk[3], _clone(state_for[post_ga]), 3))
     # static dispatches always run gens = migration_period (shorter
     # remainders go through the dynamic runner), at pow2 n_ep; compile
     # exactly those — for BOTH phase configs when a post-feasibility
@@ -580,7 +662,10 @@ def precompile(cfg: RunConfig) -> None:
               if cfg.generations >= cfg.migration_period else 0)
     for g in ([gacfg] if post_ga is None else [gacfg, post_ga]):
         g_spg_key = (_mesh_key(mesh), g, fingerprint)
-        g_state = state_for[g]
+        # the warm-up chain consumes a clone (donating runners delete
+        # their inputs; state_for[g] may be shared with other warm-ups),
+        # then each call feeds on the previous call's returned state
+        g_state = _clone(state_for[g])
         # dynamic runner FIRST: one generation is the smallest dispatch
         # the engine can make, so it doubles as the safe sec/gen probe
         # for configs whose FULL epoch would outrun the watchdog (a
@@ -588,12 +673,14 @@ def precompile(cfg: RunConfig) -> None:
         # at migration_period 10 — dies inside even the n_ep=1 static
         # shape; executing that shape to measure it is the bug)
         dyn, _ = cached_dynamic_runner(mesh, g, cfg.migration_period,
-                                       sig, n_islands)
-        _fetch(dyn(pa, wk[4], g_state, 1)[1])
+                                       sig, n_islands, donate)
+        g_state, tr0, _ = dyn(pa, wk[4], g_state, 1)
+        _fetch(tr0)
         spg_est = _SPG_CACHE.get(g_spg_key)
         if spg_est is None:
             t0 = time.monotonic()
-            _fetch(dyn(pa, jax.random.key(1), g_state, 1)[1])
+            g_state, tr0, _ = dyn(pa, jax.random.key(1), g_state, 1)
+            _fetch(tr0)
             # 1 generation + dispatch/migration overhead: an
             # OVERESTIMATE of sec/gen, used only to gate the static
             # builds below (conservative = never builds a shape the
@@ -607,8 +694,8 @@ def precompile(cfg: RunConfig) -> None:
                 # long-kernel watchdog — don't even build the shape
                 break
             runner, warm = cached_runner(mesh, g, n_ep, gens, sig,
-                                         n_islands)
-            st2, tr2, _ = runner(pa, wk[5], g_state)
+                                         n_islands, donate)
+            g_state, tr2, _ = runner(pa, wk[5], g_state)
             _fetch(tr2)
             if not warm:
                 # the timing call MUST differ from the compile call:
@@ -617,7 +704,7 @@ def precompile(cfg: RunConfig) -> None:
                 # made this measure ~2e-5 s/gen and let a 146 s dispatch
                 # through a 60 s budget — so re-run with a different key
                 t0 = time.monotonic()
-                st2, tr2, _ = runner(pa, jax.random.key(1), g_state)
+                g_state, tr2, _ = runner(pa, jax.random.key(1), g_state)
                 _fetch(tr2)
                 spg = (time.monotonic() - t0) / (n_ep * gens)
                 prev = _SPG_CACHE.get(g_spg_key)
@@ -678,7 +765,22 @@ def run(cfg: RunConfig, out=None) -> int:
             out = sys.stdout
 
     try:
-        return _run_tries(cfg, out)
+        # all record emission (and checkpoint serialization, via
+        # submit()) rides the background writer thread so the dispatch
+        # loop never stalls on host I/O; close() drains the bounded
+        # queue on clean exit AND on error, so `out` is complete the
+        # moment run() returns or raises. On the error path a queued
+        # telemetry failure must not REPLACE the run's own exception
+        # (retry logic matches on the propagating error), so close()
+        # only re-raises when nothing else is in flight.
+        writer = jsonl.AsyncWriter(out)
+        try:
+            ret = _run_tries(cfg, writer)
+        except BaseException:
+            writer.close(raise_error=False)
+            raise
+        writer.close()
+        return ret
     finally:
         if close_out:
             out.close()
@@ -792,7 +894,7 @@ def _lahc_loop(out, cfg, pa, mesh, state, base_key, t_try, reserve,
     running its scv walk until the clock, Solution.cpp:499/619-768)."""
     init_r, run_r, fin_r = cached_lahc_runners(
         mesh, gacfg_post, cfg.post_lahc, cfg.post_lahc_k, sig,
-        n_islands)
+        n_islands, cfg.donate)
     lkey = _lahc_key(mesh, gacfg_post, cfg.post_lahc, cfg.post_lahc_k,
                      fingerprint)
     lstate = init_r(pa, state)
@@ -932,7 +1034,8 @@ def _run_tries(cfg: RunConfig, out) -> int:
                 best_seen = [INT_MAX] * n_islands
             if gacfg.init_sweeps > 0:
                 polish, pwarm = cached_polish_runner(mesh, gacfg, sig,
-                                                     n_islands)
+                                                     n_islands,
+                                                     cfg.donate)
                 state, _ = _polish_chunks(
                     out, cfg, pa, polish, state, k_polish, t_try, reserve,
                     _SPS_CACHE.get(spg_key), n_islands, best_seen,
@@ -977,9 +1080,249 @@ def _run_tries(cfg: RunConfig, out) -> int:
         #                     basin means the previous depth was too
         #                     shallow to escape it
         profiled = False
+        # Depth-2 asynchronous dispatch pipeline (module docstring):
+        # chunk N+1 is enqueued BEFORE chunk N's trace is fenced, and
+        # chunk N's telemetry is processed while N+1 executes on the
+        # device. Enabled only when every between-dispatch CONTROL read
+        # is absent from the run:
+        #   - a post config makes the phase switch (and the stall kick)
+        #     read chunk N's trace before choosing chunk N+1's PROGRAM;
+        #   - multi-host trace fetches ride a process_allgather
+        #     collective that must not interleave with the next
+        #     dispatch's collectives;
+        #   - the profiler bracket is a measurement path (start/stop
+        #     must tightly enclose exactly one dispatch).
+        # Checkpoints do run pipelined: the snapshot fetch is its own
+        # fence (it blocks on the in-flight chunk), and the npz
+        # serialization rides the writer thread.
+        pipelined = bool(cfg.pipeline and gacfg_post is None
+                         and jax.process_count() == 1
+                         and cfg.trace_profile is None)
+        pending = None     # the one in-flight chunk (pipelined mode)
+        n_dispatch = 0
+        last_fence = None  # wall time of the previous chunk's fence
+        t_loop = time.monotonic()
+
+        def _process(chunk, inflight=None):
+            """Retire one dispatched chunk: fence its trace fetch, emit
+            telemetry, update the sec/gen estimate, and run the control
+            checks (phase switch / kick / checkpoint). Serial mode calls
+            this immediately after the chunk's own dispatch — exactly
+            the classic loop-body order; pipelined mode calls it with
+            the NEXT chunk already enqueued (passed as `inflight`), so
+            everything below overlaps device compute."""
+            nonlocal state, key, cur, cur_key, sec_per_gen, lahc_done
+            nonlocal kick_stall, kick_best, kick_streak, profiled
+            nonlocal epochs_at_ckpt, last_fence
+            (td0, n_ep, gens_run, dyn_gens, trace_dev, warm,
+             do_prof) = chunk                  # _Chunk fields
+            trace = _fetch(trace_dev)          # blocks on the dispatch
+            if dyn_gens is not None:
+                trace = trace[:, :, :dyn_gens]
+            td1 = time.monotonic()
+            if do_prof:
+                jax.profiler.stop_trace()
+                profiled = True
+                _phase(out, True, "profile", trial, td1 - td0,
+                       dir=cfg.trace_profile)
+            # when this chunk actually STARTED on the device: in serial
+            # mode its enqueue time; in pipelined mode the previous
+            # chunk's fence (the device was still running chunk N-1 at
+            # enqueue). Used for both the budget predictor's cost
+            # (enqueue-to-fence in pipelined mode would span ~two
+            # chunks and double the sec/gen estimate) and the logEntry
+            # time interpolation (anchoring at enqueue would timestamp
+            # bests up to one dispatch earlier than they occurred,
+            # flattering time-to-feasible)
+            t_start = (last_fence
+                       if pipelined and last_fence is not None
+                       else td0)
+            dt = td1 - t_start
+            last_fence = td1
+            _phase(out, cfg.trace, "dispatch", trial, dt,
+                   epochs=n_ep, gens=gens_run)
+            if warm and (gens_run >= cfg.migration_period or dt >= 5.0):
+                # compiling dispatches are excluded: compile time would
+                # inflate the estimate, and the poisoned value would both
+                # end this run early and persist into later runs. Tiny
+                # dynamic tails are excluded too: their wall time is
+                # dominated by fixed dispatch/migration/fetch overhead,
+                # which would inflate the per-generation estimate — but
+                # a dispatch that ran >= 5 s is overhead-free enough to
+                # measure REGARDLESS of generation count, which is the
+                # only feedback path in the watchdog-capped dyn regime
+                # (gens_run < migration_period on every dispatch there;
+                # without this the run would trust the one-generation
+                # precompile probe forever, and generation cost is
+                # data-dependent)
+                spg = dt / gens_run
+                sec_per_gen = (spg if sec_per_gen is None
+                               else 0.7 * spg + 0.3 * sec_per_gen)
+                _SPG_CACHE[cur_key] = sec_per_gen
+
+            # per-generation logEntry emission from the device-side
+            # trace — pure telemetry (writes ride the writer thread)
+            flat = trace.reshape(n_islands, gens_run, 2)
+            total = gens_run
+            for i in range(n_islands):
+                for g in range(total):
+                    rep = jsonl.reported_best(flat[i, g, 0],
+                                              flat[i, g, 1])
+                    if rep < best_seen[i]:
+                        best_seen[i] = rep
+                        tg = ((t_start - t_try)
+                              + (g + 1) / total * (td1 - t_start))
+                        jsonl.log_entry(out, i, 0, rep, tg)
+
+            # post-feasibility switch (reference phase-2 analogue): a
+            # CONTROL read — it picks the next dispatch's program — so
+            # pipelining is off whenever a post config exists, and this
+            # runs strictly between dispatches. The decision reads
+            # best_seen, which every process derives from the same
+            # allgathered trace — no divergence risk
+            if (cur is gacfg and gacfg_post is not None
+                    and min(best_seen) < FEASIBLE_LIMIT):
+                cur = gacfg_post
+                cur_key = (_mesh_key(mesh), cur, fingerprint)
+                if cur.pop_size != gacfg.pop_size:
+                    state = cached_shrink_runner(
+                        mesh, gacfg.pop_size, cur.pop_size,
+                        n_islands)(state)
+                sec_per_gen = _spg_for(cur_key, cur, gacfg, spg_key)
+                _phase(out, cfg.trace, "phase-switch", trial, 0.0,
+                       at_gen=gens_done)
+                if cfg.post_lahc > 0:
+                    # the endgame leaves the GA entirely: the remaining
+                    # budget belongs to the LAHC walkers; return (the
+                    # classic loop's `break`) — no kick, no checkpoint
+                    key, k_lahc = jax.random.split(key)
+                    state = _lahc_loop(
+                        out, cfg, pa, mesh, state, k_lahc, t_try,
+                        reserve, n_islands, best_seen, trial, cur, sig,
+                        fingerprint)
+                    lahc_done = True
+                    return
+
+            # stall kick (VERDICT round-4 next #5): in the post phase —
+            # the scv-polish endgame where small seed 43 sat pinned on a
+            # plateau for its whole budget — count consecutive dispatches
+            # with no new global best; at cfg.kick_stall of them, reseed
+            # the worst half of every island from mutated copies of its
+            # best (islands.make_kick_runner; the single-island analogue
+            # of migration's diversity injection, ga.cpp:522-535).
+            # Control, like the phase switch: post config => serial.
+            if (cur is gacfg_post and cfg.kick_stall > 0
+                    and cur.pop_size >= 2):
+                nb = min(best_seen)
+                if nb < kick_best:
+                    kick_stall = 0
+                    kick_streak = 0
+                else:
+                    kick_stall += 1
+                kick_best = nb
+                # the budget check keeps -t honest: a kick straight
+                # after the final dispatch would otherwise run past the
+                # limit. It reads the PROCESS-LOCAL clock, so the
+                # mesh-wide launch decision goes through _sync_vals like
+                # every other dispatch decision (best_seen alone is
+                # process-identical; the clock is not).
+                kick_fits = (cfg.time_limit - reserve
+                             - (time.monotonic() - t_try)) > 0
+                do_kick, = _sync_vals(
+                    kick_stall >= cfg.kick_stall and kick_fits)
+                if do_kick:
+                    # precompile builds this program (same enabling
+                    # condition); under --no-precompile the first kick
+                    # pays its XLA compile inside -t like every other
+                    # program in that mode
+                    kicker, _kwarm = cached_kick_runner(
+                        mesh, cur, sig, n_islands, cfg.donate)
+                    n_moves = min(3 << kick_streak,
+                                  islands.KICK_MAX_MOVES)
+                    key, k_kick = jax.random.split(key)
+                    t = time.monotonic()
+                    state = kicker(pa, k_kick, state, n_moves)
+                    _fetch(state.penalty)   # real fence for the phase
+                    #                         record (see init above)
+                    # context key is at_gen, NOT gens: `gens` on a
+                    # phase record means generations EXECUTED by
+                    # that phase (budget accounting sums it)
+                    _phase(out, cfg.trace, "kick", trial,
+                           time.monotonic() - t, at_gen=gens_done,
+                           moves=n_moves)
+                    kick_stall = 0
+                    kick_streak += 1
+
+            if (cfg.checkpoint
+                    and epochs_done - epochs_at_ckpt
+                    >= cfg.checkpoint_every):
+                t = time.monotonic()
+                # CONTROL half, on this thread: snapshot the CURRENT
+                # state to host memory — a real data fence (pipelined,
+                # it blocks on the in-flight chunk, whose generations
+                # gens_done already counts, so counter and state agree).
+                # Multi-host, _fetch allgathers the GLOBAL population (a
+                # collective — all processes must participate); the file
+                # holds global state so a resume can re-shard it onto
+                # any process layout (the reference's wire format
+                # likewise serves all ranks, ga.cpp:264-368).
+                # TELEMETRY half, on the writer thread: the npz
+                # serialization + fsync + rename, ordered behind the
+                # records already queued.
+                host_state = _fetch_state(state)
+                key_host = ckpt.key_data(key)
+                bs = list(best_seen)
+                if inflight is not None:
+                    # `state`/`gens_done` already cover the in-flight
+                    # chunk, but best_seen only covers chunks this
+                    # function has retired — saving the stale list
+                    # would let a resume re-emit a best the pre-crash
+                    # stream logged AFTER this checkpoint (non-monotone
+                    # merged stream). Fold the in-flight chunk's trace
+                    # into the SAVED copy (its fetch rides the same
+                    # fence _fetch_state just paid); the live best_seen
+                    # stays untouched so the chunk's logEntries still
+                    # emit normally when it retires.
+                    tr_in = _fetch(inflight.trace)
+                    if inflight.dyn_gens is not None:
+                        tr_in = tr_in[:, :, :inflight.dyn_gens]
+                    fl_in = tr_in.reshape(n_islands, -1, 2)
+                    for i in range(n_islands):
+                        for h, s in fl_in[i]:
+                            bs[i] = min(bs[i],
+                                        jsonl.reported_best(h, s))
+                if jax.process_count() <= 1 or jax.process_index() == 0:
+                    job = (lambda hs=host_state, kh=key_host,
+                           gd=gens_done, bs=bs, sd=seed:
+                           ckpt.save(cfg.checkpoint, hs, kh, gd,
+                                     fingerprint, bs, sd))
+                    submit = getattr(out, "submit", None)
+                    if submit is not None:
+                        submit(job)
+                    else:
+                        job()
+                epochs_at_ckpt = epochs_done
+                _phase(out, cfg.trace, "checkpoint", trial,
+                       time.monotonic() - t)
+
         while not lahc_done and gens_done < cfg.generations:
+            if pending is not None and sec_per_gen is None:
+                # no cost estimate for the in-flight chunk (e.g.
+                # --no-precompile before the first warm measurement):
+                # enqueueing a SECOND unmeasured dispatch could overrun
+                # -t by two chunks where the serial loop risks one, so
+                # retire the in-flight chunk first — the loop runs
+                # serially until a measurable chunk seeds the estimate
+                _process(pending)
+                pending = None
             remaining_t = (cfg.time_limit - reserve
                            - (time.monotonic() - t_try))
+            if pending is not None and sec_per_gen is not None:
+                # an in-flight chunk consumes budget the clock has not
+                # charged yet: reserve its predicted cost before sizing
+                # the next dispatch (the pipelined analogue of the
+                # serial loop's between-dispatch clock check)
+                remaining_t -= sec_per_gen * pending.gens_run
             stop = remaining_t <= 0
             if (sec_per_gen is not None
                     and sec_per_gen > DISPATCH_CAP_S):
@@ -1073,12 +1416,13 @@ def _run_tries(cfg: RunConfig, out) -> int:
             key, k_epoch = jax.random.split(key)
             if dyn_gens is not None:
                 runner, warm = cached_dynamic_runner(
-                    mesh, cur, cfg.migration_period, sig, n_islands)
+                    mesh, cur, cfg.migration_period, sig, n_islands,
+                    cfg.donate)
                 args = (pa, k_epoch, state, dyn_gens)
                 gens_run = dyn_gens
             else:
                 runner, warm = cached_runner(mesh, cur, n_ep, gens,
-                                             sig, n_islands)
+                                             sig, n_islands, cfg.donate)
                 args = (pa, k_epoch, state)
                 gens_run = n_ep * gens
             # --trace-profile: capture ONE warm dispatch per try with
@@ -1090,145 +1434,36 @@ def _run_tries(cfg: RunConfig, out) -> int:
             if do_prof:
                 jax.profiler.start_trace(cfg.trace_profile)
             td0 = time.monotonic()
-            state, trace, _gbest = runner(*args)
-            trace = _fetch(trace)              # blocks on the dispatch
-            if dyn_gens is not None:
-                trace = trace[:, :, :dyn_gens]
-            td1 = time.monotonic()
-            if do_prof:
-                jax.profiler.stop_trace()
-                profiled = True
-                _phase(out, True, "profile", trial, td1 - td0,
-                       dir=cfg.trace_profile)
-            _phase(out, cfg.trace, "dispatch", trial, td1 - td0,
-                   epochs=n_ep, gens=gens_run)
+            state, trace_dev, _gbest = runner(*args)
+            # start the trace's device->host transfer WITHOUT fencing:
+            # the tiny telemetry leaf streams over while the host moves
+            # on; the real fence is _process's _fetch, where the data
+            # is actually read
+            try:
+                trace_dev.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass           # transfer then simply happens at _fetch
             gens_done += gens_run
             epochs_done += n_ep
-            if warm and (gens_run >= cfg.migration_period
-                         or td1 - td0 >= 5.0):
-                # compiling dispatches are excluded: compile time would
-                # inflate the estimate, and the poisoned value would both
-                # end this run early and persist into later runs. Tiny
-                # dynamic tails are excluded too: their wall time is
-                # dominated by fixed dispatch/migration/fetch overhead,
-                # which would inflate the per-generation estimate — but
-                # a dispatch that ran >= 5 s is overhead-free enough to
-                # measure REGARDLESS of generation count, which is the
-                # only feedback path in the watchdog-capped dyn regime
-                # (gens_run < migration_period on every dispatch there;
-                # without this the run would trust the one-generation
-                # precompile probe forever, and generation cost is
-                # data-dependent)
-                spg = (td1 - td0) / gens_run
-                sec_per_gen = (spg if sec_per_gen is None
-                               else 0.7 * spg + 0.3 * sec_per_gen)
-                _SPG_CACHE[cur_key] = sec_per_gen
+            n_dispatch += 1
+            chunk = _Chunk(td0, n_ep, gens_run, dyn_gens, trace_dev,
+                           warm, do_prof)
+            if pipelined:
+                # retire the PREVIOUS chunk with this one already
+                # running: its telemetry cost hides behind device
+                # compute instead of serializing the dispatch stream
+                if pending is not None:
+                    _process(pending, inflight=chunk)
+                pending = chunk
+            else:
+                _process(chunk)
 
-            # per-generation logEntry emission from the device-side trace
-            flat = trace.reshape(n_islands, gens_run, 2)
-            total = gens_run
-            for i in range(n_islands):
-                for g in range(total):
-                    rep = jsonl.reported_best(flat[i, g, 0], flat[i, g, 1])
-                    if rep < best_seen[i]:
-                        best_seen[i] = rep
-                        tg = (td0 - t_try) + (g + 1) / total * (td1 - td0)
-                        jsonl.log_entry(out, i, 0, rep, tg)
-
-            # post-feasibility switch (reference phase-2 analogue): the
-            # decision reads best_seen, which every process derives from
-            # the same allgathered trace — no divergence risk
-            if (cur is gacfg and gacfg_post is not None
-                    and min(best_seen) < FEASIBLE_LIMIT):
-                cur = gacfg_post
-                cur_key = (_mesh_key(mesh), cur, fingerprint)
-                if cur.pop_size != gacfg.pop_size:
-                    state = cached_shrink_runner(
-                        mesh, gacfg.pop_size, cur.pop_size,
-                        n_islands)(state)
-                sec_per_gen = _spg_for(cur_key, cur, gacfg, spg_key)
-                _phase(out, cfg.trace, "phase-switch", trial, 0.0,
-                       at_gen=gens_done)
-                if cfg.post_lahc > 0:
-                    # the endgame leaves the GA entirely: the remaining
-                    # budget belongs to the LAHC walkers
-                    key, k_lahc = jax.random.split(key)
-                    state = _lahc_loop(
-                        out, cfg, pa, mesh, state, k_lahc, t_try,
-                        reserve, n_islands, best_seen, trial, cur, sig,
-                        fingerprint)
-                    lahc_done = True
-                    break
-
-            # stall kick (VERDICT round-4 next #5): in the post phase —
-            # the scv-polish endgame where small seed 43 sat pinned on a
-            # plateau for its whole budget — count consecutive dispatches
-            # with no new global best; at cfg.kick_stall of them, reseed
-            # the worst half of every island from mutated copies of its
-            # best (islands.make_kick_runner; the single-island analogue
-            # of migration's diversity injection, ga.cpp:522-535).
-            if (cur is gacfg_post and cfg.kick_stall > 0
-                    and cur.pop_size >= 2):
-                nb = min(best_seen)
-                if nb < kick_best:
-                    kick_stall = 0
-                    kick_streak = 0
-                else:
-                    kick_stall += 1
-                kick_best = nb
-                # the budget check keeps -t honest: a kick straight
-                # after the final dispatch would otherwise run past the
-                # limit. It reads the PROCESS-LOCAL clock, so the
-                # mesh-wide launch decision goes through _sync_vals like
-                # every other dispatch decision (best_seen alone is
-                # process-identical; the clock is not).
-                kick_fits = (cfg.time_limit - reserve
-                             - (time.monotonic() - t_try)) > 0
-                do_kick, = _sync_vals(
-                    kick_stall >= cfg.kick_stall and kick_fits)
-                if do_kick:
-                    # precompile builds this program (same enabling
-                    # condition); under --no-precompile the first kick
-                    # pays its XLA compile inside -t like every other
-                    # program in that mode
-                    kicker, _kwarm = cached_kick_runner(mesh, cur,
-                                                        sig, n_islands)
-                    n_moves = min(3 << kick_streak,
-                                  islands.KICK_MAX_MOVES)
-                    key, k_kick = jax.random.split(key)
-                    t = time.monotonic()
-                    state = kicker(pa, k_kick, state, n_moves)
-                    _fetch(state.penalty)   # real fence for the phase
-                    #                         record (see init above)
-                    # context key is at_gen, NOT gens: `gens` on a
-                    # phase record means generations EXECUTED by
-                    # that phase (budget accounting sums it)
-                    _phase(out, cfg.trace, "kick", trial,
-                           time.monotonic() - t, at_gen=gens_done,
-                           moves=n_moves)
-                    kick_stall = 0
-                    kick_streak += 1
-
-            if (cfg.checkpoint
-                    and epochs_done - epochs_at_ckpt >= cfg.checkpoint_every):
-                t = time.monotonic()
-                # multi-host: every process allgathers the global
-                # population (a collective — all must participate), then
-                # process 0 alone writes the npz; the file holds the
-                # GLOBAL state, so a resume can re-shard it onto any
-                # process layout with the same total island count (the
-                # reference's wire format likewise serves all ranks,
-                # ga.cpp:264-368)
-                ckpt_state = state
-                if jax.process_count() > 1:
-                    ckpt_state = ga.PopState(
-                        *[_fetch(x) for x in state])
-                if jax.process_count() <= 1 or jax.process_index() == 0:
-                    ckpt.save(cfg.checkpoint, ckpt_state, key, gens_done,
-                              fingerprint, best_seen, seed)
-                epochs_at_ckpt = epochs_done
-                _phase(out, cfg.trace, "checkpoint", trial,
-                       time.monotonic() - t)
+        if pending is not None:
+            _process(pending)          # drain the in-flight chunk
+            pending = None
+        _phase(out, cfg.trace, "gen-loop", trial,
+               time.monotonic() - t_loop, dispatches=n_dispatch,
+               pipelined=pipelined)
 
         # BUDGET-TAIL POLISH: the generation loop stops when not even
         # one more generation fits, stranding up to sec_per_gen seconds
@@ -1246,7 +1481,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
                          else None)
         if sec_per_sweep is not None and sec_per_sweep > 0:
             polish, pwarm = cached_polish_runner(mesh, cur, sig,
-                                                 n_islands)
+                                                 n_islands, cfg.donate)
             if pwarm:   # never compile inside the budget
                 key, k_tail = jax.random.split(key)
                 # no sps_cache_key: tail timings of converged

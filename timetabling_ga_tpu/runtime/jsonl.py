@@ -32,12 +32,131 @@ threaded); tests/test_runtime.py pins the TPU-path constant.
 from __future__ import annotations
 
 import json
+import queue
+import threading
 from typing import IO, List, Optional
 
 
 def _write(stream: IO, obj: dict) -> None:
     stream.write(json.dumps(obj, separators=(",", ":")) + "\n")
     stream.flush()
+
+
+class AsyncWriter:
+    """Background writer thread behind a bounded queue: the telemetry
+    half of the engine's dispatch pipeline.
+
+    The engine's host loop used to pay every JSONL write (and every
+    checkpoint np.savez) INLINE between device dispatches — host work
+    the device idled through. This object is file-like (`write`/`flush`)
+    so the record emitters above use it unchanged; each `write` call
+    enqueues one COMPLETE line (the emitters always pass exactly one
+    record per call, which is what keeps the output line-atomic — the
+    worker hands the line to the underlying stream in a single write()
+    and flushes, so a kill mid-run leaves whole records, never spliced
+    ones). `submit` enqueues an arbitrary job (checkpoint
+    serialization) on the SAME queue, preserving order relative to the
+    records around it.
+
+    Drain semantics: `close()` (and `drain()`) block until every queued
+    item has been handed to the underlying stream, then (`close` only)
+    stop the worker — the engine calls close() in a finally, so the
+    stream is complete both on clean exit and on error. A worker-side
+    exception (disk full, closed stream) is captured and re-raised on
+    the MAIN thread at the next write/submit/drain/close — telemetry
+    failures must fail the run, not vanish into a daemon thread. The
+    bounded queue (default 1024 items) is backpressure: a stalled disk
+    blocks the producer instead of growing memory without bound."""
+
+    _STOP = object()
+
+    def __init__(self, stream: IO, maxsize: int = 1024):
+        self._stream = stream
+        self._q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self._error: BaseException | None = None
+        self._failed = False   # worker latch, never cleared: once the
+        #                        stream failed mid-record, writing more
+        #                        would splice after the partial line
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._worker, name="tt-jsonl-writer", daemon=True)
+        self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is self._STOP:
+                    return
+                if callable(item):
+                    # a failed JOB (checkpoint serialization) leaves no
+                    # partial line, so records queued behind it are
+                    # still safe to write — only the error propagates
+                    if self._error is None:
+                        item()
+                elif not self._failed:
+                    try:
+                        self._stream.write(item)
+                        self._stream.flush()
+                    except BaseException:
+                        # _error is cleared when re-raised to the
+                        # producer; _failed is not — the worker must
+                        # never write past a mid-record STREAM failure
+                        # (a resumed stream would splice the next
+                        # record onto the partial line)
+                        self._failed = True
+                        raise
+            except BaseException as e:  # captured, re-raised on main
+                self._error = e
+            finally:
+                self._q.task_done()
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def _check_open(self) -> None:
+        if self._closed:
+            # silently dropping records would violate the 'telemetry
+            # failures must fail the run' contract
+            raise RuntimeError("AsyncWriter is closed")
+
+    def write(self, s: str) -> None:
+        self._check_open()
+        self._raise_pending()
+        self._q.put(s)
+
+    def flush(self) -> None:
+        """No-op: the worker flushes after every record. (The emitters
+        call stream.flush() per line; making this synchronous would
+        serialize the pipeline the writer exists to unblock.)"""
+
+    def submit(self, job) -> None:
+        """Enqueue `job()` (e.g. a checkpoint np.savez) behind every
+        record already queued."""
+        self._check_open()
+        self._raise_pending()
+        self._q.put(job)
+
+    def drain(self) -> None:
+        """Block until the queue is empty and every item is written."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self, raise_error: bool = True) -> None:
+        """Drain, then stop the worker; idempotent. Does NOT close the
+        underlying stream (the engine owns that). `raise_error=False`
+        swallows a pending worker error — for close() calls already on
+        an exception path, where re-raising would MASK the run's real
+        failure (retry/diagnosis match on the propagating exception)."""
+        if not self._closed:
+            self._closed = True
+            self._q.put(self._STOP)
+            self._q.join()
+            self._thread.join()
+        if raise_error:
+            self._raise_pending()
 
 
 def reported_best(hcv: int, scv: int) -> int:
@@ -85,6 +204,33 @@ def phase_record(stream: IO, name: str, trial: int, seconds: float,
     for k, v in extra.items():
         rec[k] = v
     _write(stream, {"phase": rec})
+
+
+# which fields on each record type are TIMING (wall-clock-dependent):
+# the dispatch pipeline reorders WHEN telemetry is processed, never WHAT
+# is dispatched, so serial and pipelined runs must emit identical
+# records once these fields are stripped. Owned here, next to the
+# emitters, so the bench A/B and the determinism test cannot drift on
+# what "modulo timing" means.
+TIMING_FIELDS = {"logEntry": ("time",), "solution": ("totalTime",),
+                 "runEntry": ("totalTime",)}
+
+
+def strip_timing(records: List[dict]) -> List[dict]:
+    """Protocol records minus phase records and timing fields — the
+    byte-identity domain of the pipeline A/B (bench.py extra.pipeline,
+    tests/test_runtime.py pipeline determinism)."""
+    out = []
+    for rec in records:
+        if "phase" in rec:
+            continue
+        rec = json.loads(json.dumps(rec))   # deep copy, JSON domain
+        for kind, fields in TIMING_FIELDS.items():
+            if kind in rec:
+                for f in fields:
+                    rec[kind].pop(f, None)
+        out.append(rec)
+    return out
 
 
 def run_entry(stream: IO, total_best: int, feasible: bool,
