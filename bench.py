@@ -744,12 +744,23 @@ def main() -> None:
              lambda: measure_ls_shootout_feasible(problem))):
         # every leg retries through transient tunnel windows (the
         # BENCH_r05 scale_2000ev 'response body closed' failure class)
-        # instead of poisoning the round; attempts land in the leg JSON
+        # instead of poisoning the round; attempts land in the leg JSON.
+        # Engine-level recoveries and triggered fault injections are
+        # recorded as per-leg DELTAS: a perf number that silently
+        # absorbed a sick window (the supervisor replayed work inside
+        # the measurement) must be visible in the trajectory.
+        from timetabling_ga_tpu.runtime.engine import run_counters
         try:
+            before = run_counters()
             result, attempts = retry_transient(fn, attempts=3,
                                                wait_s=60.0)
+            after = run_counters()
             if isinstance(result, dict):
                 result["attempts"] = attempts
+                result["recoveries"] = (after["recoveries"]
+                                        - before["recoveries"])
+                result["faults_injected"] = (after["faults_injected"]
+                                             - before["faults_injected"])
             extra[name] = result
         except Exception as e:  # pragma: no cover - defensive
             print(f"# {name} failed: {e}", file=sys.stderr)
@@ -757,6 +768,11 @@ def main() -> None:
                            "attempts": getattr(e, "tt_attempts", 1)}
     extra["cpu_native_evals_per_sec"] = round(cpu, 1)
     extra["cpu_threads"] = os.cpu_count() or 1
+    # whole-round robustness totals (per-leg deltas above attribute them)
+    from timetabling_ga_tpu.runtime.engine import run_counters
+    totals = run_counters()
+    extra["recoveries_total"] = totals["recoveries"]
+    extra["faults_injected_total"] = totals["faults_injected"]
     # honesty note (VERDICT round-2 weak 5): the denominator runs on
     # THIS host's cores; the north star names a 32-core box. Scale
     # linearly for an estimate vs that target.

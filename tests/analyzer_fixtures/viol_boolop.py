@@ -1,0 +1,51 @@
+"""TT102 fixture: `and`/`or` short-circuit on traced values.
+
+Not imported or executed — parsed by tests/test_analysis.py. Short-
+circuit operators call bool() on their left operand, the same tracer
+hazard TT101 catches in `if`, hidden in expression position.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def assign_and(x, y):
+    ok = (x > 0) and (y > 0)                 # EXPECT TT102
+    return jnp.where(ok, x, y)
+
+
+@jax.jit
+def return_or(x, y):
+    return x or y                            # EXPECT TT102
+
+
+def scan_body_guard(carry, x):
+    flag = carry and x                       # EXPECT TT102
+    return carry + x, flag
+
+
+def run_scan(xs):
+    c, _ = lax.scan(scan_body_guard, jnp.zeros(()), xs)
+    return c
+
+
+@jax.jit
+def if_test_chain(x, y):
+    if (x > 0) and (y > 0):   # EXPECT TT101 # EXPECT TT102
+        return x
+    return y
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def statics_are_fine(x, mode):
+    fast = mode == "fast" or mode == "quick"   # OK: mode declared static
+    big = x.shape[0] > 4 and x.ndim > 1        # OK: shapes are static
+    cond = jnp.logical_and(x > 0, x < 9)       # OK: the element-wise form
+    both = (x > 0) & (x < 9)                   # OK: bitwise, no bool()
+    last = fast or (x > 0)    # OK: bool() never runs on the LAST operand
+    if fast and big:                           # OK: both operands static
+        return jnp.where(cond, x, -x), jnp.where(last, both, x)
+    return x, both

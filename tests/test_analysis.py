@@ -56,6 +56,7 @@ def analyze_fixture(fixture: str):
 
 @pytest.mark.parametrize("fixture", [
     "viol_trace.py",       # TT101 tracer-unsafe control flow
+    "viol_boolop.py",      # TT102 and/or short-circuit on traced values
     "viol_recompile.py",   # TT201/TT202 recompile hazards
     "viol_donate.py",      # TT203 donated-buffer reuse
     "viol_sync.py",        # TT301 hidden host syncs

@@ -1,0 +1,363 @@
+"""In-run fault recovery (ISSUE 3): the engine run supervisor, the
+fetch watchdog, the degradation ladder, and the deterministic fault
+harness (runtime/faults.py) that drives them all on the CPU backend —
+plus the satellite hardening of retry/jsonl/checkpoint.
+
+The determinism contract under test: a run that absorbs an injected
+transient failure must emit protocol records IDENTICAL to an uninjected
+run with the same seed, modulo timing fields and fault/phase records
+(jsonl.strip_timing is the shared definition of that domain).
+"""
+
+import io
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from timetabling_ga_tpu.problem import dump_tim, random_instance
+from timetabling_ga_tpu.runtime import checkpoint as ckpt
+from timetabling_ga_tpu.runtime import faults, jsonl, retry
+from timetabling_ga_tpu.runtime.config import RunConfig, parse_args
+
+
+# ------------------------------------------------------------- satellites
+
+def test_is_transient_walks_cause_and_context():
+    """jit dispatch wraps the XLA UNAVAILABLE error in a RuntimeError;
+    the classifier must walk __cause__ AND __context__ or exactly the
+    failures the retry policy exists for re-raise as permanent."""
+    assert not retry.is_transient(RuntimeError("boom"))
+    assert retry.is_transient(RuntimeError("UNAVAILABLE: device"))
+    # explicit cause chain (raise ... from ...)
+    try:
+        try:
+            raise ValueError("UNAVAILABLE: TPU device error")
+        except ValueError as inner:
+            raise RuntimeError("dispatch failed") from inner
+    except RuntimeError as e:
+        assert retry.is_transient(e)
+    # implicit context chain (raise during except)
+    try:
+        try:
+            raise OSError("remote_compile: response body closed")
+        except OSError:
+            raise KeyError("wrapped")
+    except KeyError as e:
+        assert retry.is_transient(e)
+    # a cycle must terminate, not spin
+    a, b = RuntimeError("a"), RuntimeError("b")
+    a.__cause__, b.__cause__ = b, a
+    assert not retry.is_transient(a)
+
+
+def test_retry_backoff_schedule_and_cap(monkeypatch):
+    """Exponential backoff from wait_s by `backoff`, capped at
+    max_wait_s — a fixed 120 s wait either burns budget on blips or
+    re-enters a long sick window still sick."""
+    assert retry.backoff_schedule(4, 10.0, 2.0, 35.0) == [10.0, 20.0, 35.0]
+    slept = []
+    monkeypatch.setattr(time, "sleep", slept.append)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("UNAVAILABLE: window")
+        return "ok"
+
+    result, attempts = retry.retry_transient(
+        flaky, attempts=4, wait_s=5.0, backoff=3.0, max_wait_s=10.0)
+    assert result == "ok" and attempts == 3
+    assert slept == [5.0, 10.0]          # 5, then 15 capped to 10
+    # non-transient: no retry, no sleep
+    slept.clear()
+    with pytest.raises(ValueError):
+        retry.retry_transient(lambda: (_ for _ in ()).throw(
+            ValueError("real bug")), attempts=3, wait_s=1.0)
+    assert slept == []
+
+
+def test_fault_plan_grammar():
+    plan = faults.FaultPlan.parse(
+        "dispatch:3:unavailable, fetch:5:hang,writer:1:die,ckpt:2:truncate")
+    assert plan.pop_action("dispatch") is None          # invocation 1
+    assert plan.pop_action("dispatch") is None          # 2
+    assert plan.pop_action("dispatch") == "unavailable"  # 3
+    assert plan.pop_action("dispatch") is None          # 4: one-shot
+    assert plan.injected == 1
+    for bad in ("dispatch:x:unavailable", "dispatch:0:unavailable",
+                "dispatch:1:explode", "dispatch:1",
+                "dispath:1:unavailable"):   # typo'd site: loud, not no-op
+        with pytest.raises(faults.FaultPlanError):
+            faults.FaultPlan.parse(bad)
+    # unavailable raises a WRAPPED transient (the cause-chain shape)
+    faults.install("dispatch:1:unavailable")
+    try:
+        with pytest.raises(RuntimeError) as ei:
+            faults.maybe_fail("dispatch")
+        assert "UNAVAILABLE" not in str(ei.value)   # top exception clean
+        assert retry.is_transient(ei.value)         # chain classifies
+    finally:
+        faults.install(None)
+
+
+def test_async_writer_death_aware_enqueue_and_close():
+    """If the worker thread dies with the bounded queue full, write()/
+    submit()/drain()/close() must raise, not block forever on
+    queue.put/join (the pre-fix deadlock)."""
+    faults.install("writer:1:die")
+    try:
+        buf = io.StringIO()
+        w = jsonl.AsyncWriter(buf, maxsize=2)
+        w.write('{"a":1}\n')          # consumed by the worker, which dies
+        deadline = time.monotonic() + 30
+        with pytest.raises(RuntimeError, match="worker thread died"):
+            while time.monotonic() < deadline:
+                w.write('{"b":2}\n')   # fills the queue, then must raise
+        with pytest.raises(RuntimeError, match="worker thread died"):
+            w.drain()
+        with pytest.raises(RuntimeError, match="worker thread died"):
+            w.close()
+        w.close(raise_error=False)     # exception-path close: no raise,
+        #                                no deadlock
+    finally:
+        faults.install(None)
+
+
+def test_checkpoint_rotation_and_corrupt_fallback(tmp_path, small_problem):
+    """save rotates path -> path.prev; a truncated newest file (via the
+    ckpt fault site) falls back to the previous good one; both bad is a
+    CheckpointCorrupt naming both paths."""
+    import jax
+    from timetabling_ga_tpu.ops import ga
+    pa = small_problem.device_arrays()
+    st = ga.init_population(pa, jax.random.key(0), 8)
+    gacfg = ga.GAConfig(pop_size=8)
+    fp = ckpt.config_fingerprint(small_problem, gacfg, n_islands=2)
+    path = str(tmp_path / "ck.npz")
+    ckpt.save(path, st, jax.random.key(1), 10, fp, best_seen=[5, 6], seed=1)
+    assert not os.path.exists(ckpt.prev_path(path))   # nothing to rotate
+    ckpt.save(path, st, jax.random.key(2), 20, fp, best_seen=[4, 5], seed=1)
+    assert os.path.exists(ckpt.prev_path(path))
+    # generation 30 save is torn on disk by the fault harness
+    faults.install("ckpt:1:truncate")
+    try:
+        ckpt.save(path, st, jax.random.key(3), 30, fp,
+                  best_seen=[3, 4], seed=1)
+    finally:
+        faults.install(None)
+    _st, _key, gen, best, seed = ckpt.load(path, fp)
+    assert gen == 20 and best == [4, 5]    # the rotated previous-good one
+    # a missing main with a good .prev also falls back (the crash window
+    # between save's two renames)
+    os.unlink(path)
+    assert ckpt.load(path, fp)[2] == 20
+    # both unreadable: CheckpointCorrupt naming both paths
+    with open(path, "wb") as f:
+        f.write(b"not-a-zip")
+    with open(ckpt.prev_path(path), "wb") as f:
+        f.write(b"also-bad")
+    with pytest.raises(ckpt.CheckpointCorrupt) as ei:
+        ckpt.load(path, fp)
+    assert path in str(ei.value) and ckpt.prev_path(path) in str(ei.value)
+    # no file at all stays FileNotFoundError (the engine's fresh-init
+    # resume path depends on it)
+    os.unlink(path)
+    os.unlink(ckpt.prev_path(path))
+    with pytest.raises(FileNotFoundError):
+        ckpt.load(path, fp)
+
+
+def test_fault_flags_parse():
+    cfg = parse_args(["-i", "x.tim", "--max-recoveries", "5",
+                      "--fetch-timeout", "30",
+                      "--faults", "dispatch:1:unavailable"])
+    assert cfg.max_recoveries == 5
+    assert cfg.fetch_timeout == 30.0
+    assert cfg.faults == "dispatch:1:unavailable"
+    with pytest.raises(SystemExit):
+        parse_args(["-i", "x.tim", "--max-recoveries", "-1"])
+    with pytest.raises(SystemExit):
+        parse_args(["-i", "x.tim", "--fetch-timeout", "-2"])
+    # config beats env; env is the fallback
+    assert faults.active_spec("a:1:hang") == "a:1:hang"
+    os.environ["TT_FAULTS"] = "b:2:die"
+    try:
+        assert faults.active_spec(None) == "b:2:die"
+        assert faults.active_spec("a:1:hang") == "a:1:hang"
+    finally:
+        del os.environ["TT_FAULTS"]
+    assert faults.active_spec(None) is None
+
+
+# -------------------------------------------------------- recovery matrix
+
+@pytest.fixture(scope="module")
+def tim_file(tmp_path_factory):
+    problem = random_instance(55, n_events=15, n_rooms=5, n_features=2,
+                              n_students=10, attend_prob=0.1)
+    path = tmp_path_factory.mktemp("faults") / "tiny.tim"
+    path.write_text(dump_tim(problem))
+    return str(path)
+
+
+def _go(tim_file, **kw):
+    from timetabling_ga_tpu.runtime import engine
+    buf = io.StringIO()
+    cfg = RunConfig(input=tim_file, seed=3, pop_size=8, islands=1,
+                    generations=30, migration_period=10, max_steps=8,
+                    time_limit=300, backend="cpu", auto_tune=False,
+                    trace=True, **kw)
+    best = engine.run(cfg, out=buf)
+    return best, [json.loads(x) for x in buf.getvalue().splitlines()]
+
+
+def _fault_entries(lines):
+    return [x["faultEntry"] for x in lines if "faultEntry" in x]
+
+
+def test_dispatch_kill_recovers_with_identical_jsonl(tim_file):
+    """ISSUE 3 acceptance: an injected mid-run dispatch kill (serial
+    loop, snapshot = init state) recovers via snapshot rehydration and
+    the stream is identical to an uninjected run's modulo timing and
+    fault records — including the absence of duplicate logEntries for
+    the replayed span."""
+    clean_best, clean = _go(tim_file, pipeline=False)
+    best, lines = _go(tim_file, pipeline=False,
+                      faults="dispatch:2:unavailable")
+    fe = _fault_entries(lines)
+    assert [e["action"] for e in fe] == ["recover"]
+    assert fe[0]["site"] == "dispatch" and fe[0]["recovery"] == 1
+    assert fe[0]["lostGens"] == 10          # chunk 1 replayed
+    assert best == clean_best
+    assert jsonl.strip_timing(lines) == jsonl.strip_timing(clean)
+
+
+def test_dispatch_kill_pipelined_checkpoint_snapshot(tim_file, tmp_path):
+    """Pipelined run with per-epoch checkpoints: the rolling snapshot
+    advances at every checkpoint fence (covering the in-flight chunk),
+    so a later kill replays only from the last fence — and the
+    in-flight chunk's logEntries, folded into the snapshot, are still
+    emitted exactly once."""
+    clean_best, clean = _go(tim_file, pipeline=True,
+                            checkpoint=str(tmp_path / "a.npz"),
+                            checkpoint_every=1)
+    best, lines = _go(tim_file, pipeline=True,
+                      checkpoint=str(tmp_path / "b.npz"),
+                      checkpoint_every=1,
+                      faults="dispatch:3:unavailable")
+    fe = _fault_entries(lines)
+    assert [e["action"] for e in fe] == ["recover"]
+    assert best == clean_best
+    assert jsonl.strip_timing(lines) == jsonl.strip_timing(clean)
+    # the recovered run's final checkpoint is durable and loadable
+    with np.load(str(tmp_path / "b.npz"), allow_pickle=False) as z:
+        assert int(z["generation"]) == 30
+
+
+def test_fetch_hang_watchdog_recovery(tim_file):
+    """A hung control-fence fetch (the BENCH_r05 worst case) becomes a
+    FetchTimeout via the watchdog thread, classifies transient, and
+    recovers — fetch site invocation 3 is the first chunk's trace fetch
+    (1 = init fence, 2 = the supervisor's initial snapshot)."""
+    clean_best, clean = _go(tim_file, pipeline=False)
+    t0 = time.monotonic()
+    best, lines = _go(tim_file, pipeline=False, fetch_timeout=1.0,
+                      faults="fetch:3:hang")
+    wall = time.monotonic() - t0
+    fe = _fault_entries(lines)
+    assert [e["action"] for e in fe] == ["recover"]
+    assert fe[0]["site"] == "fetch"
+    assert "fetch watchdog" in fe[0]["error"]
+    assert best == clean_best
+    assert jsonl.strip_timing(lines) == jsonl.strip_timing(clean)
+    # the hang was abandoned at the deadline, not slept through
+    assert wall < faults.HANG_S
+
+
+def test_two_failures_in_window_degrade_to_serial(tim_file):
+    """The degradation ladder: a second failure inside the window steps
+    level 0 -> 1 (strictly serial loop), emitted as a degrade record;
+    the run still completes with identical records (serial vs pipelined
+    changes WHEN telemetry is processed, never WHAT is dispatched)."""
+    clean_best, clean = _go(tim_file, pipeline=False)
+    best, lines = _go(tim_file, pipeline=True, max_recoveries=5,
+                      faults="dispatch:1:unavailable,"
+                             "dispatch:2:unavailable")
+    fe = _fault_entries(lines)
+    assert [e["action"] for e in fe] == ["recover", "recover", "degrade"]
+    assert fe[-1]["level"] == 1 and fe[-1]["mode"] == "serial"
+    loops = [x["phase"] for x in lines
+             if "phase" in x and x["phase"]["name"] == "gen-loop"]
+    assert loops and loops[0]["pipelined"] is False   # ladder took hold
+    assert best == clean_best
+    assert jsonl.strip_timing(lines) == jsonl.strip_timing(clean)
+
+
+def test_ladder_level2_halves_dispatch_chunks(tim_file):
+    """Three failures in the window reach level 2: dispatch chunks are
+    halved (migration_period 10 -> 5-generation dynamic dispatches), so
+    less work is lost per kill. Chunk sizes change the key-split
+    sequence, so only completion and the generation budget are asserted
+    — not record identity."""
+    best, lines = _go(tim_file, pipeline=False, max_recoveries=6,
+                      faults="dispatch:1:unavailable,"
+                             "dispatch:2:unavailable,"
+                             "dispatch:3:unavailable")
+    fe = _fault_entries(lines)
+    assert [e["action"] for e in fe] == [
+        "recover", "recover", "degrade", "recover", "degrade"]
+    assert fe[-1]["level"] == 2 and fe[-1]["mode"] == "chunk-1/2"
+    gens = [x["phase"]["gens"] for x in lines
+            if "phase" in x and x["phase"]["name"] == "dispatch"]
+    assert sum(gens) == 30                  # budget still exact
+    assert any(g == 5 for g in gens)        # halved chunks actually ran
+    assert any("runEntry" in x for x in lines)
+
+
+def test_recovery_exhaustion_aborts_cleanly(tim_file, tmp_path):
+    """--max-recoveries exhausted: the run raises the transient error
+    (so outer harnesses can still classify it), after emitting an abort
+    faultEntry through the DRAINED writer and leaving a final durable
+    checkpoint from the snapshot."""
+    ck = str(tmp_path / "abort.npz")
+    buf = io.StringIO()
+    from timetabling_ga_tpu.runtime import engine
+    cfg = RunConfig(input=tim_file, seed=3, pop_size=8, islands=1,
+                    generations=30, migration_period=10, max_steps=8,
+                    time_limit=300, backend="cpu", auto_tune=False,
+                    pipeline=False, checkpoint=ck, checkpoint_every=1,
+                    max_recoveries=1,
+                    faults="dispatch:1:unavailable,dispatch:2:unavailable")
+    with pytest.raises(RuntimeError) as ei:
+        engine.run(cfg, out=buf)
+    assert retry.is_transient(ei.value)
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    fe = _fault_entries(lines)
+    assert [e["action"] for e in fe] == ["recover", "abort"]
+    # the abort record reached the stream: the writer was drained on the
+    # error path, not abandoned
+    assert fe[-1]["site"] == "dispatch"
+    # final durable checkpoint from the snapshot round-trips
+    fp = ckpt.config_fingerprint  # noqa: F841  (doc pointer)
+    with np.load(ck, allow_pickle=False) as z:
+        assert "generation" in z and "slots" in z
+
+
+def test_non_transient_injected_error_is_not_recovered(tim_file):
+    """The supervisor must never retry a real bug into flakiness: the
+    `error` action raises a NON-transient failure, which propagates
+    with no recover record."""
+    buf = io.StringIO()
+    from timetabling_ga_tpu.runtime import engine
+    cfg = RunConfig(input=tim_file, seed=3, pop_size=8, islands=1,
+                    generations=30, migration_period=10, max_steps=8,
+                    time_limit=300, backend="cpu", auto_tune=False,
+                    pipeline=False, faults="dispatch:1:error")
+    with pytest.raises(faults.FaultInjected):
+        engine.run(cfg, out=buf)
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    assert _fault_entries(lines) == []

@@ -6,6 +6,8 @@ Usage:
 Rules (see README "Static analysis & sanitizers"):
 
   TT101  tracer-unsafe control flow in jit/vmap/shard_map/scan targets
+  TT102  `and`/`or` short-circuit on traced values in the same targets
+         (bool() on a tracer hidden in expression position)
   TT201  jax.jit static arguments receiving unhashable/run-varying values
   TT202  compile-cache dict keys omitting a value the program closes over
   TT203  donated-buffer reuse (donate_argnums args read after the
@@ -57,6 +59,7 @@ def _rule_modules():
         rules_trace)
     return {
         "TT101": rules_trace,
+        "TT102": rules_trace,
         "TT201": rules_recompile,
         "TT202": rules_recompile,
         "TT203": rules_donate,

@@ -15,8 +15,8 @@ import dataclasses
 import os
 import re
 
-ALL_RULES = ("TT101", "TT201", "TT202", "TT203", "TT301", "TT302",
-             "TT401", "TT402", "TT501")
+ALL_RULES = ("TT101", "TT102", "TT201", "TT202", "TT203", "TT301",
+             "TT302", "TT401", "TT402", "TT501")
 
 
 @dataclasses.dataclass

@@ -1,11 +1,18 @@
-"""TT101 — tracer-unsafe control flow.
+"""TT101/TT102 — tracer-unsafe control flow.
 
-Python `if` / `while` / `assert` / `for` statements whose condition (or
-iterable) derives from a parameter of a function that is a jit / vmap /
-shard_map / lax-control-flow target execute at TRACE time: at best they
-bake one branch into the compiled program, at worst they raise
-TracerBoolConversionError at runtime. Inside traced code the data-
-dependent forms are `lax.cond` / `lax.while_loop` / `jnp.where`.
+TT101: Python `if` / `while` / `assert` / `for` statements whose
+condition (or iterable) derives from a parameter of a function that is
+a jit / vmap / shard_map / lax-control-flow target execute at TRACE
+time: at best they bake one branch into the compiled program, at worst
+they raise TracerBoolConversionError at runtime. Inside traced code the
+data-dependent forms are `lax.cond` / `lax.while_loop` / `jnp.where`.
+
+TT102: `and` / `or` expressions with a traced operand inside the same
+targets. Short-circuit operators call `bool()` on their left operand —
+the SAME tracer-bool hazard TT101 catches in `if`, hidden in expression
+position where no statement-level rule sees it (`ok = (x > 0) and
+(y > 0)` fails identically to `if x > 0:`). The element-wise forms are
+`jnp.logical_and` / `jnp.logical_or` (or `&` / `|`).
 
 Shape- and dtype-derived values (`x.shape`, `x.ndim`, `x.dtype`,
 `len(x)`) are static under tracing and do not taint; neither do params
@@ -21,6 +28,7 @@ from timetabling_ga_tpu.analysis.core import (
     target_names)
 
 RULE = "TT101"
+RULE_BOOLOP = "TT102"
 
 # callees whose function-valued arguments are traced
 _TRACING_CALLEES = {
@@ -126,6 +134,35 @@ class _TaintChecker:
             f"target `{name}` — use lax.cond/lax.while_loop/jnp.where "
             f"(or hoist the value to a static argument)"))
 
+    def flag_boolop(self, node: ast.BoolOp):
+        name = getattr(self.fn, "name", "<lambda>")
+        op = "and" if isinstance(node.op, ast.And) else "or"
+        self.findings.append(Finding(
+            RULE_BOOLOP, self.path, node.lineno, node.col_offset,
+            f"`{op}` short-circuit on a traced value inside "
+            f"jit/vmap/shard_map target `{name}` — short-circuit calls "
+            f"bool() on the tracer (the TT101 hazard in expression "
+            f"position); use jnp.logical_{op} (or `{'&' if op == 'and' else '|'}`)"))
+
+    def _boolops(self, node: ast.AST):
+        """Flag the OUTERMOST tainted BoolOp under `node` (one finding
+        per short-circuit chain; nested tainted operands are the same
+        defect)."""
+        if node is None:
+            return
+        # bool() is called on every operand EXCEPT the last (the chain's
+        # result is returned unevaluated), so a traced value in final
+        # position is legal: `use_default or (x > 0)` with a static
+        # first operand short-circuits on the static only
+        if isinstance(node, ast.BoolOp) and any(
+                self.is_tainted(v) for v in node.values[:-1]):
+            self.flag_boolop(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.keyword,
+                                  ast.comprehension)):
+                self._boolops(child)
+
     def run(self):
         body = (self.fn.body if isinstance(self.fn.body, list)
                 else [ast.Expr(self.fn.body)])
@@ -139,6 +176,14 @@ class _TaintChecker:
         if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
                            ast.ClassDef)):
             return  # nested defs are analyzed iff they are targets
+        # TT102: short-circuit chains in this statement's expression
+        # slots, checked against the CURRENT taint state (bodies of
+        # compound statements recurse below and re-check per statement)
+        for field in ("value", "test", "iter"):
+            self._boolops(getattr(st, field, None))
+        if isinstance(st, ast.With):
+            for item in st.items:
+                self._boolops(item.context_expr)
         if isinstance(st, ast.Assign):
             t = self.is_tainted(st.value)
             for tgt in st.targets:

@@ -62,6 +62,26 @@ def _donate(fn, donate: bool, argnum: int):
     return jax.jit(fn, donate_argnums=(argnum,) if donate else ())
 
 
+def delete_state(state) -> None:
+    """Best-effort device-buffer teardown for a poisoned or abandoned
+    state pytree (the engine's fault-recovery path, README "Fault
+    tolerance"). After a transient device failure the in-flight state's
+    buffers are in an unknown condition — and with donation enabled
+    (`_donate`) the FAILED dispatch may already have deleted its input
+    aliases, so a leaf may legitimately be gone. Deleting each live
+    leaf releases device memory before rehydration re-places the
+    population from the host snapshot; every error is swallowed because
+    the buffers are being discarded either way, and a donated-then-
+    killed buffer must never be re-read (only dropped)."""
+    if state is None:
+        return
+    for leaf in jax.tree.leaves(state):
+        try:
+            leaf.delete()
+        except Exception:
+            pass
+
+
 def make_mesh(n_islands: int = None, devices=None) -> Mesh:
     """1-D device mesh with axis "island" (the reference's MPI_Comm_size
     world, ga.cpp:379)."""

@@ -11,14 +11,36 @@ exact device state, so an interrupted run continues deterministically.
 from __future__ import annotations
 
 import os
+import sys
 import tempfile
+import zipfile
+import zlib
 
 import jax
 import numpy as np
 
 from timetabling_ga_tpu.ops import ga
+from timetabling_ga_tpu.runtime import faults
 
 FORMAT_VERSION = 2
+
+
+class FingerprintMismatch(ValueError):
+    """Deliberate refusal: the checkpoint is intact but belongs to a
+    different instance/config/island layout. ValueError for
+    back-compat with callers that match the original refusal."""
+
+
+class CheckpointCorrupt(RuntimeError):
+    """The checkpoint file exists but cannot be read (truncated npz,
+    bad zip magic, missing arrays) and no previous-generation file
+    could serve in its place. Names both paths so the operator knows
+    exactly what was tried."""
+
+
+def prev_path(path: str) -> str:
+    """The rotation target `save` moves the previous checkpoint to."""
+    return path + ".prev"
 
 
 def config_fingerprint(problem, cfg, n_islands: int) -> str:
@@ -71,7 +93,13 @@ def save(path: str, state: ga.PopState, key, generation: int,
     `best_seen` is the per-island best reported value already emitted to
     the JSONL stream; persisting it keeps the logEntry stream monotone
     across a resume (a fresh INT_MAX would re-emit pre-crash bests).
-    `seed` is metadata for the engine's explicit-mismatch check."""
+    `seed` is metadata for the engine's explicit-mismatch check.
+
+    Rotation: before the rename lands, the previous checkpoint is moved
+    to `prev_path(path)` — durability (fsync) protects against a crash
+    DURING the write, but not against the newest file being corrupted
+    later on disk (torn filesystem, truncation by a full disk); `load`
+    falls back to the rotated previous-good file in that case."""
     arrays = {
         "slots": np.asarray(state.slots),
         "rooms": np.asarray(state.rooms),
@@ -94,25 +122,42 @@ def save(path: str, state: ga.PopState, key, generation: int,
             np.savez(fh, **arrays)
             fh.flush()
             os.fsync(fh.fileno())
+        if os.path.exists(path):
+            # keep one previous-good generation: if THIS file is later
+            # found corrupted on disk, load() falls back to it
+            os.replace(path, prev_path(path))
         os.replace(tmp, path)
         dirfd = os.open(d, os.O_RDONLY)
         try:
-            os.fsync(dirfd)    # the rename itself must be durable too
+            os.fsync(dirfd)    # both renames must be durable too
         finally:
             os.close(dirfd)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    # fault-injection point (runtime/faults.py `ckpt` site): `truncate`
+    # tears the just-written file the way a torn disk would, so the
+    # load-side fallback path runs deterministically in tier-1
+    faults.maybe_fail("ckpt", path=path)
 
 
-def load(path: str, fingerprint: str):
-    """Restore (state, key, generation, best_seen); raises on fingerprint
-    mismatch. best_seen is None for pre-v2 checkpoints."""
+# np.load failure classes that mean 'the file on disk is damaged'
+# (truncated zip, bad magic, member cut short, missing arrays) — as
+# opposed to FileNotFoundError (no checkpoint) and FingerprintMismatch
+# (intact but foreign), which are deliberate, distinct outcomes.
+# Deliberately NOT a blanket OSError: a transient EIO/EACCES on an
+# INTACT newest file must propagate, not silently roll the run back to
+# the stale .prev generation.
+_CORRUPT_ERRORS = (zipfile.BadZipFile, zlib.error, ValueError, EOFError,
+                   KeyError)
+
+
+def _load_one(path: str, fingerprint: str):
     with np.load(path, allow_pickle=False) as z:
         found = str(z["fingerprint"])
         if found != fingerprint:
-            raise ValueError(
+            raise FingerprintMismatch(
                 f"checkpoint fingerprint mismatch: {found!r} != "
                 f"{fingerprint!r} — different instance, GA config, "
                 f"island count, or seed")
@@ -129,3 +174,42 @@ def load(path: str, fingerprint: str):
                      if "best_seen" in z else None)
         seed = int(z["seed"]) if "seed" in z else None
     return state, key, generation, best_seen, seed
+
+
+def load(path: str, fingerprint: str):
+    """Restore (state, key, generation, best_seen, seed); raises
+    FingerprintMismatch (a ValueError) on a config mismatch. best_seen
+    is None for pre-v2 checkpoints.
+
+    A corrupt `path` (truncated npz, bad magic — _CORRUPT_ERRORS) falls
+    back to the rotated previous-good file `prev_path(path)`; so does a
+    missing `path` when the rotated file exists (a crash between save's
+    two renames leaves exactly that state). When neither file is
+    readable the error is a CheckpointCorrupt naming BOTH paths."""
+    prev = prev_path(path)
+    try:
+        return _load_one(path, fingerprint)
+    except FingerprintMismatch:
+        raise
+    except FileNotFoundError:
+        if not os.path.exists(prev):
+            raise
+        first_err: BaseException = FileNotFoundError(path)
+    except _CORRUPT_ERRORS as e:
+        first_err = e
+    if not os.path.exists(prev):
+        raise CheckpointCorrupt(
+            f"checkpoint {path!r} is unreadable ({first_err!r}) and no "
+            f"previous checkpoint {prev!r} exists") from first_err
+    try:
+        result = _load_one(prev, fingerprint)
+    except (FingerprintMismatch, FileNotFoundError,
+            *_CORRUPT_ERRORS) as e2:
+        raise CheckpointCorrupt(
+            f"checkpoint {path!r} is unreadable ({first_err!r}) and the "
+            f"previous checkpoint {prev!r} failed too ({e2!r})"
+        ) from first_err
+    print(f"warning: checkpoint {path!r} is unreadable "
+          f"({str(first_err)[:120]}); resuming from the previous "
+          f"checkpoint {prev!r}", file=sys.stderr)
+    return result

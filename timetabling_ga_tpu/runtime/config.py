@@ -50,6 +50,19 @@ TPU-specific extensions (SURVEY section 7.6):
                           happen between dispatches)
     --trace               emit {"phase": ...} timing records (extension;
                           the reference's 3 record types are unchanged)
+
+Fault tolerance (README "Fault tolerance"; runtime/engine.py run
+supervisor + runtime/faults.py):
+    --max-recoveries <int>  in-run transient-failure recoveries before
+                          the run aborts (default 3; 0 disables
+                          recovery — every failure propagates)
+    --fetch-timeout <secs>  deadline watchdog on every classified
+                          control-fence host read; a hung fetch becomes
+                          a recoverable timeout error instead of a
+                          silent stall (default 600; 0 disables)
+    --faults <spec>       deterministic fault injection plan
+                          (site:nth:action, comma-separated — see
+                          runtime/faults.py); defaults to $TT_FAULTS
 """
 
 from __future__ import annotations
@@ -176,6 +189,21 @@ class RunConfig:
     # jax.distributed.initialize is called before any device use when
     # --distributed or --coordinator is given; the island mesh then spans
     # every process's devices (ICI within a slice, DCN across hosts)
+    # ---- in-run fault recovery (engine run supervisor; README "Fault
+    # tolerance"): transient dispatch/fetch failures rehydrate device
+    # state from the rolling host snapshot and resume, with the lost
+    # wall time charged against the trial budget
+    max_recoveries: int = 3   # recoveries before the run aborts with a
+    #                           final durable checkpoint (0 = off)
+    fetch_timeout: float = 600.0  # seconds before a control-fence host
+    #                           read is abandoned as a timeout error —
+    #                           the hung-RPC worst case becomes a
+    #                           classified, recoverable failure
+    #                           (0 = no watchdog)
+    faults: Optional[str] = None  # deterministic fault-injection plan
+    #                           (runtime/faults.py grammar); None reads
+    #                           $TT_FAULTS — the tier-1 recovery tests
+    #                           drive every path above through this
     distributed: bool = False     # auto-detected initialize() (TPU pods)
     coordinator: Optional[str] = None  # host:port of process 0
     num_processes: Optional[int] = None
@@ -330,6 +358,9 @@ _FLAG_MAP = {
     "--epochs-per-dispatch": ("epochs_per_dispatch", int),
     "--kick-stall": ("kick_stall", int),
     "--trace-profile": ("trace_profile", str),
+    "--max-recoveries": ("max_recoveries", int),
+    "--fetch-timeout": ("fetch_timeout", float),
+    "--faults": ("faults", str),
     "--coordinator": ("coordinator", str),
     "--num-processes": ("num_processes", int),
     "--process-id": ("process_id", int),
@@ -410,6 +441,12 @@ def parse_args(argv) -> RunConfig:
                          "cannot represent; drop one of the two flags")
     if cfg.post_pop_size is not None and cfg.post_pop_size < 1:
         raise SystemExit("--post-pop-size must be >= 1")
+    if cfg.max_recoveries < 0:
+        raise SystemExit("--max-recoveries must be >= 0 (0 disables "
+                         "in-run recovery)")
+    if cfg.fetch_timeout < 0:
+        raise SystemExit("--fetch-timeout must be >= 0 seconds "
+                         "(0 disables the fetch watchdog)")
     if cfg.post_lahc < 0:
         raise SystemExit("--post-lahc must be >= 0 (history length; "
                          "0 disables the LAHC endgame)")

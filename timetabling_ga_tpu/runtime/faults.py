@@ -1,0 +1,190 @@
+"""Deterministic fault injection — the test double for the tunnel's
+sick windows.
+
+Every recovery path the run supervisor implements (runtime/engine.py)
+exists because the REAL device occasionally kills dispatches with
+'UNAVAILABLE', hangs a fetch RPC mid-stream, or lets a process die with
+a half-written checkpoint (BASELINE.md round-4 diagnosis, BENCH_r05).
+None of that is reproducible on demand, so this module provides the
+faults on demand instead: named injection points threaded through the
+engine's dispatch sites, the control-fence fetch path, the
+jsonl.AsyncWriter worker, and checkpoint.save — each of which calls
+`maybe_fail(site)` exactly once per logical operation. A fault plan
+then makes the Nth invocation of a site fail in a chosen way, so every
+recovery path runs deterministically on the CPU backend in tier-1 with
+no real TPU sick window required.
+
+Grammar (env var `TT_FAULTS`, or RunConfig.faults / `--faults`):
+
+    TT_FAULTS=dispatch:3:unavailable,fetch:5:hang,writer:1:die,ckpt:2:truncate
+
+Each entry is `site:nth:action` — on the `nth` (1-based) invocation of
+`site`, perform `action`:
+
+    unavailable  raise RuntimeError wrapping an inner exception whose
+                 message carries 'UNAVAILABLE' (the jit-dispatch
+                 wrapping shape — retry.is_transient must walk the
+                 cause chain to classify it)
+    hang         sleep for TT_FAULT_HANG_S seconds (default 3600) —
+                 inside the fetch watchdog's monitored thread this
+                 becomes a deadline timeout, the designed detection
+    die          raise SystemExit — inside the AsyncWriter worker the
+                 thread exits silently without draining its queue (the
+                 worker-death scenario the death-aware enqueue guards)
+    truncate     truncate the just-written file to half its size (the
+                 torn-checkpoint scenario the path.prev rotation
+                 recovers from); requires the site to pass `path=`
+    error        raise FaultInjected directly (a NON-transient failure:
+                 the supervisor must re-raise, not recover)
+
+Sites currently wired: `dispatch` (engine generation/polish/LAHC/kick
+dispatch sites), `fetch` (every classified control-fence host read,
+inside the watchdog thread), `writer` (AsyncWriter worker, once per
+dequeued item), `ckpt` (checkpoint.save, after the durable rename).
+
+The plan is installed per engine.run call (`install`), which resets the
+per-site counters — invocation indices are deterministic within one
+run. With no plan installed every `maybe_fail` is a no-op costing one
+dict lookup. Stdlib-only: jsonl/checkpoint import this module, and
+nothing here may import jax or the rest of the runtime.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+HANG_S = float(os.environ.get("TT_FAULT_HANG_S", "3600"))
+
+ACTIONS = ("unavailable", "hang", "die", "truncate", "error")
+
+# the wired injection points — a closed set, validated at parse time so
+# a typo'd site fails loudly instead of becoming a silent no-op plan
+# (the exact failure mode a deterministic harness exists to prevent)
+SITES = ("dispatch", "fetch", "writer", "ckpt")
+
+
+class FaultInjected(Exception):
+    """An injected fault (also the inner 'device' error for the
+    `unavailable` action, whose message carries the transient marker)."""
+
+
+class FaultPlanError(ValueError):
+    """Malformed TT_FAULTS specification."""
+
+
+class FaultPlan:
+    """Parsed `site:nth:action` entries plus per-site invocation
+    counters. Thread-safe: the writer worker and the fetch watchdog
+    threads hit `maybe_fail` concurrently with the main loop."""
+
+    def __init__(self, entries: dict):
+        # {site: {nth: action}}
+        self._entries = entries
+        self._counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.injected = 0          # actions actually triggered
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        entries: dict[str, dict[int, str]] = {}
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            parts = item.split(":")
+            if len(parts) != 3:
+                raise FaultPlanError(
+                    f"bad TT_FAULTS entry {item!r} (want site:nth:action)")
+            site, nth_s, action = (p.strip() for p in parts)
+            try:
+                nth = int(nth_s)
+            except ValueError:
+                raise FaultPlanError(
+                    f"bad TT_FAULTS index {nth_s!r} in {item!r}") from None
+            if nth < 1:
+                raise FaultPlanError(
+                    f"TT_FAULTS index must be >= 1 in {item!r}")
+            if site not in SITES:
+                raise FaultPlanError(
+                    f"unknown TT_FAULTS site {site!r} in {item!r} "
+                    f"(one of {', '.join(SITES)})")
+            if action not in ACTIONS:
+                raise FaultPlanError(
+                    f"unknown TT_FAULTS action {action!r} in {item!r} "
+                    f"(one of {', '.join(ACTIONS)})")
+            entries.setdefault(site, {})[nth] = action
+        return cls(entries)
+
+    def pop_action(self, site: str):
+        """Count one invocation of `site`; return the action due at this
+        index, or None."""
+        with self._lock:
+            n = self._counts.get(site, 0) + 1
+            self._counts[site] = n
+            act = self._entries.get(site, {}).pop(n, None)
+            if act is not None:
+                self.injected += 1
+            return act
+
+
+# the active plan (None = injection disabled) and a process-lifetime
+# count of triggered faults (bench.py records per-leg deltas)
+_PLAN: FaultPlan | None = None
+_INJECTED_TOTAL = 0
+
+
+def install(spec: str | None) -> FaultPlan | None:
+    """Install the plan for `spec` (resetting all counters), or disable
+    injection when `spec` is falsy. Called by engine.run with
+    RunConfig.faults, falling back to the TT_FAULTS env var."""
+    global _PLAN, _INJECTED_TOTAL
+    if _PLAN is not None:
+        _INJECTED_TOTAL += _PLAN.injected
+    if not spec:
+        _PLAN = None
+    else:
+        _PLAN = FaultPlan.parse(spec)
+    return _PLAN
+
+
+def active_spec(cfg_spec: str | None = None) -> str | None:
+    """The spec to install: explicit config wins, else TT_FAULTS."""
+    return cfg_spec if cfg_spec else os.environ.get("TT_FAULTS") or None
+
+
+def injected_total() -> int:
+    """Faults triggered over the process lifetime (all plans)."""
+    return _INJECTED_TOTAL + (_PLAN.injected if _PLAN is not None else 0)
+
+
+def maybe_fail(site: str, path: str | None = None) -> None:
+    """One logical operation at `site`; trigger the plan's fault for
+    this invocation index, if any. No-op without an installed plan."""
+    plan = _PLAN
+    if plan is None:
+        return
+    act = plan.pop_action(site)
+    if act is None:
+        return
+    if act == "unavailable":
+        inner = FaultInjected(
+            f"UNAVAILABLE: TPU device error — injected fault "
+            f"(site {site})")
+        raise RuntimeError(
+            f"injected transient failure at {site}") from inner
+    if act == "hang":
+        time.sleep(HANG_S)
+        return
+    if act == "die":
+        raise SystemExit(f"injected thread death at {site}")
+    if act == "truncate":
+        if path is not None and os.path.exists(path):
+            size = os.path.getsize(path)
+            with open(path, "r+b") as fh:
+                fh.truncate(max(1, size // 2))
+        return
+    if act == "error":
+        raise FaultInjected(
+            f"injected non-transient failure at {site}")

@@ -36,6 +36,8 @@ import queue
 import threading
 from typing import IO, List, Optional
 
+from timetabling_ga_tpu.runtime import faults
+
 
 def _write(stream: IO, obj: dict) -> None:
     stream.write(json.dumps(obj, separators=(",", ":")) + "\n")
@@ -85,6 +87,14 @@ class AsyncWriter:
     def _worker(self) -> None:
         while True:
             item = self._q.get()
+            # fault-injection point (runtime/faults.py `writer` site):
+            # an injected death exits the thread WITHOUT task_done — the
+            # worker-death scenario the death-aware enqueue/drain below
+            # must turn into a raised error, not a deadlock
+            try:
+                faults.maybe_fail("writer")
+            except SystemExit:
+                return
             try:
                 if item is self._STOP:
                     return
@@ -122,10 +132,42 @@ class AsyncWriter:
             # failures must fail the run' contract
             raise RuntimeError("AsyncWriter is closed")
 
+    def _put(self, item) -> None:
+        """Death-aware enqueue: a plain `queue.put` on a full queue
+        blocks FOREVER if the worker thread has died (nothing will ever
+        drain it) — the producer then hangs instead of failing. Bounded
+        waits re-check worker liveness between attempts and raise the
+        pending worker error (or a thread-death error) instead."""
+        while True:
+            if not self._thread.is_alive():
+                self._raise_pending()
+                raise RuntimeError(
+                    "AsyncWriter worker thread died; enqueue would "
+                    "never drain")
+            try:
+                self._q.put(item, timeout=0.2)
+                return
+            except queue.Full:
+                continue
+
+    def _await_drained(self) -> None:
+        """Death-aware queue join: `Queue.join` waits on task_done
+        calls only the worker makes, so a dead worker turns it into a
+        deadlock. Wait on the same condition with a liveness check."""
+        q = self._q
+        with q.all_tasks_done:
+            while q.unfinished_tasks:
+                if not self._thread.is_alive():
+                    self._raise_pending()
+                    raise RuntimeError(
+                        "AsyncWriter worker thread died with items "
+                        "still queued")
+                q.all_tasks_done.wait(0.1)
+
     def write(self, s: str) -> None:
         self._check_open()
         self._raise_pending()
-        self._q.put(s)
+        self._put(s)
 
     def flush(self) -> None:
         """No-op: the worker flushes after every record. (The emitters
@@ -137,11 +179,11 @@ class AsyncWriter:
         record already queued."""
         self._check_open()
         self._raise_pending()
-        self._q.put(job)
+        self._put(job)
 
     def drain(self) -> None:
         """Block until the queue is empty and every item is written."""
-        self._q.join()
+        self._await_drained()
         self._raise_pending()
 
     def close(self, raise_error: bool = True) -> None:
@@ -149,12 +191,19 @@ class AsyncWriter:
         underlying stream (the engine owns that). `raise_error=False`
         swallows a pending worker error — for close() calls already on
         an exception path, where re-raising would MASK the run's real
-        failure (retry/diagnosis match on the propagating exception)."""
+        failure (retry/diagnosis match on the propagating exception).
+        Both the STOP enqueue and the drain are death-aware, so closing
+        after a worker death raises (or swallows) instead of hanging."""
         if not self._closed:
             self._closed = True
-            self._q.put(self._STOP)
-            self._q.join()
-            self._thread.join()
+            try:
+                self._put(self._STOP)
+                self._await_drained()
+            except BaseException:
+                if raise_error:
+                    self._thread.join(timeout=1.0)
+                    raise
+            self._thread.join(timeout=5.0)
         if raise_error:
             self._raise_pending()
 
@@ -192,6 +241,33 @@ def solution_record(stream: IO, proc_id: int, thread_id: int,
     _write(stream, {"solution": rec})
 
 
+def fault_entry(stream: IO, site: str, action: str, error, trial: int,
+                recovery: int, level: int, time_s: float,
+                **extra) -> None:
+    """Robustness EXTENSION record (not in the reference protocol;
+    always emitted — a recovery changes the run's trust story, so it
+    must be visible without --trace). One line per supervisor event:
+
+      {"faultEntry":{"site":"dispatch","action":"recover",
+                     "error":"...","trial":0,"recovery":1,"level":0,
+                     "time":12.3, ...}}
+
+    `site` is the failing operation class (dispatch/fetch/writer/ckpt/
+    run), `action` one of recover (state rehydrated, loop resumed),
+    degrade (the ladder stepped: level 1 = serial dispatch, level >= 2
+    = halved dispatch chunks), or abort (--max-recoveries exhausted;
+    the run raises after this record). `recovery` counts recoveries so
+    far this run; `time` is seconds into the trial — the lost wall
+    time stays charged against the trial budget."""
+    rec = {"site": str(site), "action": str(action),
+           "error": str(error)[:200], "trial": int(trial),
+           "recovery": int(recovery), "level": int(level),
+           "time": max(0.0, float(time_s))}
+    for k, v in extra.items():
+        rec[k] = v
+    _write(stream, {"faultEntry": rec})
+
+
 def phase_record(stream: IO, name: str, trial: int, seconds: float,
                  **extra) -> None:
     """Observability EXTENSION record (not in the reference protocol;
@@ -217,12 +293,15 @@ TIMING_FIELDS = {"logEntry": ("time",), "solution": ("totalTime",),
 
 
 def strip_timing(records: List[dict]) -> List[dict]:
-    """Protocol records minus phase records and timing fields — the
-    byte-identity domain of the pipeline A/B (bench.py extra.pipeline,
-    tests/test_runtime.py pipeline determinism)."""
+    """Protocol records minus phase/fault records and timing fields —
+    the byte-identity domain of the pipeline A/B (bench.py
+    extra.pipeline, tests/test_runtime.py pipeline determinism) AND of
+    the fault-recovery determinism contract (a recovered run matches an
+    uninjected one modulo timing and fault records — tests/
+    test_faults.py)."""
     out = []
     for rec in records:
-        if "phase" in rec:
+        if "phase" in rec or "faultEntry" in rec:
             continue
         rec = json.loads(json.dumps(rec))   # deep copy, JSON domain
         for kind, fields in TIMING_FIELDS.items():

@@ -23,27 +23,64 @@ import sys
 import time
 
 # substrings identifying a transient tunnel/device failure. Matched
-# against str(exception); anything else re-raises immediately — a real
-# bug must never be retried into flakiness.
+# against str(exception) over the WHOLE cause chain; anything else
+# re-raises immediately — a real bug must never be retried into
+# flakiness.
 TRANSIENT_MARKERS = (
     "UNAVAILABLE",
     "response body closed",     # remote_compile RPC died mid-stream
     "remote_compile",           # any other remote-compile tunnel error
+    "fetch watchdog",           # engine._fetch deadline timeout (a hung
+    #                             control-fence read is assumed to be a
+    #                             tunnel stall, not a program bug)
 )
+
+# cause-chain walk bound: a pathological cycle (e1.__cause__ = e2,
+# e2.__context__ = e1) must not spin the classifier forever
+_CHAIN_LIMIT = 16
 
 
 def is_transient(exc: BaseException) -> bool:
-    return any(m in str(exc) for m in TRANSIENT_MARKERS)
+    """True when `exc` — or anything on its `__cause__`/`__context__`
+    chain — carries a transient tunnel/device marker. jit dispatch wraps
+    the XLA 'UNAVAILABLE' error in a RuntimeError, so matching only the
+    top exception misclassified exactly the failures this policy exists
+    to absorb."""
+    seen: set[int] = set()
+    e: BaseException | None = exc
+    while e is not None and len(seen) < _CHAIN_LIMIT:
+        if id(e) in seen:
+            break
+        seen.add(id(e))
+        if any(m in str(e) for m in TRANSIENT_MARKERS):
+            return True
+        e = e.__cause__ if e.__cause__ is not None else e.__context__
+    return False
 
 
-def retry_transient(fn, *args, attempts: int = 3, wait_s: float = 120.0):
+def backoff_schedule(attempts: int, wait_s: float, backoff: float,
+                     max_wait_s: float):
+    """The waits retry_transient sleeps between attempts: exponential
+    from `wait_s` by `backoff`, capped at `max_wait_s`. Exposed so tests
+    pin the schedule without sleeping through it."""
+    return [min(wait_s * backoff ** i, max_wait_s)
+            for i in range(max(0, attempts - 1))]
+
+
+def retry_transient(fn, *args, attempts: int = 3, wait_s: float = 120.0,
+                    backoff: float = 2.0, max_wait_s: float = 600.0):
     """Call `fn(*args)`; retry on transient tunnel/device errors.
 
-    Returns `(result, attempts_used)` so callers can record how many
-    tries the measurement cost (bench legs persist it in their JSON).
-    Non-transient errors and the final attempt re-raise, with
-    `exc.tt_attempts` set to the attempts consumed. Timed results are
-    unaffected: a run either completes its full budget or raises."""
+    Waits grow exponentially (`wait_s * backoff**(attempt-1)`, capped at
+    `max_wait_s`): the sick windows run from seconds to minutes, and a
+    fixed wait either burns budget on short blips or re-enters a long
+    window still sick. Returns `(result, attempts_used)` so callers can
+    record how many tries the measurement cost (bench legs persist it
+    in their JSON). Non-transient errors and the final attempt
+    re-raise, with `exc.tt_attempts` set to the attempts consumed.
+    Timed results are unaffected: a run either completes its full
+    budget or raises."""
+    waits = backoff_schedule(attempts, wait_s, backoff, max_wait_s)
     for attempt in range(1, attempts + 1):
         try:
             return fn(*args), attempt
@@ -51,11 +88,12 @@ def retry_transient(fn, *args, attempts: int = 3, wait_s: float = 120.0):
             e.tt_attempts = attempt
             if not is_transient(e) or attempt == attempts:
                 raise
+            wait = waits[attempt - 1]
             print(f"# transient device error "
                   f"({getattr(fn, '__name__', 'fn')}, attempt "
                   f"{attempt}/{attempts}): {str(e)[:120]}; retrying in "
-                  f"{wait_s:.0f}s", file=sys.stderr, flush=True)
-            time.sleep(wait_s)
+                  f"{wait:.0f}s", file=sys.stderr, flush=True)
+            time.sleep(wait)
 
 
 def retry_unavailable(fn, *args, attempts: int = 3, wait_s: float = 120.0):
