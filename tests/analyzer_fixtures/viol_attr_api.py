@@ -1,0 +1,48 @@
+"""TT502 fixture: jax.* attribute access outside the pinned table.
+
+Not imported or executed — parsed by tests/test_analysis.py. This is
+the gap TT501 cannot see: `jax.profiler.start_trace` never appears in
+an import statement, but an attribute a supported JAX version does not
+export fails exactly like an undeclared import — at the first call.
+"""
+import functools
+
+import jax
+import jax as j
+import jax.numpy as jnp
+
+
+def uses_declared_surface(x):
+    jax.profiler.start_trace("/tmp/t")          # OK: declared
+    jax.profiler.stop_trace()                   # OK: declared
+    jax.distributed.initialize()                # OK: declared
+    jax.config.update("jax_platforms", "cpu")   # OK: declared
+    y = jax.jit(lambda a: a + 1)(x)             # OK: declared
+    return jax.block_until_ready(y)
+
+
+def undeclared_attributes(x):
+    jax.profiler.annotate_function(x)    # EXPECT TT502 (not in table)
+    jax.distributed.shutdown()           # EXPECT TT502 (not in table)
+    jax.live_arrays()                    # EXPECT TT502 (not under jax)
+    j.experimental.pallas.when(x)        # EXPECT TT502 (via alias too)
+    return jnp.asarray(x)                # OK: jax.numpy is "*"
+
+
+def wildcard_and_deep_ok(key):
+    a = jax.random.normal(key, (2,))     # OK: jax.random is "*"
+    b = jax.tree.map(lambda v: v, a)     # OK: jax.tree is "*"
+    jax.tree_util.register_pytree_node(int, None, None)  # OK: declared
+    return functools.reduce(lambda u, v: u + v, [a, b])
+
+
+def guarded_probe_is_exempt():
+    try:
+        return jax.extend.backend.get_backend()   # OK: guarded
+    except AttributeError:
+        return None
+
+
+jax.numpy.asarray(0)                     # OK: jax.numpy is "*"
+_bad = jax.sharding.AbstractMesh         # EXPECT TT502 (not declared)
+_ok = getattr(jax, "live_arrays", None)  # OK: getattr probing

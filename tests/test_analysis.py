@@ -64,6 +64,7 @@ def analyze_fixture(fixture: str):
     "viol_rng.py",         # TT401 RNG key reuse
     "viol_loopkey.py",     # TT402 loop-carried key reuse
     "viol_api.py",         # TT501 pinned API surface
+    "viol_attr_api.py",    # TT502 attribute-access API pinning
 ])
 def test_rule_fires_at_expected_lines(fixture):
     """Each rule family fires exactly at the marked (rule, line) pairs —

@@ -347,6 +347,69 @@ def test_recovery_exhaustion_aborts_cleanly(tim_file, tmp_path):
         assert "generation" in z and "slots" in z
 
 
+def test_init_site_kill_retries_with_identical_jsonl(tim_file):
+    """ISSUE 4 satellite (ROADMAP PR-3 follow-up): a transient failure
+    at the INIT dispatch — before the first supervisor snapshot exists
+    — is retried by the supervised-init wrapper instead of propagating,
+    and the stream stays identical to an uninjected run's modulo timing
+    and fault records."""
+    clean_best, clean = _go(tim_file, pipeline=False)
+    best, lines = _go(tim_file, pipeline=False,
+                      faults="init:1:unavailable")
+    fe = _fault_entries(lines)
+    assert [e["action"] for e in fe] == ["recover"]
+    assert fe[0]["site"] == "init" and fe[0].get("init") is True
+    assert best == clean_best
+    assert jsonl.strip_timing(lines) == jsonl.strip_timing(clean)
+
+
+def test_init_retry_covers_init_polish_window(tim_file):
+    """The retry wraps the whole pre-snapshot window: a dispatch kill
+    INSIDE the init polish (dispatch site invocation 1, with
+    init_sweeps > 0) re-runs init+polish from the same keys; the
+    emitted floor keeps replayed polish bests from duplicating."""
+    clean_best, clean = _go(tim_file, pipeline=False, init_sweeps=3)
+    best, lines = _go(tim_file, pipeline=False, init_sweeps=3,
+                      faults="dispatch:1:unavailable")
+    fe = _fault_entries(lines)
+    assert [e["action"] for e in fe] == ["recover"]
+    assert fe[0].get("init") is True
+    assert best == clean_best
+    assert jsonl.strip_timing(lines) == jsonl.strip_timing(clean)
+
+
+def test_init_retry_bounded_and_disabled_by_zero_recoveries(tim_file):
+    """Three consecutive init kills exhaust the bounded retry (2) and
+    the last error propagates; with --max-recoveries 0 the FIRST init
+    failure propagates untouched — no hidden retry behind the
+    recovery-off switch."""
+    buf = io.StringIO()
+    from timetabling_ga_tpu.runtime import engine
+    cfg = RunConfig(input=tim_file, seed=3, pop_size=8, islands=1,
+                    generations=30, migration_period=10, max_steps=8,
+                    time_limit=300, backend="cpu", auto_tune=False,
+                    pipeline=False,
+                    faults="init:1:unavailable,init:2:unavailable,"
+                           "init:3:unavailable")
+    with pytest.raises(RuntimeError) as ei:
+        engine.run(cfg, out=buf)
+    assert retry.is_transient(ei.value)
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    assert [e["action"] for e in _fault_entries(lines)] == [
+        "recover", "recover"]
+    # recovery disabled: the init window is NOT silently retried
+    buf2 = io.StringIO()
+    cfg2 = RunConfig(input=tim_file, seed=3, pop_size=8, islands=1,
+                     generations=30, migration_period=10, max_steps=8,
+                     time_limit=300, backend="cpu", auto_tune=False,
+                     pipeline=False, max_recoveries=0,
+                     faults="init:1:unavailable")
+    with pytest.raises(RuntimeError):
+        engine.run(cfg2, out=buf2)
+    lines2 = [json.loads(x) for x in buf2.getvalue().splitlines()]
+    assert _fault_entries(lines2) == []
+
+
 def test_non_transient_injected_error_is_not_recovered(tim_file):
     """The supervisor must never retry a real bug into flakiness: the
     `error` action raises a NON-transient failure, which propagates

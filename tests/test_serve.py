@@ -1,0 +1,466 @@
+"""tt-serve (ISSUE 4): shape bucketing, the job queue, the packing/
+time-slicing scheduler, and the line-JSON service frontend.
+
+The two acceptance properties pinned here:
+
+  1. padding is NEUTRAL: a bucket-padded instance evaluates (penalty,
+     hcv, scv) bit-exactly equal to the unpadded instance for any
+     genotype, on the committed ITC fixtures — and the greedy matcher
+     assigns live events the same rooms;
+  2. the bucket is the compile key: two instances of DIFFERENT sizes
+     in the same bucket trigger exactly one trace of each island
+     program (islands.TRACE_COUNTS), and a third job into the warm
+     bucket compiles nothing.
+"""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from timetabling_ga_tpu.ops import fitness, ga
+from timetabling_ga_tpu.ops.rooms import (
+    batch_assign_rooms, batch_parallel_assign_rooms)
+from timetabling_ga_tpu.parallel import islands
+from timetabling_ga_tpu.problem import (
+    dump_tim, load_tim_file, random_instance)
+from timetabling_ga_tpu.runtime import jsonl
+from timetabling_ga_tpu.runtime.config import ServeConfig, parse_serve_args
+from timetabling_ga_tpu.serve import (
+    AdmissionError, BucketSpec, Job, JobQueue, JobState, bucket_dims,
+    bucket_key, pad_problem)
+from timetabling_ga_tpu.serve.bucket import embed_population
+from timetabling_ga_tpu.serve.service import SolveService, serve_stream
+
+FIXTURES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "fixtures")
+
+SPEC = BucketSpec()
+
+
+def _cfg(**kw):
+    kw.setdefault("backend", "cpu")
+    kw.setdefault("lanes", 2)
+    kw.setdefault("quantum", 10)
+    kw.setdefault("pop_size", 6)
+    kw.setdefault("max_steps", 8)
+    return ServeConfig(**kw)
+
+
+def _records(buf):
+    return [json.loads(x) for x in buf.getvalue().splitlines()]
+
+
+def _job_records(lines, job_id):
+    out = []
+    for rec in lines:
+        kind = next(iter(rec))
+        if rec[kind].get("job") == job_id:
+            out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------- bucketing
+
+def test_bucket_dims_geometric():
+    p = random_instance(0, n_events=20, n_rooms=3, n_features=2,
+                        n_students=12, attend_prob=0.1)
+    assert bucket_dims(p, SPEC) == (32, 4, 4, 32)
+    q = random_instance(0, n_events=33, n_rooms=9, n_features=5,
+                        n_students=70, attend_prob=0.05)
+    assert bucket_dims(q, SPEC) == (64, 16, 8, 128)
+    # the slot grid is part of the key, never padded
+    assert bucket_key(p, SPEC) == (32, 4, 4, 32, 5, 9)
+    # idempotent: an exactly-bucket-shaped instance keeps its dims
+    pp = pad_problem(p, SPEC)
+    assert bucket_dims(pp, SPEC) == (32, 4, 4, 32)
+    assert (pp.n_events, pp.n_rooms, pp.n_features,
+            pp.n_students) == (32, 4, 4, 32)
+    assert pp.n_live_events == 20 and pp.n_live_rooms == 3
+
+
+def test_padding_contract_possible_and_masks():
+    p = random_instance(3, n_events=10, n_rooms=3, n_features=2,
+                        n_students=8, attend_prob=0.2)
+    pp = pad_problem(p, SPEC)
+    assert not pp.possible[p.n_events:, :].any()    # padded events: none
+    assert not pp.possible[:, p.n_rooms:].any()     # padded rooms: none
+    np.testing.assert_array_equal(pp.possible[:10, :3], p.possible)
+    pa = pp.device_arrays()
+    np.testing.assert_array_equal(
+        np.asarray(pa.event_mask), (np.arange(32) < 10).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(pa.room_mask), np.arange(4) < 3)
+    # padded events carry nothing: zero attendance, zero conflict
+    assert pp.attends[:, 10:].sum() == 0
+    assert pp.student_count[10:].sum() == 0
+    assert not pp.conflict[10:, :].any()
+
+
+@pytest.mark.parametrize("name", ["comp01s", "comp05s"])
+def test_padded_penalty_bit_exact_on_itc_fixture(name):
+    """ISSUE 4 acceptance: padded bucket evaluation is bit-exact with
+    unpadded on the ITC fixtures — penalty, hcv AND scv, per
+    individual, for arbitrary genotypes."""
+    p = load_tim_file(os.path.join(FIXTURES, f"{name}.tim"))
+    pp = pad_problem(p, SPEC)
+    rng = np.random.default_rng(7)
+    P = 4
+    slots = rng.integers(0, p.n_slots, size=(P, p.n_events)).astype(
+        np.int32)
+    rooms = rng.integers(0, p.n_rooms, size=(P, p.n_events)).astype(
+        np.int32)
+    s_pad, r_pad = embed_population(slots, rooms, pp)
+
+    pen, hcv, scv = fitness.batch_penalty(p.device_arrays(), slots, rooms)
+    pen2, hcv2, scv2 = fitness.batch_penalty(pp.device_arrays(),
+                                             s_pad, r_pad)
+    np.testing.assert_array_equal(np.asarray(pen), np.asarray(pen2))
+    np.testing.assert_array_equal(np.asarray(hcv), np.asarray(hcv2))
+    np.testing.assert_array_equal(np.asarray(scv), np.asarray(scv2))
+
+
+@pytest.mark.parametrize("name", ["comp01s"])
+def test_padded_matching_bit_exact_on_itc_fixture(name):
+    """The greedy matcher gives LIVE events identical rooms on the
+    padded instance (padded rooms carry the _W_DEAD key penalty and
+    padded events occupy nothing, so every live argmin is preserved)."""
+    p = load_tim_file(os.path.join(FIXTURES, f"{name}.tim"))
+    pp = pad_problem(p, SPEC)
+    rng = np.random.default_rng(11)
+    P = 3
+    slots = rng.integers(0, p.n_slots, size=(P, p.n_events)).astype(
+        np.int32)
+    s_pad, _ = embed_population(slots, np.zeros_like(slots), pp)
+
+    rooms = np.asarray(batch_assign_rooms(p.device_arrays(), slots))
+    rooms_pad = np.asarray(batch_assign_rooms(pp.device_arrays(), s_pad))
+    np.testing.assert_array_equal(rooms, rooms_pad[:, :p.n_events])
+    # live events never land in a padded (dead) room
+    assert (rooms_pad[:, :p.n_events] < p.n_rooms).all()
+
+    par = np.asarray(batch_parallel_assign_rooms(p.device_arrays(), slots))
+    par_pad = np.asarray(
+        batch_parallel_assign_rooms(pp.device_arrays(), s_pad))
+    np.testing.assert_array_equal(par, par_pad[:, :p.n_events])
+
+
+def test_padded_event_deltas_are_zero():
+    """A padded event's relocation has EXACTLY zero delta on every
+    delta-evaluation path (sweep Move1 and the shared 3-relocation
+    kernel) — a padded move may be taken, but can never change a
+    penalty or corrupt a live event's maintained occupancy."""
+    import jax.numpy as jnp
+    from timetabling_ga_tpu.ops.delta import _delta_one, init_state
+    from timetabling_ga_tpu.ops.rooms import capacity_rank
+    from timetabling_ga_tpu.ops.sweep import _move1_sweep
+
+    p = random_instance(5, n_events=12, n_rooms=3, n_features=2,
+                        n_students=10, attend_prob=0.2)
+    pp = pad_problem(p, SPEC)
+    pa = pp.device_arrays()
+    rng = np.random.default_rng(0)
+    slots = rng.integers(0, p.n_slots, size=(1, pp.n_events)).astype(
+        np.int32)
+    rooms = np.asarray(batch_assign_rooms(pa, jnp.asarray(slots)))
+    st = init_state(pa, jnp.asarray(slots), jnp.asarray(rooms))
+    cap = capacity_rank(pa)
+
+    padded_e = jnp.int32(p.n_events + 1)        # a padding event
+    d_hcv, d_scv, _ = _move1_sweep(
+        pa, st.slots[0], st.rooms[0], st.att[0], st.occ[0], padded_e, cap)
+    assert not np.asarray(d_hcv).any()
+    assert not np.asarray(d_scv).any()
+
+    evs = jnp.asarray([p.n_events + 1, p.n_events + 2, 0], jnp.int32)
+    ns = jnp.asarray([3, 4, int(slots[0, 0])], jnp.int32)
+    active = jnp.asarray([True, True, False])
+    dh, ds, _ = _delta_one(pa, st.slots[0], st.rooms[0], st.att[0],
+                           st.occ[0], evs, ns, active, cap)
+    assert int(dh) == 0 and int(ds) == 0
+
+
+def test_oversize_bucket_rejected_cleanly():
+    """Geometric rounding must not manufacture an instance that trips
+    the room-key packing bound (`assert E < 4096`, ops/rooms.py) at
+    trace time: pad_problem rejects it at admission, and a failing
+    submit leaves the service fully usable with the queue untouched."""
+    big = random_instance(99, n_events=2500, n_rooms=3, n_features=2,
+                          n_students=5, attend_prob=0.01)
+    with pytest.raises(ValueError, match="packing bound"):
+        pad_problem(big, SPEC)
+
+    buf = io.StringIO()
+    svc = SolveService(_cfg(), out=buf)
+    with pytest.raises(ValueError, match="packing bound"):
+        svc.submit(big, job_id="huge", generations=5)
+    assert len(svc.queue) == 0        # no half-admitted job left behind
+    ok = svc.submit(random_instance(98, n_events=10, n_rooms=3,
+                                    n_features=2, n_students=6,
+                                    attend_prob=0.2), generations=5)
+    svc.drive()
+    svc.close()
+    assert svc.state(ok) == JobState.DONE
+
+
+def test_unpadded_instances_have_all_live_masks(small_problem):
+    """Every pre-serve construction path yields all-ones masks — the
+    masked kernels then reduce to the unmasked math exactly (the whole
+    existing suite is the regression net for that)."""
+    pa = small_problem.device_arrays()
+    assert np.asarray(pa.event_mask).all()
+    assert np.asarray(pa.room_mask).all()
+
+
+# ---------------------------------------------------------------- queue
+
+def test_queue_admission_priority_cancel():
+    q = JobQueue(backlog=2)
+    p = random_instance(0, n_events=8, n_rooms=2, n_features=2,
+                        n_students=5, attend_prob=0.2)
+    a = Job(id="a", problem=p, priority=0)
+    b = Job(id="b", problem=p, priority=5)
+    q.submit(a)
+    q.submit(b)
+    with pytest.raises(AdmissionError, match="backlog full"):
+        q.submit(Job(id="c", problem=p))
+    # cancel frees a backlog slot; duplicate ids stay rejected
+    assert q.cancel("a")
+    with pytest.raises(AdmissionError, match="duplicate"):
+        q.submit(Job(id="b", problem=p))
+    q.submit(Job(id="d", problem=p))
+    # priority first, FIFO within
+    assert [j.id for j in q.ready()] == ["b", "d"]
+    assert q.get("a").state == JobState.CANCELLED
+    # least-served overtakes within a priority class
+    q.get("b").priority = 0
+    q.get("b").gens_done = 50
+    assert [j.id for j in q.ready()] == ["d", "b"]
+    assert not q.cancel("a")          # terminal: cancel is a no-op
+
+
+# ------------------------------------------------------- compile-once
+
+def test_bucket_compile_reuse_exactly_one_trace():
+    """ISSUE 4 acceptance: two .tim instances of DIFFERENT sizes in the
+    same bucket trigger exactly one trace/compile of each island
+    program; a third job into the warm bucket adds zero."""
+    p1 = random_instance(21, n_events=18, n_rooms=3, n_features=2,
+                         n_students=14, attend_prob=0.1)
+    p2 = random_instance(22, n_events=27, n_rooms=4, n_features=2,
+                         n_students=20, attend_prob=0.1)
+    assert bucket_key(p1, SPEC) == bucket_key(p2, SPEC)
+    assert (p1.n_events, p1.n_rooms) != (p2.n_events, p2.n_rooms)
+
+    # fresh programs: drop any cached lane programs from earlier tests
+    from timetabling_ga_tpu.runtime import engine
+    for cache in (engine._RUNNER_CACHE, engine._INIT_CACHE):
+        for k in [k for k in cache
+                  if isinstance(k[0], str) and k[0].startswith("lane")]:
+            del cache[k]
+    before = dict(islands.TRACE_COUNTS)
+
+    buf = io.StringIO()
+    svc = SolveService(_cfg(), out=buf)
+    a = svc.submit(p1, generations=15, seed=1)
+    b = svc.submit(p2, generations=15, seed=2)
+    svc.drive()
+    assert svc.state(a) == svc.state(b) == JobState.DONE
+    mid = dict(islands.TRACE_COUNTS)
+    assert mid.get("lane_init", 0) - before.get("lane_init", 0) == 1
+    assert mid.get("lane_runner", 0) - before.get("lane_runner", 0) == 1
+
+    # a third, different-size job into the WARM bucket: zero compiles
+    p3 = random_instance(23, n_events=24, n_rooms=2, n_features=3,
+                         n_students=9, attend_prob=0.1)
+    assert bucket_key(p3, SPEC) == bucket_key(p1, SPEC)
+    c = svc.submit(p3, generations=5, seed=3)
+    svc.drive()
+    svc.close()
+    assert svc.state(c) == JobState.DONE
+    assert dict(islands.TRACE_COUNTS) == mid
+
+
+# ------------------------------------------------------- scheduling
+
+def test_small_late_job_completes_while_long_job_runs():
+    """ISSUE 4 satellite: with ONE lane, a small job submitted AFTER a
+    long job still completes while the long job is mid-flight — the
+    least-served ordering hands it the lane at the next control fence
+    instead of letting the long job monopolize the hardware."""
+    long_p = random_instance(31, n_events=16, n_rooms=3, n_features=2,
+                             n_students=10, attend_prob=0.1)
+    small_p = random_instance(32, n_events=12, n_rooms=3, n_features=2,
+                              n_students=8, attend_prob=0.1)
+    buf = io.StringIO()
+    svc = SolveService(_cfg(lanes=1, quantum=5), out=buf)
+    long_id = svc.submit(long_p, generations=100, seed=1)
+    assert svc.step()                 # long job takes the first quantum
+    small_id = svc.submit(small_p, generations=5, seed=2)
+    assert svc.step()                 # fence: small job gets the lane
+    assert svc.state(small_id) == JobState.DONE
+    assert svc.state(long_id) in (JobState.PARKED, JobState.RUNNING)
+    assert svc.queue.get(long_id).gens_done < 100
+    svc.drive()
+    assert svc.state(long_id) == JobState.DONE
+    svc.close()
+    # the small job's records all precede the long job's terminal ones
+    lines = _records(buf)
+    kinds = [(next(iter(r)), r[next(iter(r))].get("job"))
+             for r in lines]
+    assert kinds.index(("runEntry", small_id)) < kinds.index(
+        ("runEntry", long_id))
+
+
+def test_job_stream_independent_of_co_tenants():
+    """RNG isolation: a job's records are bit-identical (modulo timing)
+    whether it runs alone or packed with another tenant — lane RNG
+    derives from (job seed, job progress), never from lane position or
+    dispatch mix."""
+    p = random_instance(41, n_events=14, n_rooms=3, n_features=2,
+                        n_students=10, attend_prob=0.15)
+    other = random_instance(42, n_events=22, n_rooms=4, n_features=2,
+                            n_students=12, attend_prob=0.1)
+
+    buf_solo = io.StringIO()
+    svc = SolveService(_cfg(), out=buf_solo)
+    a = svc.submit(p, job_id="target", generations=25, seed=5)
+    svc.drive()
+    svc.close()
+    assert svc.state(a) == JobState.DONE
+
+    buf_packed = io.StringIO()
+    svc2 = SolveService(_cfg(), out=buf_packed)
+    svc2.submit(other, job_id="noise", generations=40, seed=6)
+    svc2.submit(p, job_id="target", generations=25, seed=5)
+    svc2.drive()
+    svc2.close()
+
+    solo = jsonl.strip_timing(_job_records(_records(buf_solo), "target"))
+    packed = jsonl.strip_timing(
+        _job_records(_records(buf_packed), "target"))
+    assert solo == packed
+
+
+def test_deadline_cuts_budget_and_prestart_deadline_fails():
+    clock = {"t": 0.0}
+
+    def now():
+        return clock["t"]
+
+    p = random_instance(51, n_events=10, n_rooms=3, n_features=2,
+                        n_students=8, attend_prob=0.15)
+    buf = io.StringIO()
+    svc = SolveService(_cfg(lanes=1, quantum=5), out=buf, now=now)
+    a = svc.submit(p, generations=10_000, seed=1, deadline_s=5.0)
+    b = svc.submit(p, generations=10, seed=2, priority=-1,
+                   deadline_s=1.0)
+    svc.step()                        # a runs one quantum at t=0
+    clock["t"] = 10.0                 # both deadlines pass
+    svc.drive()
+    svc.close()
+    assert svc.state(a) == JobState.DONE       # budget CUT, best kept
+    assert svc.result(a)["deadline_hit"] is True
+    assert svc.result(a)["gens"] < 10_000
+    assert svc.state(b) == JobState.FAILED     # never got a slice
+    events = [r["jobEntry"]["event"] for r in _records(buf)
+              if "jobEntry" in r and r["jobEntry"]["job"] == b]
+    assert events == ["admitted", "failed"]
+
+
+# ------------------------------------------------------- protocol
+
+def test_line_json_protocol_end_to_end(tmp_path):
+    p1 = random_instance(61, n_events=10, n_rooms=3, n_features=2,
+                         n_students=8, attend_prob=0.15)
+    p2 = random_instance(62, n_events=13, n_rooms=3, n_features=2,
+                         n_students=9, attend_prob=0.15)
+    tim_path = tmp_path / "p1.tim"
+    tim_path.write_text(dump_tim(p1))
+    requests = "\n".join([
+        json.dumps({"submit": {"id": "f", "instance": str(tim_path),
+                               "generations": 10, "seed": 3}}),
+        json.dumps({"submit": {"id": "i", "tim": dump_tim(p2),
+                               "generations": 10, "seed": 4,
+                               "priority": 2}}),
+        json.dumps({"submit": {"id": "bad", "instance": "/no/such"}}),
+        "not json at all",
+        json.dumps({"cancel": "f"}),
+        json.dumps({"drain": True}),
+    ]) + "\n"
+    buf = io.StringIO()
+    svc = serve_stream(_cfg(backlog=8), io.StringIO(requests),
+                       out_stream=buf)
+    lines = _records(buf)
+    events = [(r["jobEntry"]["job"], r["jobEntry"]["event"])
+              for r in lines if "jobEntry" in r]
+    assert ("f", "admitted") in events
+    assert ("i", "admitted") in events
+    assert ("bad", "rejected") in events
+    assert ("?", "rejected") in events          # the non-JSON line
+    assert ("f", "cancelled") in events
+    assert ("i", "done") in events
+    # the cancelled job produced no solve records; the served one did
+    assert _job_records(lines, "f") == [
+        r for r in lines if "jobEntry" in r
+        and r["jobEntry"]["job"] == "f"]
+    i_recs = _job_records(lines, "i")
+    assert any("solution" in r for r in i_recs)
+    assert any("runEntry" in r for r in i_recs)
+    assert any("logEntry" in r for r in i_recs)
+    assert svc.state("i") == JobState.DONE
+    assert svc.result("i")["gens"] == 10
+
+
+def test_backlog_admission_control():
+    p = random_instance(71, n_events=8, n_rooms=2, n_features=2,
+                        n_students=6, attend_prob=0.2)
+    buf = io.StringIO()
+    svc = SolveService(_cfg(backlog=1), out=buf)
+    svc.submit(p, job_id="one", generations=5)
+    with pytest.raises(AdmissionError):
+        svc.submit(p, job_id="two", generations=5)
+    svc.drive()
+    svc.submit(p, job_id="three", generations=5)   # slot freed
+    svc.drive()
+    svc.close()
+    assert svc.state("three") == JobState.DONE
+
+
+def test_parse_serve_args_and_validation():
+    cfg = parse_serve_args(["--lanes", "8", "--quantum", "50",
+                            "--backlog", "16", "--backend", "cpu",
+                            "--bucket-events", "64"])
+    assert (cfg.lanes, cfg.quantum, cfg.backlog) == (8, 50, 16)
+    assert cfg.bucket_events == 64 and cfg.backend == "cpu"
+    for bad in (["--lanes", "0"], ["--quantum", "0"],
+                ["--bucket-ratio", "1.0"], ["--frobnicate", "1"],
+                ["--backend", "gpu"]):
+        with pytest.raises(SystemExit):
+            parse_serve_args(bad)
+    with pytest.raises(SystemExit):
+        parse_serve_args(["-h"])
+
+
+def test_solution_record_verifies_against_oracle():
+    """The timetable a DONE job reports must evaluate to the reported
+    (hcv, scv) under the reference-semantics oracle on the UNPADDED
+    instance — the end-to-end proof that serving through a padded
+    bucket returns answers about the real problem."""
+    from timetabling_ga_tpu.oracle.reference_oracle import (
+        oracle_hcv, oracle_scv)
+    p = random_instance(81, n_events=12, n_rooms=3, n_features=2,
+                        n_students=8, attend_prob=0.1)
+    buf = io.StringIO()
+    svc = SolveService(_cfg(quantum=20), out=buf)
+    a = svc.submit(p, generations=60, seed=1)
+    svc.drive()
+    svc.close()
+    res = svc.result(a)
+    slots = np.asarray(res["timeslots"], np.int32)
+    rooms = np.asarray(res["rooms"], np.int32)
+    assert slots.shape == (p.n_events,)
+    assert oracle_hcv(p, slots, rooms) == res["hcv"]
+    assert oracle_scv(p, slots) == res["scv"]

@@ -20,6 +20,9 @@ Rules (see README "Static analysis & sanitizers"):
   TT402  loop-carried key reuse (one call site consuming the same key
          across `for` iterations without fold_in on the loop index)
   TT501  JAX imports outside the pinned compatibility table (compat.py)
+  TT502  jax.* ATTRIBUTE access outside the pinned table — the
+         `jax.profiler.*` / `jax.distributed.*` uses TT501's import
+         scanner cannot see
 
 Suppress one finding inline with `# tt-analyze: ignore[TT301]` (on the
 line, or on a comment line directly above). Configure via
@@ -68,6 +71,7 @@ def _rule_modules():
         "TT401": rules_rng,
         "TT402": rules_rng,
         "TT501": rules_api,
+        "TT502": rules_api,
     }
 
 

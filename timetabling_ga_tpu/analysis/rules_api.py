@@ -1,15 +1,28 @@
-"""TT501 — pinned JAX API surface.
+"""TT501/TT502 — pinned JAX API surface.
 
-Every `import jax...` in the package must be declared in the
+TT501: every `import jax...` in the package must be declared in the
 compatibility table (`JAX_COMPAT_TABLE` in timetabling_ga_tpu/compat.py
 by default): the table is the set of JAX symbols known to exist on every
 JAX version we support. An import of an undeclared symbol is exactly how
 `from jax import shard_map` (a 0.6+ export) broke the whole suite on the
 installed JAX 0.4.37 — this rule fails that at lint time instead.
 
-Imports inside a `try:` whose handler catches ImportError are exempt:
-that is the sanctioned version-tolerance idiom (see compat.py), where a
-missing symbol is handled, not fatal.
+TT502: the same pinning for ATTRIBUTE access. `jax.profiler.start_trace`
+and `jax.distributed.initialize` never appear in an import statement, so
+they bypass TT501 entirely — yet an attribute that a supported JAX
+version does not export fails at exactly the same place an undeclared
+import does, just later (first call instead of import time). Every
+maximal `jax.a.b...` attribute chain must resolve through the table:
+the longest table-key module prefix is found, and the next component
+must be in that entry's allowed list ("*" = anything). Chains are only
+checked in files that actually bind the name via `import jax` (aliases
+included), so unrelated locals named `jax` never fire.
+
+Constructs inside a `try:` whose handler catches ImportError (TT501) or
+ImportError/AttributeError (TT502) are exempt: those are the sanctioned
+version-tolerance idioms (see compat.py), where a missing symbol is
+handled, not fatal. `getattr(jax, "name", default)` probing is
+naturally exempt — it is not an attribute chain.
 """
 
 from __future__ import annotations
@@ -19,14 +32,18 @@ import ast
 from timetabling_ga_tpu.analysis.core import Finding, qualname
 
 RULE = "TT501"
+RULE_ATTR = "TT502"
 
 _IMPORT_ERRORS = {"ImportError", "ModuleNotFoundError", "Exception",
                   "BaseException"}
+_ATTR_ERRORS = _IMPORT_ERRORS | {"AttributeError"}
 
 
-def _guarded_lines(tree: ast.Module) -> set[int]:
-    """Line numbers inside try/except-ImportError bodies and their
-    handlers (the whole construct is version-tolerant by design)."""
+def _guarded_lines(tree: ast.Module,
+                   error_names: set[str] = _IMPORT_ERRORS) -> set[int]:
+    """Line numbers inside try/except bodies whose handlers catch one
+    of `error_names` (the whole construct is version-tolerant by
+    design)."""
     lines: set[int] = set()
     for node in ast.walk(tree):
         if not isinstance(node, ast.Try):
@@ -42,7 +59,7 @@ def _guarded_lines(tree: ast.Module) -> set[int]:
                 types = [h.type]
             for t in types:
                 qn = qualname(t)
-                if qn and qn.rsplit(".", 1)[-1] in _IMPORT_ERRORS:
+                if qn and qn.rsplit(".", 1)[-1] in error_names:
                     catches_import = True
         if not catches_import:
             continue
@@ -54,11 +71,70 @@ def _guarded_lines(tree: ast.Module) -> set[int]:
     return lines
 
 
+def _jax_aliases(tree: ast.Module) -> set[str]:
+    """Names the module binds to the `jax` package itself."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax":
+                    names.add(alias.asname or "jax")
+    return names
+
+
+def _check_attrs(tree: ast.Module, src: str, path: str, ctx
+                 ) -> list[Finding]:
+    """TT502: maximal jax-rooted attribute chains vs the table."""
+    table = ctx.compat_table
+    aliases = _jax_aliases(tree)
+    if not table or not aliases:
+        return []
+    guarded = _guarded_lines(tree, _ATTR_ERRORS)
+    # attribute nodes that are the `.value` of another attribute are
+    # sub-chains; only the maximal chain is checked (one finding per
+    # use, anchored at its full dotted path)
+    sub_chains = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Attribute)):
+            sub_chains.add(id(node.value))
+
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Attribute) or id(node) in sub_chains:
+            continue
+        qn = qualname(node)
+        if qn is None:
+            continue
+        root = qn.split(".", 1)[0]
+        if root not in aliases:
+            continue
+        q = "jax" + qn[len(root):]
+        if node.lineno in guarded:
+            continue
+        parts = q.split(".")
+        for i in range(len(parts), 0, -1):
+            prefix = ".".join(parts[:i])
+            allowed = table.get(prefix)
+            if allowed is None:
+                continue
+            nxt = parts[i] if i < len(parts) else None
+            if not (nxt is None or "*" in allowed or nxt in allowed):
+                findings.append(Finding(
+                    RULE_ATTR, path, node.lineno, node.col_offset,
+                    f"`{q}` is outside the pinned JAX API surface — "
+                    f"`{nxt}` is not declared under `{prefix}` in "
+                    f"JAX_COMPAT_TABLE (compat.py); declare it or "
+                    f"resolve it through compat"))
+            break
+    return findings
+
+
 def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
     table = ctx.compat_table
     if not table:
         return []
-    findings: list[Finding] = []
+    findings: list[Finding] = list(_check_attrs(tree, src, path, ctx))
     guarded = _guarded_lines(tree)
 
     for node in ast.walk(tree):

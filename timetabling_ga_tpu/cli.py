@@ -7,6 +7,13 @@ Usage mirrors the reference binary (`timetabling.ga.uk.2 -i instance.tim
         --islands 8 --pop-size 128 --generations 2001
 
 Output is the reference's JSONL protocol on stdout (or -o <file>).
+
+`serve` subcommand — the multi-tenant solver service (README
+"Serving"; timetabling_ga_tpu/serve): line-JSON solve requests in,
+job-tagged JSONL records out:
+
+    python -m timetabling_ga_tpu.cli serve --lanes 4 --quantum 25 \
+        -i requests.jsonl -o records.jsonl
 """
 
 from __future__ import annotations
@@ -18,7 +25,13 @@ from timetabling_ga_tpu.runtime.engine import precompile, run
 
 
 def main(argv=None) -> int:
-    cfg = parse_args(sys.argv[1:] if argv is None else argv)
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "serve":
+        # deferred import: the single-run path must not pay the serve
+        # subsystem's import, and vice versa
+        from timetabling_ga_tpu.serve.service import main_serve
+        return main_serve(argv[1:])
+    cfg = parse_args(argv)
     # compile-then-run, like the reference binary (mpicxx compiles
     # before anyone races it): XLA compilation happens BEFORE the per-
     # try clock starts, so -t bounds solve time, not compile time — a

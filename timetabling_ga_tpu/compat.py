@@ -49,19 +49,34 @@ def shard_map(f, **kwargs):
     return _shard_map_impl(f, **kwargs)
 
 
-# The declared JAX import surface (analysis rule TT501). Keys are module
-# paths; values are the symbol names importable *from* that module, with
-# "*" meaning any symbol. A bare `import jax.foo` is allowed iff
-# "jax.foo" is a key. `shard_map` is deliberately NOT under the "jax"
-# key: its top-level export does not exist on every supported version —
-# import it from this module instead.
+# The declared JAX API surface (analysis rules TT501 + TT502). Keys are
+# module paths; values are the symbol names reachable from that module —
+# by `from <module> import <name>` (TT501) OR by attribute access
+# `<module>.<name>` (TT502) — with "*" meaning any symbol. A bare
+# `import jax.foo` is allowed iff "jax.foo" is a key. `shard_map` is
+# deliberately NOT under the "jax" key: its top-level export does not
+# exist on every supported version — import it from this module instead.
+# The "jax" entry therefore lists every `jax.X` attribute the package
+# uses (jit/vmap/devices/...): an attribute outside the table is the
+# same API-drift hazard an undeclared import is, just invisible to the
+# import scanner — TT502 closes that gap.
 JAX_COMPAT_TABLE = {
-    "jax": ["lax", "numpy"],
+    "jax": ["lax", "numpy",
+            # attribute surface (TT502)
+            "jit", "vmap", "devices", "block_until_ready",
+            "make_array_from_callback", "process_count",
+            "process_index", "clear_caches", "device_get",
+            "config", "random", "tree", "tree_util", "sharding",
+            "profiler", "distributed", "errors", "experimental"],
     "jax.numpy": ["*"],
     "jax.lax": ["*"],
     "jax.sharding": ["Mesh", "PartitionSpec", "NamedSharding"],
     "jax.random": ["*"],
     "jax.tree": ["*"],
+    "jax.config": ["update"],
+    "jax.tree_util": ["register_pytree_node"],
+    "jax.profiler": ["start_trace", "stop_trace"],
+    "jax.distributed": ["initialize"],
     "jax.errors": ["JaxRuntimeError"],
     "jax.experimental": ["multihost_utils"],
     "jax.experimental.multihost_utils": ["*"],

@@ -99,16 +99,20 @@ def _delta_one(pa, slots, rooms_arr, att, occ, evs, new_slots, active,
     # Only ACTIVE events are removed/re-added: the greedy room choice
     # must see exactly the occupancy random_move's Move1/2/3 see
     # (ops/moves.py removes only the moved events before choosing).
+    # Padded (masked-out) events never occupied a cell, so their weight
+    # in the replay is 0 — they relocate freely with an exact pair delta
+    # of 0 and cannot perturb a live partner's delta.
+    live = pa.event_mask[evs].astype(jnp.int32)         # (3,) 0/1
     occ32 = occ.astype(jnp.int32)
     pair_d = jnp.int32(0)
     for m in range(3):
-        act = active[m].astype(jnp.int32)
+        act = active[m].astype(jnp.int32) * live[m]
         cell = occ32[old_slots[m], old_rooms[m]]
         pair_d = pair_d - act * (cell - 1)
         occ32 = occ32.at[old_slots[m], old_rooms[m]].add(-act)
     new_rooms = []
     for m in range(3):
-        act = active[m].astype(jnp.int32)
+        act = active[m].astype(jnp.int32) * live[m]
         row = occ32[new_slots[m]]
         r_choice = choose_room(pa, row, evs[m], cap_rank)
         r_new = jnp.where(active[m], r_choice, old_rooms[m])
@@ -179,18 +183,22 @@ def _delta_one(pa, slots, rooms_arr, att, occ, evs, new_slots, active,
 
 def _apply_move(pa, state_i, evs, new_slots, new_rooms):
     """Commit an accepted candidate to one individual's maintained state.
-    Inactive pad entries (new == old) cancel exactly in every update."""
+    Inactive pad entries (new == old) cancel exactly in every update.
+    Padded (masked-out) events carry occupancy weight 0 — their attends
+    column is already all-zero — so the maintained grids stay exactly
+    the mask-aware truth `init_state` computes."""
     slots, rooms_arr, att, occ = state_i
     old_slots = slots[evs]
     old_rooms = rooms_arr[evs]
+    live = pa.event_mask[evs].astype(jnp.int32)         # (3,) 0/1
     att32 = att.astype(jnp.int32)
     occ32 = occ.astype(jnp.int32)
     for m in range(3):
         col = pa.attends[:, evs[m]].astype(jnp.int32)
         att32 = att32.at[:, old_slots[m]].add(-col)
         att32 = att32.at[:, new_slots[m]].add(col)
-        occ32 = occ32.at[old_slots[m], old_rooms[m]].add(-1)
-        occ32 = occ32.at[new_slots[m], new_rooms[m]].add(1)
+        occ32 = occ32.at[old_slots[m], old_rooms[m]].add(-live[m])
+        occ32 = occ32.at[new_slots[m], new_rooms[m]].add(live[m])
     slots = slots.at[evs].set(new_slots)
     rooms_arr = rooms_arr.at[evs].set(new_rooms)
     return slots, rooms_arr, att32.astype(jnp.int16), occ32.astype(jnp.int16)

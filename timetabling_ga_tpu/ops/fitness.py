@@ -53,7 +53,12 @@ def compute_hcv(pa, slots: jnp.ndarray, rooms: jnp.ndarray) -> jnp.ndarray:
     """
     T = pa.n_slots
     R = pa.n_rooms
-    X = slot_onehot(slots, T)                      # (T, E)
+    # Padded (masked-out) events occupy nothing and count nowhere: their
+    # one-hot columns are zeroed, so they vanish from the occupancy and
+    # correlation contractions exactly (their conflict rows/columns are
+    # already zero by construction — serve/bucket.py). On unpadded
+    # instances event_mask is all-ones and the multiply is exact.
+    X = slot_onehot(slots, T) * pa.event_mask[None, :]   # (T, E)
     Y = room_onehot(rooms, R)                      # (R, E)
 
     # (a) events sharing (slot, room): occupancy n[t, r], pairs = C(n, 2)
@@ -68,8 +73,11 @@ def compute_hcv(pa, slots: jnp.ndarray, rooms: jnp.ndarray) -> jnp.ndarray:
     diag = jnp.sum(jnp.diagonal(pa.conflict))
     corr_pairs = (full - diag) * 0.5
 
-    # (c) event in unsuitable room
-    unsuitable = jnp.sum(~pa.possible[jnp.arange(slots.shape[0]), rooms])
+    # (c) event in unsuitable room — padded events suit no room by
+    # construction, so the mask keeps them out of the count
+    unsuitable = jnp.sum(
+        (~pa.possible[jnp.arange(slots.shape[0]), rooms])
+        * pa.event_mask.astype(jnp.int32))
 
     return (pair_clash + corr_pairs).astype(jnp.int32) + unsuitable.astype(
         jnp.int32)

@@ -38,7 +38,12 @@ def move1(pa, slots, rooms_arr, e, t, cap_rank=None):
     if cap_rank is None:
         cap_rank = capacity_rank(pa)
     occ = occupancy(pa, slots, rooms_arr)
-    occ = occ.at[slots[e], rooms_arr[e]].add(-1)
+    # self-removals are weighted by the event mask throughout this
+    # module: a padded (masked-out) event never occupied its cell, so an
+    # unweighted -1 would leave a phantom vacancy that skews the greedy
+    # room choice of the OTHER moved events
+    occ = occ.at[slots[e], rooms_arr[e]].add(
+        -pa.event_mask[e].astype(jnp.int32))
     r = choose_room(pa, occ[t], e, cap_rank)
     return slots.at[e].set(t), rooms_arr.at[e].set(r)
 
@@ -49,11 +54,13 @@ def move2(pa, slots, rooms_arr, e1, e2, cap_rank=None):
     if cap_rank is None:
         cap_rank = capacity_rank(pa)
     t1, t2 = slots[e1], slots[e2]
+    w1 = pa.event_mask[e1].astype(jnp.int32)
+    w2 = pa.event_mask[e2].astype(jnp.int32)
     occ = occupancy(pa, slots, rooms_arr)
-    occ = occ.at[t1, rooms_arr[e1]].add(-1)
-    occ = occ.at[t2, rooms_arr[e2]].add(-1)
+    occ = occ.at[t1, rooms_arr[e1]].add(-w1)
+    occ = occ.at[t2, rooms_arr[e2]].add(-w2)
     r1 = choose_room(pa, occ[t2], e1, cap_rank)
-    occ = occ.at[t2, r1].add(1)
+    occ = occ.at[t2, r1].add(w1)
     r2 = choose_room(pa, occ[t1], e2, cap_rank)
     slots = slots.at[e1].set(t2).at[e2].set(t1)
     rooms_arr = rooms_arr.at[e1].set(r1).at[e2].set(r2)
@@ -67,14 +74,17 @@ def move3(pa, slots, rooms_arr, e1, e2, e3, cap_rank=None):
     if cap_rank is None:
         cap_rank = capacity_rank(pa)
     t1, t2, t3 = slots[e1], slots[e2], slots[e3]
+    w1 = pa.event_mask[e1].astype(jnp.int32)
+    w2 = pa.event_mask[e2].astype(jnp.int32)
+    w3 = pa.event_mask[e3].astype(jnp.int32)
     occ = occupancy(pa, slots, rooms_arr)
-    occ = occ.at[t1, rooms_arr[e1]].add(-1)
-    occ = occ.at[t2, rooms_arr[e2]].add(-1)
-    occ = occ.at[t3, rooms_arr[e3]].add(-1)
+    occ = occ.at[t1, rooms_arr[e1]].add(-w1)
+    occ = occ.at[t2, rooms_arr[e2]].add(-w2)
+    occ = occ.at[t3, rooms_arr[e3]].add(-w3)
     r1 = choose_room(pa, occ[t2], e1, cap_rank)
-    occ = occ.at[t2, r1].add(1)
+    occ = occ.at[t2, r1].add(w1)
     r2 = choose_room(pa, occ[t3], e2, cap_rank)
-    occ = occ.at[t3, r2].add(1)
+    occ = occ.at[t3, r2].add(w2)
     r3 = choose_room(pa, occ[t1], e3, cap_rank)
     slots = slots.at[e1].set(t2).at[e2].set(t3).at[e3].set(t1)
     rooms_arr = rooms_arr.at[e1].set(r1).at[e2].set(r2).at[e3].set(r3)
@@ -133,11 +143,12 @@ def apply_relocation(pa, slots, rooms_arr, evs, new_slots, active,
     occ = occupancy(pa, slots, rooms_arr)
     old_slots = slots[evs]
     old_rooms = rooms_arr[evs]
+    live = pa.event_mask[evs].astype(occ.dtype)     # (3,) 0/1; see move1
     for m in range(3):
-        act = active[m].astype(occ.dtype)
+        act = active[m].astype(occ.dtype) * live[m]
         occ = occ.at[old_slots[m], old_rooms[m]].add(-act)
     for m in range(3):
-        act = active[m].astype(occ.dtype)
+        act = active[m].astype(occ.dtype) * live[m]
         r_choice = choose_room(pa, occ[new_slots[m]], evs[m], cap_rank)
         r_new = jnp.where(active[m], r_choice, old_rooms[m])
         occ = occ.at[new_slots[m], r_new].add(act)

@@ -40,6 +40,19 @@ from jax import lax
 _W_COST = 1 << 13
 _W_UNSUIT = 1 << 12
 _W_BUSY = _W_COST  # alias kept for the key-packing bound checks below
+# Dead-room penalty: rooms masked out by shape bucketing (pa.room_mask
+# False — zero capacity, zero features; serve/bucket.py) must NEVER win
+# a room argmin, or a padded instance's matching would diverge from the
+# unpadded instance's. Strictly dominates every live key: live keys top
+# out near (E+1)*_W_COST + _W_UNSUIT + R < 2^26 (the assert below bounds
+# E and R), and 2^26 + _W_DEAD still fits int32.
+_W_DEAD = 1 << 28
+
+
+def _dead_rooms(pa) -> jnp.ndarray:
+    """(R,) int32 additive key penalty excluding masked-out rooms from
+    every room argmin (all-zero on unpadded instances)."""
+    return (~pa.room_mask).astype(jnp.int32) * _W_DEAD
 
 
 def capacity_rank(pa) -> jnp.ndarray:
@@ -70,7 +83,8 @@ def _room_key(pa, occ_row: jnp.ndarray, event: jnp.ndarray,
     unsuit = (~suit).astype(jnp.int32)
     return ((occ_row + unsuit) * _W_COST
             + unsuit * _W_UNSUIT
-            + cap_rank)
+            + cap_rank
+            + _dead_rooms(pa))
 
 
 def choose_room(pa, occ_row: jnp.ndarray, event: jnp.ndarray,
@@ -110,7 +124,10 @@ def assign_rooms(pa, slots: jnp.ndarray) -> jnp.ndarray:
     def step(occ, e):
         t = slots[e]
         r = choose_room(pa, occ[t], e, cap_rank)
-        return occ.at[t, r].add(1), r
+        # padded events (event_mask 0) choose a room but occupy nothing,
+        # so the occupancy every LIVE event sees — and hence its choice —
+        # is identical to the unpadded instance's
+        return occ.at[t, r].add(pa.event_mask[e].astype(jnp.int32)), r
 
     occ0 = jnp.zeros((T, R), dtype=jnp.int32)
     _, rooms_in_order = lax.scan(step, occ0, order)
@@ -243,7 +260,10 @@ def augment_rooms(pa, slots: jnp.ndarray, rooms_arr: jnp.ndarray,
     # improvement over the reference's stack-into-least-busy-suitable
     # fallback, Solution.cpp:814-830; see _room_key). Two bid rounds
     # spread co-parked events instead of letting them all pick the same
-    # cheapest cell.
+    # cheapest cell. Padded events enter the park phase pre-parked: they
+    # must neither bid (a won cell would add phantom occupancy the live
+    # events' keys see) nor end up in a live room's count.
+    live_ev = pa.event_mask > 0.5                          # (E,) bool
     matched = mrooms < UNM
     # occupancy over the matched assignment, with a dump column R
     occ = jnp.zeros((T, R + 1), jnp.int32).at[slots, mrooms].add(
@@ -252,7 +272,8 @@ def augment_rooms(pa, slots: jnp.ndarray, rooms_arr: jnp.ndarray,
 
     def park_key(occ):
         return ((occ[slots][:, :R] + unsuit) * _W_COST
-                + unsuit * _W_UNSUIT + cap_rank[None, :])
+                + unsuit * _W_UNSUIT + cap_rank[None, :]
+                + _dead_rooms(pa)[None, :])
 
     def park_round(carry, _):
         occ, mrooms, parked = carry
@@ -264,11 +285,14 @@ def augment_rooms(pa, slots: jnp.ndarray, rooms_arr: jnp.ndarray,
         return (occ, mrooms, parked | win), None
 
     (occ, mrooms, parked), _ = lax.scan(
-        park_round, (occ, mrooms, matched), None, length=2)
+        park_round, (occ, mrooms, matched | ~live_ev), None, length=2)
     # stragglers (lost both bid rounds): take current argmin, collisions
     # accepted — the hcv penalty absorbs them
     fallback = jnp.argmin(park_key(occ), axis=1).astype(jnp.int32)
-    return jnp.where(parked, mrooms, fallback)
+    # padded events keep their incoming (valid, fitness-invisible) room:
+    # their mrooms is the out-of-range UNM sentinel by construction
+    return jnp.where(live_ev, jnp.where(parked, mrooms, fallback),
+                     rooms_arr)
 
 
 def parallel_assign_rooms(pa, slots: jnp.ndarray,
@@ -301,6 +325,8 @@ def batch_parallel_assign_rooms(pa, slots: jnp.ndarray,
 
 def occupancy(pa, slots: jnp.ndarray, rooms: jnp.ndarray) -> jnp.ndarray:
     """Occupancy counts (T, R) of one solution — the dense replacement for
-    the reference's ragged `timeslot_events` index (Solution.h:37)."""
+    the reference's ragged `timeslot_events` index (Solution.h:37).
+    Padded (masked-out) events occupy nothing, so every consumer (moves,
+    delta LS, sweeps) sees exactly the unpadded instance's grid."""
     occ = jnp.zeros((pa.n_slots, pa.n_rooms), dtype=jnp.int32)
-    return occ.at[slots, rooms].add(1)
+    return occ.at[slots, rooms].add(pa.event_mask.astype(jnp.int32))

@@ -58,7 +58,8 @@ from jax import lax
 from timetabling_ga_tpu.ops import fitness
 from timetabling_ga_tpu.ops.delta import (
     LSState, _apply_move, _day_scv, _delta_one, init_state)
-from timetabling_ga_tpu.ops.rooms import _W_COST, _W_UNSUIT, capacity_rank
+from timetabling_ga_tpu.ops.rooms import (
+    _W_COST, _W_UNSUIT, _dead_rooms, capacity_rank)
 
 
 def _neighbor_masks(b):
@@ -87,15 +88,21 @@ def _move1_sweep(pa, slots, rooms_arr, att, occ, e, cap_rank):
     s_old = slots[e]
     r_old = rooms_arr[e]
 
-    # ---- room-pair clashes + greedy re-rooming for every target slot
-    occ32 = occ.astype(jnp.int32).at[s_old, r_old].add(-1)
+    # ---- room-pair clashes + greedy re-rooming for every target slot.
+    # `live` is 0 for padded (masked-out) events: they never occupied a
+    # cell, so the self-removal is weighted out, and the final deltas
+    # are forced to exactly 0 below (a padded event's relocation cannot
+    # change any penalty term).
+    live = pa.event_mask[e].astype(jnp.int32)
+    occ32 = occ.astype(jnp.int32).at[s_old, r_old].add(-live)
     remove_d = -(occ.astype(jnp.int32)[s_old, r_old] - 1)
     suit = pa.possible[e]                                  # (R,)
     # marginal-hcv-cost key — MUST stay in lockstep with rooms._room_key
     unsuit = (~suit).astype(jnp.int32)[None, :]
     key = ((occ32 + unsuit) * _W_COST
            + unsuit * _W_UNSUIT
-           + cap_rank[None, :])                            # (T, R)
+           + cap_rank[None, :]
+           + _dead_rooms(pa)[None, :])                     # (T, R)
     new_rooms = jnp.argmin(key, axis=1).astype(jnp.int32)  # (T,)
     add_d = occ32[jnp.arange(T), new_rooms]
     pair_d = remove_d + add_d
@@ -148,7 +155,10 @@ def _move1_sweep(pa, slots, rooms_arr, att, occ, e, cap_rank):
         (dconsec + dsingle).astype(jnp.float32)).reshape(T)
 
     d_scv = last_d + rm_d + add_per_target.astype(jnp.int32)
-    return d_hcv, d_scv, new_rooms
+    # padded pivot: every term above is already zero EXCEPT the pair
+    # replay (whose self-removal assumption does not hold for an event
+    # that occupies nothing) — force the whole delta to its true value 0
+    return d_hcv * live, d_scv * live, new_rooms
 
 
 def _distinct_pad(e1, e2, E: int):
@@ -208,7 +218,9 @@ def event_heat(pa, slots, rooms_arr, att, occ, hcv):
     H = pa.attends.astype(jnp.float32).T @ heat_slot        # (E, T) MXU
     scv_heat = H[ar, slots] + last
 
-    return jnp.where(hcv > 0, hcv_heat, scv_heat)
+    # padded events are permanently cold (heat 0): a hot-K pivot slot
+    # spent on one would be pure padding waste
+    return jnp.where(hcv > 0, hcv_heat, scv_heat) * pa.event_mask
 
 
 def sweep_pass(pa, key, state: LSState, swap_block: int = 8,

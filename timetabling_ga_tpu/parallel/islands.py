@@ -31,6 +31,7 @@ prescribes.
 
 from __future__ import annotations
 
+import collections
 import functools
 
 import jax
@@ -44,6 +45,19 @@ from timetabling_ga_tpu.ops import fitness, ga
 
 
 AXIS = "island"
+
+# Trace-time counters, keyed by program tag ("lane_runner", "lane_init",
+# ...): the builders below bump the tag INSIDE the to-be-jitted Python
+# function, so the count increments exactly when XLA (re)traces — i.e.
+# once per compiled (program, shape) pair and zero times on a cache hit.
+# This is the observable behind the serve subsystem's bucket guarantee
+# (two different-size instances in one bucket => ONE trace per program;
+# tests/test_serve.py, bench.py extra.serve bucket_compiles).
+TRACE_COUNTS: collections.Counter = collections.Counter()
+
+
+def _mark_trace(tag: str) -> None:
+    TRACE_COUNTS[tag] += 1
 
 
 def _donate(fn, donate: bool, argnum: int):
@@ -573,3 +587,117 @@ def make_island_runner_dynamic(mesh: Mesh, cfg: ga.GAConfig,
         return state, trace, global_best
 
     return _donate(_run, donate, 2)
+
+
+# ---------------------------------------------------------------------------
+# Multi-tenant lane programs (the serve subsystem, timetabling_ga_tpu/serve)
+#
+# A LANE is one slot of the island axis carrying one JOB's island: the
+# scheduler stacks up to n_lanes same-bucket jobs into one dispatch, so
+# the whole mesh advances many tenants' populations in a single fused
+# program. Differences from the single-problem runners above:
+#   - ProblemArrays leaves carry a leading LANE axis (each lane has its
+#     own padded instance data — same bucket SHAPE, different values);
+#   - per-lane seed/chunk indices derive each lane's RNG stream, so one
+#     tenant's draws never depend on who shares the dispatch;
+#   - per-lane generation counts (a lane runs min(quantum, remaining));
+#   - NO migration and NO cross-lane collectives: lanes are different
+#     problems, and solutions must never mix. The compiled program is
+#     collective-free, so per-device trip-count divergence is harmless.
+
+
+def make_lane_init(mesh: Mesh, pop_size: int, cfg: ga.GAConfig,
+                   n_lanes: int):
+    """Per-lane population init: `init(pa_l, seeds) -> PopState` where
+    every ProblemArrays leaf of `pa_l` has a leading (n_lanes,) axis and
+    `seeds` is (n_lanes,) int32. Lane i draws from key(seeds[i]) only —
+    job identity, not lane position, determines the stream, so a job
+    resumed into a different lane reproduces the same evolution."""
+    L = local_islands(mesh, n_lanes)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS)),
+        out_specs=ga.PopState(slots=P(AXIS), rooms=P(AXIS),
+                              penalty=P(AXIS), hcv=P(AXIS), scv=P(AXIS)),
+        check_vma=False)
+    def _init(pa_l, seeds):
+        st = jax.vmap(
+            lambda pa_i, seed: ga.init_population(
+                pa_i, jax.random.key(seed), pop_size, cfg))(pa_l, seeds)
+        return _flat(st)
+
+    def run(pa_l, seeds):
+        _mark_trace("lane_init")
+        return _init(pa_l, seeds)
+
+    return jax.jit(run)
+
+
+def make_lane_runner(mesh: Mesh, cfg: ga.GAConfig, max_gens: int,
+                     n_lanes: int, donate: bool = False):
+    """The serve dispatch program:
+    `run(pa_l, seeds, chunks, state, gens) -> (state, trace)`.
+
+      pa_l    ProblemArrays, every leaf with leading (n_lanes,) axis
+      seeds   (n_lanes,) int32 — per-job RNG identity
+      chunks  (n_lanes,) int32 — per-job dispatch counter: chunk c of a
+              job folds (seed, c), so a job's stream is a pure function
+              of its own progress, independent of lane packing and of
+              whatever other jobs ran in the same dispatches
+      state   global PopState, (n_lanes * pop, E) leaves, lane-sharded
+      gens    (n_lanes,) int32 — generations to run this quantum
+              (0 for idle/filler lanes; <= max_gens)
+      trace   (n_lanes, max_gens, 2) int32 per-generation (hcv, scv) of
+              each lane's best row; rows >= gens hold INT_MAX sentinels
+
+    One compile serves every quantum size and every job mix of a
+    bucket. Each device iterates to the max of ITS lanes' counts and
+    masks per-lane updates beyond a lane's own count."""
+    L = local_islands(mesh, n_lanes)
+    pop = cfg.pop_size
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS),
+                  ga.PopState(slots=P(AXIS), rooms=P(AXIS), penalty=P(AXIS),
+                              hcv=P(AXIS), scv=P(AXIS)), P(AXIS)),
+        out_specs=(ga.PopState(slots=P(AXIS), rooms=P(AXIS),
+                               penalty=P(AXIS), hcv=P(AXIS), scv=P(AXIS)),
+                   P(AXIS)),
+        check_vma=False)
+    def _run(pa_l, seeds, chunks, state, gens):
+        sb = _blocks(state, L, pop)
+        tr0 = jnp.full((L, max_gens, 2), _SENTINEL, jnp.int32)
+        n_steps = jnp.max(gens)
+
+        def lane_keys(seed, chunk):
+            return jax.random.fold_in(jax.random.key(seed), chunk)
+
+        keys = jax.vmap(lane_keys)(seeds, chunks)
+
+        def body(i, carry):
+            st, tr = carry
+
+            def one_lane(pa_i, k, b, g, tr_i):
+                b2 = ga.generation(pa_i, jax.random.fold_in(k, i), b,
+                                   cfg)
+                keep = i < g
+                b = jax.tree.map(
+                    lambda new, old: jnp.where(keep, new, old), b2, b)
+                row = jnp.stack([b.hcv[0], b.scv[0]])
+                tr_i = lax.dynamic_update_index_in_dim(
+                    tr_i, jnp.where(keep, row, tr_i[i]), i, 0)
+                return b, tr_i
+
+            st, tr = jax.vmap(one_lane)(pa_l, keys, st, gens, tr)
+            return st, tr
+
+        sb, trace = lax.fori_loop(0, n_steps, body, (sb, tr0))
+        return _flat(sb), trace
+
+    def run(pa_l, seeds, chunks, state, gens):
+        _mark_trace("lane_runner")
+        return _run(pa_l, seeds, chunks, state, gens)
+
+    return _donate(run, donate, 3)

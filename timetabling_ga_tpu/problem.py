@@ -58,6 +58,16 @@ class Problem:
     possible: np.ndarray       # (E, R)  bool   room suitability
     n_days: int = DAYS_DEFAULT
     slots_per_day: int = SLOTS_PER_DAY_DEFAULT
+    # Live-prefix counts for SHAPE-BUCKETED instances (serve/bucket.py):
+    # events/rooms at index >= n_live_* are padding — zero attendance,
+    # zero features, zero capacity — present only so every instance in a
+    # bucket shares one compiled program shape. None = everything live
+    # (every instance outside the serve path). The padding invariants
+    # (padded events suit no room, padded rooms suit no event) are
+    # established by serve.bucket.pad_problem, and the kernels consume
+    # them through ProblemArrays.event_mask / room_mask below.
+    n_live_events: Union[int, None] = None
+    n_live_rooms: Union[int, None] = None
 
     @property
     def n_slots(self) -> int:
@@ -71,12 +81,20 @@ class Problem:
         ``Problem*`` held by each Solution (Solution.h:38), except the data
         is replicated into HBM instead of chased through host pointers.
         """
+        live_e = (self.n_events if self.n_live_events is None
+                  else self.n_live_events)
+        live_r = (self.n_rooms if self.n_live_rooms is None
+                  else self.n_live_rooms)
         return ProblemArrays(
             attends=jnp.asarray(self.attends, dtype=jnp.float32),
             conflict=jnp.asarray(self.conflict, dtype=jnp.float32),
             possible=jnp.asarray(self.possible, dtype=jnp.bool_),
             student_count=jnp.asarray(self.student_count, dtype=jnp.int32),
             room_size=jnp.asarray(self.room_size, dtype=jnp.int32),
+            event_mask=jnp.asarray(
+                np.arange(self.n_events) < live_e, dtype=jnp.float32),
+            room_mask=jnp.asarray(
+                np.arange(self.n_rooms) < live_r, dtype=jnp.bool_),
             n_days=self.n_days,
             slots_per_day=self.slots_per_day,
         )
@@ -96,6 +114,14 @@ class ProblemArrays:
     possible: "object"       # (E, R) bool
     student_count: "object"  # (E,)   i32
     room_size: "object"      # (R,)   i32
+    # Validity masks for shape-bucketed (padded) instances: 1.0/True for
+    # live entries, 0.0/False for padding (serve/bucket.py). All-ones on
+    # unpadded instances, where every masked expression reduces to the
+    # unmasked one exactly (0/1 float multiplies and int adds are exact).
+    # event_mask is float32 because its hottest use is masking the f32
+    # one-hot operands of the fitness contractions.
+    event_mask: "object"     # (E,)   f32  1.0 live / 0.0 padded
+    room_mask: "object"      # (R,)   bool True live / False padded
     n_days: int
     slots_per_day: int
 
@@ -115,16 +141,18 @@ class ProblemArrays:
 # Register ProblemArrays as a pytree with static day/slot geometry.
 def _pa_flatten(pa: ProblemArrays):
     children = (pa.attends, pa.conflict, pa.possible, pa.student_count,
-                pa.room_size)
+                pa.room_size, pa.event_mask, pa.room_mask)
     aux = (pa.n_days, pa.slots_per_day)
     return children, aux
 
 
 def _pa_unflatten(aux, children):
-    attends, conflict, possible, student_count, room_size = children
+    (attends, conflict, possible, student_count, room_size, event_mask,
+     room_mask) = children
     n_days, slots_per_day = aux
     return ProblemArrays(attends, conflict, possible, student_count,
-                         room_size, n_days, slots_per_day)
+                         room_size, event_mask, room_mask, n_days,
+                         slots_per_day)
 
 
 jax.tree_util.register_pytree_node(ProblemArrays, _pa_flatten, _pa_unflatten)

@@ -376,16 +376,61 @@ _NEG_BOOL_FLAGS = {"--no-auto-tune": "auto_tune",
                    "--no-donate": "donate"}
 
 
-def _usage() -> str:
-    lines = ["usage: python -m timetabling_ga_tpu -i <instance.tim> "
-             "[flags]", "",
-             "reference-style flags (Control.cpp parsing model):"]
-    for flag, (field, typ) in _FLAG_MAP.items():
+def _format_usage(header_lines, flag_map, bool_flag_maps=()) -> str:
+    """Shared usage formatter for every `-key value` parser here."""
+    lines = list(header_lines)
+    for flag, (field, typ) in flag_map.items():
         lines.append(f"  {flag} <{typ.__name__}>".ljust(28) + field)
-    for flag, field in {**_BOOL_FLAGS, **_NEG_BOOL_FLAGS}.items():
-        lines.append(f"  {flag}".ljust(28) + field)
+    for m in bool_flag_maps:
+        for flag, field in m.items():
+            lines.append(f"  {flag}".ljust(28) + field)
     lines.append("  -h, --help".ljust(28) + "show this message and exit")
     return "\n".join(lines)
+
+
+def _parse_flag_stream(argv, cfg, flag_map, usage_fn,
+                       bool_flags=None, neg_bool_flags=None) -> set:
+    """Shared `-key value` parse loop (Control.cpp:14-16 model) behind
+    parse_args AND parse_serve_args. -h/--help prints usage and exits 0
+    (the smoke tier checks that path runs with no device access —
+    API-drift canary); unknown flags and missing values are SystemExit.
+    Returns the set of field names the argv explicitly set."""
+    bool_flags = bool_flags or {}
+    neg_bool_flags = neg_bool_flags or {}
+    seen: set = set()
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("-h", "--help"):
+            print(usage_fn())
+            raise SystemExit(0)
+        if a in bool_flags:
+            setattr(cfg, bool_flags[a], True)
+            seen.add(bool_flags[a])
+            i += 1
+            continue
+        if a in neg_bool_flags:
+            setattr(cfg, neg_bool_flags[a], False)
+            seen.add(neg_bool_flags[a])
+            i += 1
+            continue
+        if a not in flag_map:
+            raise SystemExit(f"unknown flag: {a}")
+        if i + 1 >= len(argv):
+            raise SystemExit(f"flag {a} needs a value")
+        field, typ = flag_map[a]
+        setattr(cfg, field, typ(argv[i + 1]))
+        seen.add(field)
+        i += 2
+    return seen
+
+
+def _usage() -> str:
+    return _format_usage(
+        ["usage: python -m timetabling_ga_tpu -i <instance.tim> "
+         "[flags]", "",
+         "reference-style flags (Control.cpp parsing model):"],
+        _FLAG_MAP, ({**_BOOL_FLAGS, **_NEG_BOOL_FLAGS},))
 
 
 def parse_args(argv) -> RunConfig:
@@ -394,33 +439,8 @@ def parse_args(argv) -> RunConfig:
     Unknown flags raise; a missing `-i` raises like the reference's
     exit-on-missing-input (Control.cpp:36-39)."""
     cfg = RunConfig()
-    seen = set()
-    i = 0
-    while i < len(argv):
-        a = argv[i]
-        if a in ("-h", "--help"):
-            # exit 0, like every CLI's help path — the smoke tier checks
-            # this runs with no device access (API-drift canary)
-            print(_usage())
-            raise SystemExit(0)
-        if a in _BOOL_FLAGS:
-            setattr(cfg, _BOOL_FLAGS[a], True)
-            seen.add(_BOOL_FLAGS[a])
-            i += 1
-            continue
-        if a in _NEG_BOOL_FLAGS:
-            setattr(cfg, _NEG_BOOL_FLAGS[a], False)
-            seen.add(_NEG_BOOL_FLAGS[a])
-            i += 1
-            continue
-        if a not in _FLAG_MAP:
-            raise SystemExit(f"unknown flag: {a}")
-        if i + 1 >= len(argv):
-            raise SystemExit(f"flag {a} needs a value")
-        field, typ = _FLAG_MAP[a]
-        setattr(cfg, field, typ(argv[i + 1]))
-        seen.add(field)
-        i += 2
+    seen = _parse_flag_stream(argv, cfg, _FLAG_MAP, _usage,
+                              _BOOL_FLAGS, _NEG_BOOL_FLAGS)
     cfg.explicit_fields = frozenset(seen)
     if cfg.input is None:
         raise SystemExit("No instance file specified, use -i <file>")
@@ -465,4 +485,90 @@ def parse_args(argv) -> RunConfig:
         # re-validates the final pair
         raise SystemExit("--post-pop-size must not exceed --pop-size "
                          "(it truncates to the elite rows)")
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# Solver-service configuration (`tt serve`, timetabling_ga_tpu/serve).
+# Kept here with RunConfig so the whole flag surface lives in one module.
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Configuration of the multi-tenant solver service.
+
+    The service accepts jobs over a line-JSON protocol (serve/service.py
+    docstring has the grammar), pads each instance to its shape bucket
+    (serve/bucket.py), and time-slices up to `lanes` same-bucket jobs
+    per mesh dispatch in `quantum`-generation slices."""
+
+    input: Optional[str] = None   # line-JSON request file; None = stdin
+    output: Optional[str] = None  # record stream; None = stdout
+    backend: str = "tpu"
+    lanes: int = 4                # job lanes per dispatch (stacked along
+    #                               the island axis; must be a multiple
+    #                               of the device count)
+    quantum: int = 25             # generations per time slice: small
+    #                               enough that late arrivals wait at
+    #                               most one dispatch, large enough to
+    #                               amortize dispatch latency
+    backlog: int = 64             # admission-control bound (active jobs)
+    pop_size: int = 16            # per-job island population
+    generations: int = 200        # default per-job budget (a submit may
+    #                               override per job)
+    seed: int = 0                 # default per-job seed
+    bucket_events: int = 32       # geometric bucket floors + ratio
+    bucket_rooms: int = 4         #   (serve/bucket.py BucketSpec)
+    bucket_features: int = 4
+    bucket_students: int = 32
+    bucket_ratio: float = 2.0
+    max_steps: int = 32           # LS budget per generation (see
+    #                               RunConfig.resolved_max_steps)
+    ls_candidates: int = 8
+
+
+_SERVE_FLAG_MAP = {
+    "-i": ("input", str),
+    "-o": ("output", str),
+    "--backend": ("backend", str),
+    "--lanes": ("lanes", int),
+    "--quantum": ("quantum", int),
+    "--backlog": ("backlog", int),
+    "--pop-size": ("pop_size", int),
+    "--generations": ("generations", int),
+    "-s": ("seed", int),
+    "--bucket-events": ("bucket_events", int),
+    "--bucket-rooms": ("bucket_rooms", int),
+    "--bucket-features": ("bucket_features", int),
+    "--bucket-students": ("bucket_students", int),
+    "--bucket-ratio": ("bucket_ratio", float),
+    "-m": ("max_steps", int),
+    "--ls-candidates": ("ls_candidates", int),
+}
+
+
+def _serve_usage() -> str:
+    return _format_usage(
+        ["usage: python -m timetabling_ga_tpu serve [flags]", "",
+         "multi-tenant solver service (line-JSON jobs on -i/stdin, "
+         "job-tagged JSONL records on -o/stdout):"],
+        _SERVE_FLAG_MAP)
+
+
+def parse_serve_args(argv) -> ServeConfig:
+    """Parse the `serve` subcommand's flags (same -key value model as
+    parse_args — _parse_flag_stream is the shared loop)."""
+    cfg = ServeConfig()
+    _parse_flag_stream(argv, cfg, _SERVE_FLAG_MAP, _serve_usage)
+    if cfg.backend not in ("tpu", "cpu"):
+        raise SystemExit(f"unknown backend: {cfg.backend}")
+    if cfg.lanes < 1:
+        raise SystemExit("--lanes must be >= 1")
+    if cfg.quantum < 1:
+        raise SystemExit("--quantum must be >= 1 generation")
+    if cfg.backlog < 1:
+        raise SystemExit("--backlog must be >= 1")
+    if cfg.bucket_ratio <= 1.0:
+        raise SystemExit("--bucket-ratio must be > 1.0 (geometric "
+                         "bucket growth)")
     return cfg

@@ -196,6 +196,36 @@ def cached_init(mesh, pop_size: int, gacfg: ga.GAConfig,
     return f
 
 
+def cached_lane_runner(mesh, gacfg: ga.GAConfig, max_gens: int,
+                       n_lanes: int, donate: bool = False):
+    """Multi-tenant lane program (islands.make_lane_runner) for the
+    serve scheduler: one compiled program per (mesh, config, quantum
+    bound, lane count) serves EVERY job whose padded instance shares
+    the bucket shape — the compile-cache key is the bucket, not the
+    instance (serve/bucket.py). Lives in _RUNNER_CACHE so recovery's
+    _purge_programs covers it like every other compiled program."""
+    k = ("lane", _mesh_key(mesh), gacfg, max_gens, n_lanes, donate)
+    r = _RUNNER_CACHE.get(k)
+    if r is not None:
+        return r, True
+    r = islands.make_lane_runner(mesh, gacfg, max_gens, n_lanes,
+                                 donate=donate)
+    _RUNNER_CACHE[k] = r
+    return r, False
+
+
+def cached_lane_init(mesh, pop_size: int, gacfg: ga.GAConfig,
+                     n_lanes: int):
+    """Per-lane init program (islands.make_lane_init), cached like
+    cached_init."""
+    k = ("lane-init", _mesh_key(mesh), pop_size, gacfg, n_lanes)
+    f = _INIT_CACHE.get(k)
+    if f is None:
+        f = islands.make_lane_init(mesh, pop_size, gacfg, n_lanes)
+        _INIT_CACHE[k] = f
+    return f
+
+
 # Hard ceiling on one fused dispatch's predicted wall time. The
 # tunneled device kills kernels that run too long ('UNAVAILABLE: TPU
 # device error — often a kernel fault'): the comp05s post-phase runner
@@ -664,6 +694,28 @@ def _fetch_state(state) -> ga.PopState:
         slots=packed[:, :E], rooms=packed[:, E:2 * E],
         penalty=packed[:, 2 * E], hcv=packed[:, 2 * E + 1],
         scv=packed[:, 2 * E + 2])
+
+
+# --- the resumable run-chunk surface ---------------------------------
+# The serve scheduler (timetabling_ga_tpu/serve/scheduler.py) drives the
+# engine's machinery one CHUNK at a time: place a host snapshot on the
+# mesh (reshard_state), dispatch one quantum through a cached_* program,
+# fence, and take the next host snapshot (fetch_state) — exactly the
+# park/resume cycle the PR-3 fault supervisor already performs around
+# failures, exposed as the public chunk-step API so a scheduler can
+# preempt and resume jobs at every control-fence boundary.
+
+def fetch_state(state) -> ga.PopState:
+    """Public host-snapshot fetch: one packed device round trip (see
+    _fetch_state). The returned all-numpy PopState is the same tuple
+    checkpoint.save takes and reshard_state re-places."""
+    return _fetch_state(state)
+
+
+def reshard_state(state: ga.PopState, mesh) -> ga.PopState:
+    """Public rehydrate: place a host (numpy) PopState back onto the
+    mesh as global island/lane-sharded arrays (see _reshard_state)."""
+    return _reshard_state(state, mesh)
 
 
 def _setup(cfg: RunConfig):
@@ -1234,32 +1286,78 @@ def _run_tries(cfg: RunConfig, out) -> int:
         # (no duplicate logEntries) — see _process
         emitted = list(best_seen)
         if state is None:
-            t = time.monotonic()
-            state = cached_init(mesh, cfg.pop_size, gacfg_init,
-                                n_islands)(pa, k_init)
-            _fetch(state.penalty)   # real fence: the init phase record
-            #                         must not bleed into the polish
-            #                         bracket (block_until_ready
-            #                         early-acks on the tunnel)
-            _phase(out, cfg.trace, "init", trial, time.monotonic() - t)
-            # Initial-population LS polish (ga.cpp:429-434), CHUNKED so
-            # the wall clock is checked between dispatches — one fused
-            # 30-pass converge polish at comp scale can otherwise eat a
-            # whole budget in a single unboundable dispatch. The runner
-            # takes the sweep count at runtime (one compile, any chunk);
-            # the loop stops at the pass budget, at the population-wide
-            # fixed point (penalty sum stops dropping — convergence
-            # inside a chunk implies the next chunk is a no-op), or when
-            # the next chunk is predicted not to fit the time budget.
-            if gacfg.init_sweeps > 0:
-                polish, pwarm = cached_polish_runner(mesh, gacfg, sig,
-                                                     n_islands,
-                                                     cfg.donate)
-                state, _ = _polish_chunks(
-                    out, cfg, pa, polish, state, k_polish, t_try, reserve,
-                    _SPS_CACHE.get(spg_key), n_islands, best_seen,
-                    emitted, trial, "polish", gacfg.init_sweeps,
-                    gacfg.ls_sideways, pwarm, sps_cache_key=spg_key)
+            # SUPERVISED INIT (ROADMAP PR-3 follow-up): failures during
+            # cached_init or the init polish happen BEFORE the first
+            # supervisor snapshot exists, so the in-run recovery matrix
+            # cannot cover them — instead of propagating, retry the
+            # whole init a bounded number of times. Re-running with the
+            # SAME k_init/k_polish reproduces the identical trajectory,
+            # and the emitted floor keeps replayed polish bests from
+            # re-emitting, so a recovered run's records match an
+            # uninjected run's modulo timing and fault records (the
+            # same determinism contract as the supervisor's;
+            # tests/test_faults.py init-site tests pin it). Disabled
+            # along with the rest of recovery at --max-recoveries 0.
+            init_tries = 1 + (2 if cfg.max_recoveries > 0
+                              and jax.process_count() == 1 else 0)
+            for init_attempt in range(init_tries):
+                try:
+                    t = time.monotonic()
+                    faults.maybe_fail("init")
+                    # key reuse across retry ATTEMPTS is the point:
+                    # the replayed init must reproduce the identical
+                    # trajectory (determinism contract)
+                    # tt-analyze: ignore[TT402]
+                    state = cached_init(mesh, cfg.pop_size, gacfg_init,
+                                        n_islands)(pa, k_init)
+                    _fetch(state.penalty)   # real fence: the init phase
+                    #                         record must not bleed into
+                    #                         the polish bracket
+                    #                         (block_until_ready early-
+                    #                         acks on the tunnel)
+                    _phase(out, cfg.trace, "init", trial,
+                           time.monotonic() - t)
+                    # Initial-population LS polish (ga.cpp:429-434),
+                    # CHUNKED so the wall clock is checked between
+                    # dispatches — one fused 30-pass converge polish at
+                    # comp scale can otherwise eat a whole budget in a
+                    # single unboundable dispatch. The runner takes the
+                    # sweep count at runtime (one compile, any chunk);
+                    # the loop stops at the pass budget, at the
+                    # population-wide fixed point (penalty sum stops
+                    # dropping — convergence inside a chunk implies the
+                    # next chunk is a no-op), or when the next chunk is
+                    # predicted not to fit the time budget.
+                    if gacfg.init_sweeps > 0:
+                        polish, pwarm = cached_polish_runner(
+                            mesh, gacfg, sig, n_islands, cfg.donate)
+                        # same deliberate reuse as k_init above
+                        # tt-analyze: ignore[TT402]
+                        state, _ = _polish_chunks(
+                            out, cfg, pa, polish, state, k_polish,
+                            t_try, reserve, _SPS_CACHE.get(spg_key),
+                            n_islands, best_seen, emitted, trial,
+                            "polish", gacfg.init_sweeps,
+                            gacfg.ls_sideways, pwarm,
+                            sps_cache_key=spg_key)
+                    break
+                except Exception as e:
+                    if (init_attempt + 1 >= init_tries
+                            or not retry.is_transient(e)):
+                        raise
+                    jsonl.fault_entry(
+                        out, getattr(e, "tt_site", "init"), "recover",
+                        e, trial, init_attempt + 1, 0,
+                        time.monotonic() - t_try, init=True)
+                    # teardown mirrors the supervisor's: drop poisoned
+                    # buffers, purge the mesh's compiled programs,
+                    # rebuild, and re-place the problem data
+                    islands.delete_state(state)
+                    state = None
+                    _purge_programs(mesh)
+                    mesh = islands.make_mesh(min(n_islands,
+                                                 len(jax.devices())))
+                    pa = problem.device_arrays()
 
         epochs_done = 0
         epochs_at_ckpt = 0

@@ -40,7 +40,9 @@ Each entry is `site:nth:action` — on the `nth` (1-based) invocation of
 Sites currently wired: `dispatch` (engine generation/polish/LAHC/kick
 dispatch sites), `fetch` (every classified control-fence host read,
 inside the watchdog thread), `writer` (AsyncWriter worker, once per
-dequeued item), `ckpt` (checkpoint.save, after the durable rename).
+dequeued item), `ckpt` (checkpoint.save, after the durable rename),
+`init` (the engine's pre-snapshot init dispatch — the supervised-init
+retry's window).
 
 The plan is installed per engine.run call (`install`), which resets the
 per-site counters — invocation indices are deterministic within one
@@ -61,8 +63,12 @@ ACTIONS = ("unavailable", "hang", "die", "truncate", "error")
 
 # the wired injection points — a closed set, validated at parse time so
 # a typo'd site fails loudly instead of becoming a silent no-op plan
-# (the exact failure mode a deterministic harness exists to prevent)
-SITES = ("dispatch", "fetch", "writer", "ckpt")
+# (the exact failure mode a deterministic harness exists to prevent).
+# `init` fires at the engine's pre-snapshot init dispatch (the window
+# the supervised-init retry covers — ROADMAP PR-3 follow-up); it is a
+# separate site so injecting there does not shift the invocation
+# indices of the `dispatch` plans existing tests pin.
+SITES = ("dispatch", "fetch", "writer", "ckpt", "init")
 
 
 class FaultInjected(Exception):

@@ -215,19 +215,27 @@ def reported_best(hcv: int, scv: int) -> int:
 
 
 def log_entry(stream: IO, proc_id: int, thread_id: int, best: int,
-              time_s: float) -> None:
-    _write(stream, {"logEntry": {
+              time_s: float, job: Optional[str] = None) -> None:
+    rec = {
         "procID": proc_id,
         "threadID": thread_id,
         "best": int(best),
         "time": max(0.0, float(time_s)),
-    }})
+    }
+    if job is not None:
+        # multi-tenant serving (timetabling_ga_tpu/serve): every record
+        # of a job's stream carries its id, so one shared output stream
+        # demultiplexes per tenant. Absent on single-run streams — the
+        # reference protocol's records stay byte-identical there.
+        rec["job"] = str(job)
+    _write(stream, {"logEntry": rec})
 
 
 def solution_record(stream: IO, proc_id: int, thread_id: int,
                     total_time: float, total_best: int, feasible: bool,
                     timeslots: Optional[List[int]] = None,
-                    rooms: Optional[List[int]] = None) -> None:
+                    rooms: Optional[List[int]] = None,
+                    job: Optional[str] = None) -> None:
     rec = {
         "procID": proc_id,
         "threadID": thread_id,
@@ -238,7 +246,27 @@ def solution_record(stream: IO, proc_id: int, thread_id: int,
     if feasible:
         rec["timeslots"] = [int(x) for x in timeslots]
         rec["rooms"] = [int(x) for x in rooms]
+    if job is not None:
+        rec["job"] = str(job)
     _write(stream, {"solution": rec})
+
+
+def job_entry(stream: IO, job: str, event: str, **extra) -> None:
+    """Serving EXTENSION record (not in the reference protocol): one
+    line per job lifecycle transition on the service stream —
+
+      {"jobEntry":{"job":"j1","event":"admitted","bucket":[64,8,8,64,
+                   5,9]}}
+
+    `event` is one of admitted / rejected / started / parked / done /
+    failed / cancelled; `extra` carries per-event context (bucket dims,
+    generation counts, rejection reason). Deliberately no wall-clock
+    field: lifecycle records must stay in the byte-identity domain of
+    determinism tests (strip_timing keeps them)."""
+    rec = {"job": str(job), "event": str(event)}
+    for k, v in extra.items():
+        rec[k] = v
+    _write(stream, {"jobEntry": rec})
 
 
 def fault_entry(stream: IO, site: str, action: str, error, trial: int,
@@ -315,10 +343,13 @@ def strip_timing(records: List[dict]) -> List[dict]:
 def run_entry(stream: IO, total_best: int, feasible: bool,
               procs_num: Optional[int] = None,
               threads_num: Optional[int] = None,
-              total_time: Optional[float] = None) -> None:
+              total_time: Optional[float] = None,
+              job: Optional[str] = None) -> None:
     rec = {"totalBest": int(total_best), "feasible": bool(feasible)}
     if procs_num is not None:
         rec["procsNum"] = int(procs_num)
         rec["threadsNum"] = int(threads_num)
         rec["totalTime"] = float(total_time)
+    if job is not None:
+        rec["job"] = str(job)
     _write(stream, {"runEntry": rec})

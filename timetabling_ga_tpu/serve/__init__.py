@@ -1,0 +1,44 @@
+"""tt-serve — the multi-tenant batched solver service (ISSUE 4).
+
+The engine solves one `.tim` instance per invocation, inheriting the
+reference's one-problem-one-process shape (`mpirun ... -i comp01.tim`).
+This subsystem turns the same compiled island machinery into a SERVER:
+many concurrent solve jobs, admitted through a bounded queue, batched
+onto shared accelerator hardware, time-sliced so late arrivals don't
+starve, and streamed back as job-tagged JSONL records.
+
+Four layers (each its own module):
+
+  bucket.py     shape bucketing: pad a parsed Problem's arrays up to
+                geometric bucket boundaries with validity masks, so
+                every job in a bucket hits the SAME compiled island
+                programs — compile-cache keys become bucket shapes,
+                not instance shapes. Padding is provably neutral:
+                padded events carry zero attendance/features and
+                padded rooms zero capacity, and the mask-aware kernels
+                (ops/fitness.py, ops/rooms.py, ops/delta.py,
+                ops/sweep.py) keep (penalty, hcv, scv) and the greedy
+                matching bit-exact vs the unpadded instance.
+  queue.py      job admission and lifecycle: bounded backlog,
+                priorities, per-job seed/budget/deadline, cancellation.
+  scheduler.py  packs compatible queued jobs into one mesh dispatch
+                (jobs stacked along the island axis — one lane each),
+                time-slices long jobs into generation quanta at the
+                engine's control-fence boundaries, and parks/resumes
+                jobs through the PR-3 host-snapshot machinery
+                (engine.fetch_state / engine.reshard_state).
+  service.py    the frontend: a Python API (SolveService) and a
+                line-JSON protocol (`tt serve`, cli.py), streaming each
+                job's records tagged with a `job` id through the
+                existing jsonl.AsyncWriter.
+
+EvoX (arXiv:2301.12457) motivates the shape: evolutionary workloads as
+batched tensor programs behind a scheduling layer; the wafer-scale
+island work (arXiv:2405.03605) multiplexes island populations far
+beyond one problem's needs the same way.
+"""
+
+from timetabling_ga_tpu.serve.bucket import (  # noqa: F401
+    BucketSpec, bucket_dims, bucket_key, pad_problem)
+from timetabling_ga_tpu.serve.queue import (  # noqa: F401
+    AdmissionError, Job, JobQueue, JobState)

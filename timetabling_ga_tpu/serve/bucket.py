@@ -1,0 +1,162 @@
+"""Shape bucketing: pad instances to shared compile shapes, neutrally.
+
+jax.jit compiles PER INPUT SHAPE, so a service that solved each
+instance at its native (E, R, F, S) would pay a multi-second XLA
+compile for every new instance shape — the compile cache would be as
+fragmented as the traffic. Padding every instance up to geometric
+bucket boundaries makes the compile-cache key the BUCKET shape: any
+two instances in a bucket share every compiled island program, and a
+warm bucket serves a cold instance with zero compiles
+(tests/test_serve.py pins "exactly one trace per program per bucket").
+
+Neutrality contract (the part that makes this safe to serve):
+
+  - padded EVENTS attend no students, require no features, and carry
+    `ProblemArrays.event_mask == 0`: the mask-aware kernels exclude
+    them from occupancy, clash/correlation counts, the unsuitable-room
+    count, and the greedy matcher's occupancy bookkeeping — they are
+    genotype freeloaders whose slot/room values cannot affect any
+    penalty term;
+  - padded ROOMS have zero capacity, zero features, and
+    `room_mask == False`: `possible[:, padded]` is forced False and
+    every room argmin carries the `_W_DEAD` key penalty, so no live
+    event ever chooses one;
+  - `possible[padded_event, :]` is forced uniformly False, so the
+    unsuitable-room DELTA of relocating a padded event is identically
+    zero on every path.
+
+Together: for any genotype that places live events exactly as an
+unpadded genotype does, (penalty, hcv, scv) are bit-exact equal, and
+`assign_rooms` assigns live events the same rooms (padded rooms
+append at the tail, so live capacity ranks shift uniformly and every
+argmin comparison among live rooms is preserved).
+tests/test_serve.py pins both properties on the ITC fixtures.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from timetabling_ga_tpu.problem import Problem, derive
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSpec:
+    """Geometric bucket boundaries: dim -> smallest floor*ratio^k >= n.
+
+    Floors keep tiny instances from over-fragmenting the small buckets;
+    ratio 2 bounds padding waste below 2x per dimension (the classic
+    geometric-bucketing bound). The slot grid (n_days, slots_per_day)
+    is never padded — it is part of the bucket key instead: timeslot
+    semantics (last-slot-of-day scv, day windows) are not maskable."""
+
+    event_floor: int = 32
+    room_floor: int = 4
+    feature_floor: int = 4
+    student_floor: int = 32
+    ratio: float = 2.0
+
+
+DEFAULT_SPEC = BucketSpec()
+
+
+def _round_up(n: int, floor: int, ratio: float) -> int:
+    if n <= 0:
+        return floor
+    size = floor
+    while size < n:
+        size = int(np.ceil(size * ratio))
+    return size
+
+
+def bucket_dims(problem: Problem, spec: BucketSpec = DEFAULT_SPEC
+                ) -> tuple[int, int, int, int]:
+    """(E', R', F', S') bucket boundaries for `problem`."""
+    return (_round_up(problem.n_events, spec.event_floor, spec.ratio),
+            _round_up(problem.n_rooms, spec.room_floor, spec.ratio),
+            _round_up(problem.n_features, spec.feature_floor, spec.ratio),
+            _round_up(problem.n_students, spec.student_floor, spec.ratio))
+
+
+def bucket_key(problem: Problem, spec: BucketSpec = DEFAULT_SPEC
+               ) -> tuple:
+    """The compile-compatibility key: bucket dims + the slot grid.
+
+    Two jobs with equal bucket_key (and equal breeding config) execute
+    the SAME compiled island programs — the scheduler packs them into
+    one dispatch and the engine's program caches serve both."""
+    return bucket_dims(problem, spec) + (problem.n_days,
+                                         problem.slots_per_day)
+
+
+def pad_problem(problem: Problem, spec: BucketSpec = DEFAULT_SPEC
+                ) -> Problem:
+    """Pad `problem` up to its bucket boundaries with masked padding.
+
+    Returns a new Problem whose raw arrays are zero-padded to
+    `bucket_dims`, whose `possible` matrix enforces the neutrality
+    contract (module docstring), and whose `n_live_events` /
+    `n_live_rooms` drive the ProblemArrays validity masks. Idempotent
+    on an already-bucket-shaped instance (same dims in = same dims
+    out), and a no-op-shaped instance still gets the mask fields set."""
+    E, R, F, S = (problem.n_events, problem.n_rooms, problem.n_features,
+                  problem.n_students)
+    Ep, Rp, Fp, Sp = bucket_dims(problem, spec)
+    # The room-key packing bound (ops/rooms.py: `assert E < 4096 and
+    # R < _W_UNSUIT`) applies to the PADDED dims — geometric rounding
+    # can push an instance the single-run engine solves fine (e.g.
+    # E = 2500) up to a bucket that would assert at trace time. Reject
+    # it here, at admission, with an actionable error instead.
+    if Ep >= 4096 or Rp >= 4096:
+        raise ValueError(
+            f"instance too large for serve bucketing: padded dims "
+            f"events={Ep} rooms={Rp} exceed the room-key packing "
+            f"bound 4096 (instance events={E} rooms={R}; use the "
+            f"single-run engine, or a finer BucketSpec ratio)")
+
+    room_size = np.zeros((Rp,), np.int32)
+    room_size[:R] = problem.room_size
+    attends = np.zeros((Sp, Ep), np.int8)
+    attends[:S, :E] = problem.attends
+    room_features = np.zeros((Rp, Fp), np.int8)
+    room_features[:R, :F] = problem.room_features
+    event_features = np.zeros((Ep, Fp), np.int8)
+    event_features[:E, :F] = problem.event_features
+
+    padded = derive(Ep, Rp, Fp, Sp, room_size, attends, room_features,
+                    event_features, n_days=problem.n_days,
+                    slots_per_day=problem.slots_per_day)
+    # derive() leaves zero-padding mostly neutral (conflict rows/cols and
+    # student counts of padded events are zero by construction), but the
+    # suitability matrix needs the explicit contract: a zero-requirement
+    # live event would otherwise find a zero-capacity padded room
+    # "possible", and padded events would look placeable everywhere.
+    possible = np.array(padded.possible)
+    possible[E:, :] = False       # padded events suit NO room
+    possible[:, R:] = False       # padded rooms suit NO event
+    return dataclasses.replace(padded, possible=possible,
+                               n_live_events=E, n_live_rooms=R)
+
+
+def embed_population(slots: np.ndarray, rooms: np.ndarray,
+                     padded: Problem) -> tuple[np.ndarray, np.ndarray]:
+    """Extend (P, E) live genotypes to the padded (P, E') shape.
+
+    Padded events are parked at slot 0 / room 0 — any valid indices
+    work, since the masks make them fitness- and matching-invisible."""
+    P, E = slots.shape
+    Ep = padded.n_events
+    s = np.zeros((P, Ep), np.int32)
+    r = np.zeros((P, Ep), np.int32)
+    s[:, :E] = slots
+    r[:, :E] = rooms
+    return s, r
+
+
+def extract_solution(slots, rooms, padded: Problem):
+    """Slice a padded genotype back to the live events."""
+    E = (padded.n_live_events if padded.n_live_events is not None
+         else padded.n_events)
+    return np.asarray(slots)[..., :E], np.asarray(rooms)[..., :E]

@@ -1,0 +1,146 @@
+"""Job admission and lifecycle for the solver service.
+
+The backlog is BOUNDED (admission control): a service that accepts
+unbounded work converts overload into unbounded latency for everyone;
+rejecting at submit time converts it into immediate, actionable
+backpressure — the same principle as jsonl.AsyncWriter's bounded
+queue. Priorities order admission into the scheduler's lanes
+(higher first, FIFO within a priority); a job's seed, generation
+budget and wall-clock deadline travel with it, so one tenant's
+parameters can never leak into another's stream.
+
+Lifecycle:
+
+    PENDING --admit--> RUNNING --quantum--> PARKED --resume--> RUNNING
+       |                  |                    |
+       |                  +------- budget/deadline ------> DONE
+       +--cancel--> CANCELLED      (failure) ------------> FAILED
+
+PARKED is the between-quanta state: the job's population lives as a
+host snapshot (the PR-3 checkpoint tuple), not on the device, so a
+parked job costs zero HBM and any number of jobs can share the lanes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Optional
+
+from timetabling_ga_tpu.problem import Problem
+
+
+class JobState:
+    """String states (JSON-friendly; no enum dependency in records)."""
+    PENDING = "pending"
+    RUNNING = "running"
+    PARKED = "parked"
+    DONE = "done"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+
+    ACTIVE = (PENDING, RUNNING, PARKED)
+    TERMINAL = (DONE, FAILED, CANCELLED)
+
+
+class AdmissionError(RuntimeError):
+    """Backlog full — the job was NOT admitted (admission control)."""
+
+
+@dataclasses.dataclass
+class Job:
+    """One solve request plus its runtime bookkeeping."""
+
+    id: str
+    problem: Problem                  # the parsed, UNPADDED instance
+    priority: int = 0                 # higher = served first
+    seed: int = 0
+    generations: int = 200            # total generation budget
+    deadline_s: Optional[float] = None  # wall-clock bound from submit
+    # -- runtime (owned by the scheduler) --------------------------------
+    state: str = JobState.PENDING
+    seq: int = 0                      # admission order (FIFO tie-break)
+    padded: Optional[Problem] = None  # bucket-padded instance
+    bucket: Optional[tuple] = None    # serve.bucket.bucket_key result
+    pa_dev: object = None             # padded ProblemArrays (device)
+    gens_done: int = 0
+    chunks: int = 0                   # dispatched quanta (RNG stream idx)
+    snapshot: object = None           # host PopState between quanta
+    best: int = 2 ** 31 - 1           # reported-form best seen
+    emitted: int = 2 ** 31 - 1        # logEntry floor (no duplicates)
+    submitted_t: float = 0.0
+    finished_t: Optional[float] = None
+    result: Optional[dict] = None
+    error: Optional[str] = None
+
+    def runnable(self) -> bool:
+        return self.state in (JobState.PENDING, JobState.RUNNING,
+                              JobState.PARKED)
+
+    def remaining(self) -> int:
+        return max(0, self.generations - self.gens_done)
+
+
+class JobQueue:
+    """Bounded, priority-ordered job table.
+
+    Holds every job the service knows about (terminal jobs stay
+    queryable until `forget`); `backlog` bounds only the ACTIVE set.
+    Single-threaded by design — the scheduler drives it between
+    dispatches, the service mutates it between requests; there is no
+    concurrent producer the way there is for AsyncWriter."""
+
+    def __init__(self, backlog: int = 64, now=None):
+        import time
+        self._backlog = backlog
+        self._jobs: dict[str, Job] = {}
+        self._seq = itertools.count()
+        self._now = now or time.monotonic
+
+    def __len__(self) -> int:
+        return len(self._jobs)
+
+    def active(self) -> list[Job]:
+        return [j for j in self._jobs.values() if j.runnable()]
+
+    def submit(self, job: Job) -> str:
+        if job.id in self._jobs:
+            raise AdmissionError(f"duplicate job id {job.id!r}")
+        if len(self.active()) >= self._backlog:
+            raise AdmissionError(
+                f"backlog full ({self._backlog} active jobs) — "
+                f"job {job.id!r} rejected")
+        job.seq = next(self._seq)
+        job.submitted_t = self._now()
+        job.state = JobState.PENDING
+        self._jobs[job.id] = job
+        return job.id
+
+    def get(self, job_id: str) -> Job:
+        return self._jobs[job_id]
+
+    def cancel(self, job_id: str) -> bool:
+        """Cancel a job: immediate for PENDING/PARKED, honored at the
+        next control fence for RUNNING (the scheduler checks state
+        between dispatches — a quantum is never interrupted mid-kernel,
+        exactly like every other engine control decision)."""
+        job = self._jobs.get(job_id)
+        if job is None or job.state in JobState.TERMINAL:
+            return False
+        job.state = JobState.CANCELLED
+        job.finished_t = self._now()
+        job.snapshot = None
+        return True
+
+    def ready(self, bucket: Optional[tuple] = None) -> list[Job]:
+        """Runnable jobs (optionally of one bucket), scheduling order:
+        higher priority first, then least-served, then admission order —
+        the least-served term is what lets a small late job overtake a
+        long early one inside a full bucket (fairness)."""
+        jobs = [j for j in self.active()
+                if bucket is None or j.bucket == bucket]
+        return sorted(jobs, key=lambda j: (-j.priority, j.gens_done,
+                                           j.seq))
+
+    def forget(self, job_id: str) -> None:
+        self._jobs.pop(job_id, None)

@@ -1,0 +1,283 @@
+"""The serve scheduler: pack, time-slice, park, resume.
+
+Turns a queue of heterogeneous solve jobs into a sequence of fused mesh
+dispatches:
+
+  PACKING   runnable jobs are grouped by bucket_key (serve/bucket.py):
+            only same-bucket jobs share compiled programs, so only they
+            can ride one dispatch. Up to `lanes` jobs are stacked along
+            the island axis (one lane each) into a single
+            engine.cached_lane_runner call — the whole mesh advances
+            many tenants at once, and the compile-cache key is the
+            bucket shape, never the instance.
+
+  SLICING   a dispatch runs at most `quantum` generations per lane (a
+            lane with less budget left runs less — per-lane counts are
+            runtime arguments, so no new shapes). Between dispatches is
+            a control fence: cancellations, deadlines, and newly
+            admitted jobs all take effect there, so a late small job
+            waits at most one quantum for a lane — the fairness the
+            one-run-per-process engine cannot offer.
+
+  PARKING   between quanta every job's population lives as a host
+            snapshot (engine.fetch_state — the same all-numpy tuple the
+            PR-3 fault supervisor rolls and checkpoint.save serializes)
+            and is re-placed with engine.reshard_state at its next
+            slice. Parked jobs cost zero device memory, so the backlog
+            can exceed the lanes by any factor. Fetch/re-place per
+            quantum is the v1 cost model (exact, simple, and measured
+            by bench.py extra.serve); keeping a resident group on
+            device between unchanged dispatches is the known follow-up
+            (ROADMAP).
+
+  FAIRNESS  bucket groups are served round-robin, and within a group
+            jobs are ordered by (priority desc, generations-served asc,
+            arrival) — so a long job cannot starve a later short one
+            even inside its own bucket.
+
+RNG isolation: lane l of dispatch d runs job j's chunk c with keys
+fold_in(key(j.seed), c) — a pure function of the job's own identity and
+progress. A job's record stream is therefore bit-identical whether it
+ran alone or packed with any mix of co-tenants (pinned by
+tests/test_serve.py).
+
+Single-device, single-process by design in v1: multi-device lane
+sharding only needs `lanes % devices == 0` plumbing, and multi-host
+serving has the same agreement problem as the ROADMAP's multi-host
+recovery item.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from timetabling_ga_tpu.ops import ga
+from timetabling_ga_tpu.parallel import islands
+from timetabling_ga_tpu.runtime import jsonl
+from timetabling_ga_tpu.runtime.config import ServeConfig
+from timetabling_ga_tpu.serve import bucket as bucket_mod
+from timetabling_ga_tpu.serve.queue import Job, JobQueue, JobState
+
+INT_MAX = 2 ** 31 - 1
+
+
+def _stack_states(snaps, pop: int, n_lanes: int, n_events: int
+                  ) -> ga.PopState:
+    """Concatenate per-job host snapshots (and zero filler for idle
+    lanes) into the (n_lanes * pop, E) stacked host state."""
+    parts = list(snaps)
+    for _ in range(n_lanes - len(parts)):
+        parts.append(ga.PopState(
+            slots=np.zeros((pop, n_events), np.int32),
+            rooms=np.zeros((pop, n_events), np.int32),
+            penalty=np.full((pop,), INT_MAX, np.int32),
+            hcv=np.full((pop,), INT_MAX, np.int32),
+            scv=np.full((pop,), INT_MAX, np.int32)))
+    return ga.PopState(*[np.concatenate([getattr(p, f) for p in parts])
+                         for f in ga.PopState._fields])
+
+
+def _slice_state(host: ga.PopState, lane: int, pop: int) -> ga.PopState:
+    """One lane's rows of a stacked host state, copied (the job owns
+    its snapshot; the stacked buffer is rebuilt every quantum)."""
+    lo, hi = lane * pop, (lane + 1) * pop
+    return ga.PopState(*[np.array(getattr(host, f)[lo:hi])
+                         for f in ga.PopState._fields])
+
+
+class Scheduler:
+    """Drives a JobQueue through the engine's lane programs."""
+
+    def __init__(self, cfg: ServeConfig, queue: JobQueue, out,
+                 now=None):
+        import jax
+        self.cfg = cfg
+        self.queue = queue
+        self.out = out
+        self._now = now or time.monotonic
+        self.spec = bucket_mod.BucketSpec(
+            event_floor=cfg.bucket_events, room_floor=cfg.bucket_rooms,
+            feature_floor=cfg.bucket_features,
+            student_floor=cfg.bucket_students, ratio=cfg.bucket_ratio)
+        # v1 serves from ONE device (module docstring); lane count is
+        # free because every lane of a single shard is a vmapped local
+        # island (islands.local_islands)
+        self.mesh = islands.make_mesh(1)
+        self.gacfg = ga.GAConfig(
+            pop_size=cfg.pop_size,
+            ls_steps=max(1, cfg.max_steps // cfg.ls_candidates),
+            ls_candidates=cfg.ls_candidates)
+        self._rr = 0               # round-robin cursor over buckets
+        self._jax = jax
+
+    # -- admission ------------------------------------------------------
+
+    def prepare(self, job: Job) -> None:
+        """Pad the instance to its bucket and place the problem data.
+        Called by the service BEFORE queue.submit: anything that can
+        fail about the instance (over-bound buckets, placement errors)
+        fails here, while the job is still nobody's — the queue never
+        holds a half-admitted job with no bucket."""
+        job.padded = bucket_mod.pad_problem(job.problem, self.spec)
+        job.bucket = bucket_mod.bucket_key(job.problem, self.spec)
+        job.pa_dev = job.padded.device_arrays()
+
+    def admit(self, job: Job) -> None:
+        """Record the admission (after queue.submit succeeds)."""
+        jsonl.job_entry(self.out, job.id, "admitted",
+                        bucket=list(job.bucket),
+                        generations=job.generations,
+                        priority=job.priority)
+
+    # -- one dispatch cycle --------------------------------------------
+
+    def _reap(self) -> None:
+        """Deadline pass at the control fence: finalize what ran out of
+        wall clock with its best-so-far (a serving deadline is a budget
+        cut, not an error — unless the job never got a single slice)."""
+        now = self._now()
+        for job in self.queue.active():
+            if (job.deadline_s is not None
+                    and now - job.submitted_t > job.deadline_s):
+                if job.snapshot is not None:
+                    self._finalize(job, deadline_hit=True)
+                else:
+                    job.state = JobState.FAILED
+                    job.finished_t = now
+                    job.error = "deadline before first slice"
+                    jsonl.job_entry(self.out, job.id, "failed",
+                                    reason="deadline", gens=0)
+
+    def _buckets_ready(self) -> list[tuple]:
+        seen: list[tuple] = []
+        for job in self.queue.ready():
+            if job.bucket not in seen:
+                seen.append(job.bucket)
+        return seen
+
+    def step(self) -> bool:
+        """Run one fused dispatch for the next bucket group (round-
+        robin). Returns True while any runnable job remains."""
+        self._reap()
+        buckets = self._buckets_ready()
+        if not buckets:
+            return False
+        bkey = buckets[self._rr % len(buckets)]
+        self._rr += 1
+
+        lanes = self.cfg.lanes
+        pop = self.cfg.pop_size
+        jobs = self.queue.ready(bkey)[:lanes]
+        fresh = [j for j in jobs if j.snapshot is None]
+        if fresh:
+            self._init_jobs(fresh)
+        for job in jobs:
+            if job.state != JobState.RUNNING:
+                job.state = JobState.RUNNING
+
+        Ep = jobs[0].padded.n_events
+        pa_stack = self._jax.tree.map(
+            lambda *ls: self._jax.numpy.stack(ls),
+            *[j.pa_dev for j in jobs],
+            *([jobs[0].pa_dev] * (lanes - len(jobs))))
+        seeds = np.zeros((lanes,), np.int32)
+        chunks = np.zeros((lanes,), np.int32)
+        gens = np.zeros((lanes,), np.int32)
+        for lane, job in enumerate(jobs):
+            seeds[lane] = job.seed
+            chunks[lane] = job.chunks
+            gens[lane] = min(self.cfg.quantum, job.remaining())
+
+        from timetabling_ga_tpu.runtime import engine
+        host0 = _stack_states([j.snapshot for j in jobs], pop, lanes, Ep)
+        state = engine.reshard_state(host0, self.mesh)
+        runner, _ = engine.cached_lane_runner(
+            self.mesh, self.gacfg, self.cfg.quantum, lanes, donate=True)
+        state, trace = runner(pa_stack, seeds, chunks, state, gens)
+        trace = np.asarray(trace)            # (lanes, quantum, 2)
+        host = engine.fetch_state(state)
+
+        now = self._now()
+        for lane, job in enumerate(jobs):
+            job.snapshot = _slice_state(host, lane, pop)
+            job.chunks += 1
+            job.gens_done += int(gens[lane])
+            for g in range(int(gens[lane])):
+                h, s = int(trace[lane, g, 0]), int(trace[lane, g, 1])
+                rep = jsonl.reported_best(h, s)
+                if rep < job.best:
+                    job.best = rep
+                if rep < job.emitted:
+                    job.emitted = rep
+                    jsonl.log_entry(self.out, 0, 0, rep,
+                                    now - job.submitted_t, job=job.id)
+            job.state = JobState.PARKED
+            if job.remaining() == 0:
+                self._finalize(job)
+        return bool(self.queue.ready())
+
+    def drive(self) -> None:
+        """Run dispatches until no runnable job remains."""
+        while self.step():
+            pass
+
+    # -- job endpoints --------------------------------------------------
+
+    def _init_jobs(self, jobs: list[Job]) -> None:
+        """First slices, BATCHED: all freshly scheduled jobs of the
+        group initialize in ONE lane-stacked dispatch (the same lane
+        width as the runner, so each bucket compiles exactly one init
+        program). Each lane draws from key(its job's seed) alone, so
+        batched init preserves the co-tenant-independence contract.
+        Idle lanes replicate the first job's data and are discarded."""
+        from timetabling_ga_tpu.runtime import engine
+        lanes = self.cfg.lanes
+        init = engine.cached_lane_init(self.mesh, self.cfg.pop_size,
+                                       self.gacfg, n_lanes=lanes)
+        pa_stack = self._jax.tree.map(
+            lambda *ls: self._jax.numpy.stack(ls),
+            *[j.pa_dev for j in jobs],
+            *([jobs[0].pa_dev] * (lanes - len(jobs))))
+        seeds = np.zeros((lanes,), np.int32)
+        for lane, job in enumerate(jobs):
+            seeds[lane] = job.seed
+        host = engine.fetch_state(init(pa_stack, seeds))
+        for lane, job in enumerate(jobs):
+            job.snapshot = _slice_state(host, lane, self.cfg.pop_size)
+            jsonl.job_entry(self.out, job.id, "started",
+                            bucket=list(job.bucket))
+
+    def _finalize(self, job: Job, deadline_hit: bool = False) -> None:
+        """Emit the job's endTry records from its snapshot (row 0 is
+        the lane's lex-best individual) and mark it DONE."""
+        snap = job.snapshot
+        hcv = int(snap.hcv[0])
+        scv = int(snap.scv[0])
+        rep = jsonl.reported_best(hcv, scv)
+        if rep < job.best:
+            job.best = rep
+        feasible = hcv == 0
+        total_time = self._now() - job.submitted_t
+        slots, rooms = bucket_mod.extract_solution(
+            snap.slots[0], snap.rooms[0], job.padded)
+        jsonl.solution_record(
+            self.out, 0, 0, total_time, job.best, feasible,
+            timeslots=slots.tolist() if feasible else None,
+            rooms=rooms.tolist() if feasible else None, job=job.id)
+        jsonl.run_entry(self.out, job.best, feasible, job=job.id)
+        jsonl.run_entry(self.out, job.best, feasible, procs_num=1,
+                        threads_num=1, total_time=total_time,
+                        job=job.id)
+        jsonl.job_entry(self.out, job.id, "done", gens=job.gens_done,
+                        best=job.best, feasible=feasible,
+                        deadline_hit=deadline_hit)
+        job.state = JobState.DONE
+        job.finished_t = self._now()
+        job.result = {"best": job.best, "feasible": feasible,
+                      "hcv": hcv, "scv": scv, "gens": job.gens_done,
+                      "deadline_hit": deadline_hit,
+                      "timeslots": slots.tolist(),
+                      "rooms": rooms.tolist()}
+        job.snapshot = None        # parked memory released

@@ -1,0 +1,183 @@
+"""The service frontend: Python API + line-JSON protocol.
+
+Python API:
+
+    from timetabling_ga_tpu.runtime.config import ServeConfig
+    from timetabling_ga_tpu.serve.service import SolveService
+
+    svc = SolveService(ServeConfig(backend="cpu"), out=stream)
+    jid = svc.submit(problem, generations=100, priority=5)
+    svc.drive()                       # run until every job settles
+    svc.result(jid)                   # {"best": ..., "feasible": ...}
+    svc.close()
+
+Line-JSON protocol (`tt serve` / `python -m timetabling_ga_tpu serve`):
+one request object per input line, one record object per output line —
+the engine's JSONL protocol with each record tagged `"job"`, plus the
+`jobEntry` lifecycle records (jsonl.job_entry):
+
+    {"submit": {"id": "j1", "instance": "comp01.tim", "priority": 5,
+                "seed": 42, "generations": 200, "deadline": 30.0}}
+    {"submit": {"id": "j2", "tim": "4 2 2 5\\n..."}}   inline instance
+    {"cancel": "j1"}
+    {"drain": true}                    run everything admitted so far
+
+Requests are processed in order; `drain` (and end-of-input) hands the
+queue to the scheduler. A malformed request or a rejected submission
+emits a jobEntry (event "rejected") and the stream continues — one bad
+tenant must not take down the service.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from timetabling_ga_tpu.problem import load_tim, load_tim_file
+from timetabling_ga_tpu.runtime import jsonl
+from timetabling_ga_tpu.runtime.config import ServeConfig, parse_serve_args
+from timetabling_ga_tpu.serve.queue import AdmissionError, Job, JobQueue
+from timetabling_ga_tpu.serve.scheduler import Scheduler
+
+
+class SolveService:
+    """Owns the queue, the scheduler, and the job-tagged record stream.
+
+    All records ride a jsonl.AsyncWriter, so solve dispatches never
+    stall on host I/O — the same telemetry discipline as the engine's
+    run loop, shared across every tenant of the stream."""
+
+    def __init__(self, cfg: ServeConfig, out=None, now=None):
+        import jax
+        if cfg.backend == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        self.cfg = cfg
+        self._close_out = False
+        if out is None:
+            if cfg.output:
+                out = open(cfg.output, "w")
+                self._close_out = True
+            else:
+                out = sys.stdout
+        self._raw_out = out
+        self.writer = jsonl.AsyncWriter(out)
+        self.queue = JobQueue(cfg.backlog, now=now)
+        self.scheduler = Scheduler(cfg, self.queue, self.writer, now=now)
+        self._auto_id = 0
+
+    # -- API -------------------------------------------------------------
+
+    def submit(self, problem, job_id=None, priority: int = 0,
+               seed=None, generations=None, deadline_s=None) -> str:
+        """Admit one job; returns its id. Raises AdmissionError when
+        the backlog is full or the id is taken (admission control)."""
+        if job_id is None:
+            self._auto_id += 1
+            job_id = f"job-{self._auto_id}"
+        job = Job(id=str(job_id), problem=problem,
+                  priority=int(priority),
+                  seed=int(self.cfg.seed if seed is None else seed),
+                  generations=int(self.cfg.generations
+                                  if generations is None
+                                  else generations),
+                  deadline_s=deadline_s)
+        # prepare (pad + place) BEFORE the queue takes the job: a
+        # failing instance is rejected here with the queue untouched —
+        # no half-admitted job can reach the scheduler
+        self.scheduler.prepare(job)
+        self.queue.submit(job)
+        self.scheduler.admit(job)
+        return job.id
+
+    def cancel(self, job_id: str) -> bool:
+        ok = self.queue.cancel(job_id)
+        if ok:
+            jsonl.job_entry(self.writer, job_id, "cancelled")
+        return ok
+
+    def drive(self) -> None:
+        """Run dispatches until every admitted job settles."""
+        self.scheduler.drive()
+
+    def step(self) -> bool:
+        """One dispatch cycle (for callers interleaving submissions)."""
+        return self.scheduler.step()
+
+    def result(self, job_id: str):
+        return self.queue.get(job_id).result
+
+    def state(self, job_id: str) -> str:
+        return self.queue.get(job_id).state
+
+    def close(self) -> None:
+        self.writer.close()
+        if self._close_out:
+            self._raw_out.close()
+
+
+def _load_submit_problem(req: dict):
+    if "tim" in req:
+        return load_tim(req["tim"])
+    return load_tim_file(req["instance"])
+
+
+def serve_stream(cfg: ServeConfig, in_stream, out_stream=None,
+                 now=None) -> SolveService:
+    """Run the line-JSON protocol over `in_stream` to completion.
+
+    Returns the (closed) service so programmatic callers can inspect
+    results. Errors in individual requests are reported on the record
+    stream and skipped."""
+    svc = SolveService(cfg, out=out_stream, now=now)
+    try:
+        for line in in_stream:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+            except ValueError as e:
+                jsonl.job_entry(svc.writer, "?", "rejected",
+                                reason=f"bad request: {e}")
+                continue
+            if "submit" in req:
+                sub = req["submit"]
+                try:
+                    svc.submit(_load_submit_problem(sub),
+                               job_id=sub.get("id"),
+                               priority=sub.get("priority", 0),
+                               seed=sub.get("seed"),
+                               generations=sub.get("generations"),
+                               deadline_s=sub.get("deadline"))
+                except Exception as e:
+                    # one bad tenant must not take down the service:
+                    # ANY submit-side failure (parse error, admission
+                    # control, over-bound bucket, placement OOM) is a
+                    # rejection record, and the stream continues —
+                    # submit() leaves no partial state (prepare runs
+                    # before the queue takes the job)
+                    jsonl.job_entry(svc.writer, str(sub.get("id", "?")),
+                                    "rejected", reason=str(e)[:200])
+            elif "cancel" in req:
+                svc.cancel(str(req["cancel"]))
+            elif "drain" in req:
+                svc.drive()
+            else:
+                jsonl.job_entry(svc.writer, "?", "rejected",
+                                reason=f"unknown request "
+                                       f"{sorted(req)[:3]}")
+        svc.drive()
+    finally:
+        svc.close()
+    return svc
+
+
+def main_serve(argv) -> int:
+    """`tt serve` entry point (cli.py dispatches here)."""
+    cfg = parse_serve_args(argv)
+    if cfg.input:
+        with open(cfg.input, "r") as fh:
+            serve_stream(cfg, fh)
+    else:
+        serve_stream(cfg, sys.stdin)
+    return 0

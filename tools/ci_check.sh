@@ -28,7 +28,14 @@ step "compileall"
 python -m compileall -q timetabling_ga_tpu tests tools bench.py || fail=1
 
 if [ "${1:-}" = "--fast" ]; then
-    [ "$fail" -eq 0 ] && step "OK (fast mode: tests skipped)"
+    # fast mode still exercises the serve subsystem end-to-end: its
+    # test module is minutes not tens of minutes, and the serve stack
+    # (bucketing neutrality, compile-once, scheduler fairness) spans
+    # enough layers that a lint-only gate would miss real breakage
+    step "serve tests (tests/test_serve.py)"
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_serve.py -q -p no:cacheprovider || fail=1
+    [ "$fail" -eq 0 ] && step "OK (fast mode: full test tier skipped)"
     exit $fail
 fi
 
