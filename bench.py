@@ -783,6 +783,95 @@ def measure_serve() -> dict:
     }
 
 
+def measure_obs(problem, pop: int = 256, gens: int = 600) -> dict:
+    """extra.obs leg (ISSUE 5): span+metrics overhead and the
+    telemetry-leaf reduction, same-session A/B.
+
+    Three legs of the SAME run (same seed, same programs): obs off,
+    obs on (spans + per-dispatch metricsEntry snapshots riding the
+    writer), and obs on with --trace-mode deltas (the compressed
+    telemetry leaf). `records_identical_modulo_timing` asserts all
+    three emit the same protocol records — observability must never
+    change what a run does. The leaf sizes are reported per island per
+    dispatch: deltas wins once the fused generation count clears
+    ~1.5x TRACE_DELTAS_CAP (below that the packed event block is the
+    bigger buffer — the point of the mode is LONG fused dispatches)."""
+    import dataclasses
+    import io
+    import json as _json
+    import tempfile
+
+    from timetabling_ga_tpu.parallel import islands as isl
+    from timetabling_ga_tpu.problem import dump_tim
+    from timetabling_ga_tpu.runtime import engine, jsonl
+    from timetabling_ga_tpu.runtime.config import RunConfig
+
+    with tempfile.NamedTemporaryFile("w", suffix=".tim",
+                                     delete=False) as f:
+        f.write(dump_tim(problem))
+        tim = f.name
+    try:
+        # long fused dispatches (4 x 50 gens) so the leaf reduction is
+        # in its design regime; 3 dispatches keep the leg cheap
+        base = RunConfig(input=tim, seed=1234, pop_size=pop, islands=1,
+                         generations=gens, migration_period=50,
+                         epochs_per_dispatch=4, ls_mode="sweep",
+                         ls_sweeps=1, init_sweeps=0,
+                         time_limit=100000.0, auto_tune=False,
+                         trace=True, metrics_every=1)
+        engine.precompile(base)
+        engine.precompile(dataclasses.replace(base, trace_mode="deltas"))
+
+        def leg(obs, trace_mode="full"):
+            cfg = dataclasses.replace(base, obs=obs,
+                                      trace_mode=trace_mode)
+            buf = io.StringIO()
+            t0 = time.perf_counter()
+            best = engine.run(cfg, out=buf)
+            wall = time.perf_counter() - t0
+            lines = [_json.loads(x) for x in buf.getvalue().splitlines()]
+            loop = [x["phase"] for x in lines if "phase" in x
+                    and x["phase"]["name"] == "gen-loop"][0]
+            n_spans = sum(1 for x in lines if "spanEntry" in x)
+            return {"best": best, "wall": wall,
+                    "loop_s": loop["seconds"],
+                    "dispatches": loop["dispatches"],
+                    "spans": n_spans,
+                    "recs": jsonl.strip_timing(lines)}
+
+        off = leg(False)
+        on = leg(True)
+        deltas = leg(True, trace_mode="deltas")
+    finally:
+        os.unlink(tim)
+    gpd = 4 * 50
+    leaf_full = gpd * 2
+    leaf_deltas = isl.trace_leaf_width(gpd, "deltas")
+    out = {
+        "pop": pop, "gens": gens, "dispatches": off["dispatches"],
+        "loop_s_obs_off": round(off["loop_s"], 3),
+        "loop_s_obs_on": round(on["loop_s"], 3),
+        "loop_s_obs_deltas": round(deltas["loop_s"], 3),
+        "obs_overhead_ms_per_dispatch": round(
+            (on["loop_s"] - off["loop_s"]) / max(1, on["dispatches"])
+            * 1e3, 3),
+        "span_records": on["spans"],
+        "trace_leaf_ints_per_island_full": leaf_full,
+        "trace_leaf_ints_per_island_deltas": leaf_deltas,
+        "trace_leaf_shrink": round(leaf_full / leaf_deltas, 2),
+        "records_identical_modulo_timing":
+            off["recs"] == on["recs"] == deltas["recs"],
+    }
+    print(f"# obs A/B (pop {pop}, {off['dispatches']} dispatches): "
+          f"loop {off['loop_s']:.3f}s off vs {on['loop_s']:.3f}s on "
+          f"({out['obs_overhead_ms_per_dispatch']} ms/dispatch, "
+          f"{on['spans']} spans) vs {deltas['loop_s']:.3f}s deltas; "
+          f"leaf {leaf_full} -> {leaf_deltas} ints/island "
+          f"(x{out['trace_leaf_shrink']}); records identical="
+          f"{out['records_identical_modulo_timing']}", file=sys.stderr)
+    return out
+
+
 def main() -> None:
     problem = _instance()
     # retry the headline through device sick windows (shared policy,
@@ -812,6 +901,7 @@ def main() -> None:
             ("kernel_cost",
              lambda: measure_kernel_cost(problem, tpu)),
             ("pipeline", lambda: measure_pipeline(problem)),
+            ("obs", lambda: measure_obs(problem)),
             ("serve", measure_serve),
             ("scale_2000ev", measure_scale),
             ("ls_shootout", lambda: measure_ls_shootout(problem)),

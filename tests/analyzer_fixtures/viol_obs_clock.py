@@ -1,0 +1,73 @@
+"""TT601 fixture: wall-clock reads / span enters inside trace targets.
+
+Not imported or executed — parsed by tests/test_analysis.py. A clock
+read (or a span emission) inside a jitted function executes at TRACE
+time: the compiled program carries the compile's wall clock as a
+constant, so the "timing" it reports never moves again.
+"""
+import functools
+import time
+from time import perf_counter
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from timetabling_ga_tpu.obs.spans import NULL_TRACER, SpanTracer
+
+tracer = SpanTracer(out=None, enabled=False)
+
+
+@jax.jit
+def stamped_step(x):
+    t0 = time.monotonic()                    # EXPECT TT601
+    y = x * 2
+    return y, t0
+
+
+@jax.jit
+def perf_counter_step(x):
+    start = perf_counter()                   # EXPECT TT601
+    return x + 1, start
+
+
+def scan_body_clock(carry, x):
+    now = time.time()                        # EXPECT TT601
+    return carry + x, now
+
+
+def run_scan(xs):
+    c, _ = lax.scan(scan_body_clock, jnp.zeros(()), xs)
+    return c
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def span_inside_jit(x, n):
+    tracer.record("step", 0.0, 0.1)          # EXPECT TT601
+    with tracer.span("block"):               # EXPECT TT601
+        y = x * n
+    return y
+
+
+def vmapped_with_null_tracer(x):
+    NULL_TRACER.record("lane", 0.0, 0.0)     # EXPECT TT601
+    return x + 1
+
+
+def run_vmap(xs):
+    return jax.vmap(vmapped_with_null_tracer)(xs)
+
+
+def host_side_is_fine(x):
+    # OK: not a trace target — host code times itself freely
+    t0 = time.monotonic()
+    with tracer.span("host"):
+        y = jnp.sum(x)
+    return y, time.monotonic() - t0
+
+
+@jax.jit
+def data_not_clocks(x):
+    # OK: shipping DATA the host will timestamp is the designed pattern
+    best = jnp.min(x)
+    return best

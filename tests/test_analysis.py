@@ -65,6 +65,7 @@ def analyze_fixture(fixture: str):
     "viol_loopkey.py",     # TT402 loop-carried key reuse
     "viol_api.py",         # TT501 pinned API surface
     "viol_attr_api.py",    # TT502 attribute-access API pinning
+    "viol_obs_clock.py",   # TT601 wall clocks / spans in trace targets
 ])
 def test_rule_fires_at_expected_lines(fixture):
     """Each rule family fires exactly at the marked (rule, line) pairs —

@@ -1,0 +1,601 @@
+"""tt-obs tests (timetabling_ga_tpu/obs + --trace-mode).
+
+Four layers:
+
+  unit        metrics registry (counter/gauge/histogram/Prometheus),
+              SpanTracer, the spanEntry/metricsEntry record emitters,
+              strip_timing over the new record types, and the on-device
+              trace compression vs a host recomputation
+  engine A/B  --trace-mode full|deltas|stats x pipeline x --obs must
+              emit IDENTICAL protocol records modulo timing (the
+              acceptance criterion: telemetry reduction changes WHAT is
+              fetched, never what is emitted) — including through a
+              checkpointed pipelined run and a fault recovery
+  serve A/B   the same contract for the lane scheduler, plus the
+              `stats` line-JSON command and Prometheus exposition
+  CLI         `tt trace` emits well-formed Chrome trace-event JSON;
+              `tt stats` summarizes a log without jq
+"""
+
+import io
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from timetabling_ga_tpu.obs import metrics as obs_metrics
+from timetabling_ga_tpu.obs.logstats import summarize
+from timetabling_ga_tpu.obs.metrics import MetricsRegistry
+from timetabling_ga_tpu.obs.spans import NULL_TRACER, SpanTracer
+from timetabling_ga_tpu.obs.trace_export import (
+    export_chrome_trace, read_jsonl)
+from timetabling_ga_tpu.runtime import jsonl
+from timetabling_ga_tpu.runtime.config import RunConfig, ServeConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TIM = os.path.join(REPO, "fixtures", "comp01s.tim")
+
+
+# ---------------------------------------------------------------- metrics
+
+
+def test_counter_monotone_and_negative_inc_raises():
+    reg = MetricsRegistry()
+    c = reg.counter("x.count")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_set_pull_and_degrade():
+    reg = MetricsRegistry()
+    g = reg.gauge("x.level")
+    g.set(4)
+    assert g.value == 4.0
+    pull = reg.gauge_fn("x.depth", lambda: 7)
+    assert pull.value == 7.0
+    # a dead pull source degrades to nan (JSON null), never raises
+    reg.gauge_fn("x.depth", lambda: 1 / 0)
+    assert math.isnan(reg.gauge("x.depth").value)
+    snap = reg.snapshot()
+    assert snap["gauges"]["x.depth"] is None
+    assert snap["gauges"]["x.level"] == 4.0
+
+
+def test_histogram_percentiles_and_summary():
+    reg = MetricsRegistry()
+    h = reg.histogram("x.lat")
+    for v in [0.002, 0.004, 0.02, 0.02, 0.3, 2.0]:
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 6
+    assert s["min"] == 0.002 and s["max"] == 2.0
+    assert 0.002 <= s["p50"] <= 0.3
+    assert s["p95"] <= 2.0
+    assert reg.histogram("x.lat") is h          # get-or-create
+
+
+def test_registry_kind_collision_raises():
+    reg = MetricsRegistry()
+    reg.counter("a.b")
+    with pytest.raises(TypeError):
+        reg.gauge("a.b")
+
+
+def test_prometheus_exposition_format():
+    reg = MetricsRegistry()
+    reg.counter("engine.gens").inc(5)
+    reg.gauge("serve.queue_depth").set(3)
+    reg.histogram("serve.job_seconds").observe(0.3)
+    text = reg.to_prometheus()
+    assert "# TYPE tt_engine_gens_total counter" in text
+    assert "tt_engine_gens_total 5" in text
+    assert "tt_serve_queue_depth 3" in text
+    assert 'tt_serve_job_seconds_bucket{le="+Inf"} 1' in text
+    assert "tt_serve_job_seconds_count 1" in text
+    assert text.endswith("\n")
+
+
+def test_registry_is_thread_safe():
+    reg = MetricsRegistry()
+    c = reg.counter("t.n")
+
+    def hammer():
+        for _ in range(1000):
+            c.inc()
+
+    ts = [threading.Thread(target=hammer) for _ in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert c.value == 4000
+
+
+# ------------------------------------------------------------------ spans
+
+
+def test_span_tracer_nesting_and_record():
+    buf = io.StringIO()
+    tracer = SpanTracer(buf)
+    with tracer.span("outer", cat="t"):
+        with tracer.span("inner", cat="t", k=1):
+            pass
+    tracer.record("measured", tracer._clock() - 0.5, 0.25, cat="d")
+    recs = [json.loads(x) for x in buf.getvalue().splitlines()]
+    spans = {r["spanEntry"]["name"]: r["spanEntry"] for r in recs}
+    assert spans["inner"]["depth"] == 1 and spans["outer"]["depth"] == 0
+    assert spans["inner"]["k"] == 1
+    assert spans["measured"]["dur"] == 0.25
+    # inner closes before outer -> emitted first
+    assert [r["spanEntry"]["name"] for r in recs] == [
+        "inner", "outer", "measured"]
+    assert spans["outer"]["dur"] >= spans["inner"]["dur"]
+
+
+def test_span_tracer_disabled_is_noop():
+    with NULL_TRACER.span("x"):
+        pass
+    NULL_TRACER.record("y", 0.0, 1.0)   # no output target, no error
+
+
+def test_span_error_is_marked_and_propagates():
+    buf = io.StringIO()
+    tracer = SpanTracer(buf)
+    with pytest.raises(RuntimeError):
+        with tracer.span("failing"):
+            raise RuntimeError("boom")
+    rec = json.loads(buf.getvalue())
+    assert rec["spanEntry"]["error"] is True
+
+
+# ------------------------------------------------- records + strip_timing
+
+
+def test_strip_timing_drops_obs_records():
+    buf = io.StringIO()
+    jsonl.log_entry(buf, 0, 0, 42, 1.5)
+    jsonl.span_entry(buf, "dispatch", "device", 1.0, 0.5, gens=10)
+    jsonl.metrics_entry(buf, {"counters": {"engine.gens": 10}}, ts=2.0)
+    jsonl.phase_record(buf, "init", 0, 0.1)
+    jsonl.fault_entry(buf, "dispatch", "recover", ValueError("x"), 0, 1,
+                      0, 1.0)
+    recs = [json.loads(x) for x in buf.getvalue().splitlines()]
+    assert {next(iter(r)) for r in recs} == {
+        "logEntry", "spanEntry", "metricsEntry", "phase", "faultEntry"}
+    stripped = jsonl.strip_timing(recs)
+    assert len(stripped) == 1
+    assert "logEntry" in stripped[0]
+    assert "time" not in stripped[0]["logEntry"]
+
+
+def test_span_and_metrics_records_are_well_formed():
+    buf = io.StringIO()
+    jsonl.span_entry(buf, "quantum", "serve", 1.23456789, 0.001, depth=2,
+                     tid=1, job="j1")
+    jsonl.metrics_entry(buf, {"gauges": {"g": 1.0}})
+    span, metrics = [json.loads(x) for x in buf.getvalue().splitlines()]
+    s = span["spanEntry"]
+    assert (s["name"], s["cat"], s["depth"], s["tid"], s["job"]) == (
+        "quantum", "serve", 2, 1, "j1")
+    assert s["ts"] == 1.234568            # 6-digit rounding
+    assert "ts" not in metrics["metricsEntry"]   # optional
+
+
+# ------------------------------------------------- trace compression unit
+
+
+def _host_improvements(tr, n):
+    SENT = 2 ** 31 - 1
+    best, out = (SENT, SENT), []
+    for g in range(n):
+        h, s = int(tr[g, 0]), int(tr[g, 1])
+        if (h, s) < best:
+            best = (h, s)
+            out.append((g, h, s))
+    return out
+
+
+def test_compress_trace_matches_host_recomputation():
+    import jax.numpy as jnp
+    from timetabling_ga_tpu.parallel import islands
+    rng = np.random.default_rng(7)
+    tr = rng.integers(0, 6, size=(4, 12, 2)).astype(np.int32)
+    for mode in ("deltas", "stats"):
+        packed = np.asarray(islands._compress_trace(
+            jnp.asarray(tr), None, mode))
+        assert packed.shape == (4, islands.trace_leaf_width(12, mode))
+        events, counts, moments = islands.trace_events(packed, mode)
+        for i in range(4):
+            want = _host_improvements(tr[i], 12)
+            assert events[i] == want
+            assert counts[i] == len(want)
+        assert (moments is not None) == (mode == "stats")
+
+
+def test_compress_trace_per_lane_valid_counts():
+    import jax.numpy as jnp
+    from timetabling_ga_tpu.parallel import islands
+    rng = np.random.default_rng(8)
+    tr = rng.integers(0, 6, size=(3, 10, 2)).astype(np.int32)
+    nv = np.array([4, 10, 0], np.int32)
+    packed = np.asarray(islands._compress_trace(
+        jnp.asarray(tr), jnp.asarray(nv), "deltas"))
+    events, counts, _ = islands.trace_events(packed, "deltas")
+    for i in range(3):
+        assert events[i] == _host_improvements(tr[i], int(nv[i]))
+    assert events[2] == [] and counts[2] == 0
+
+
+def test_compress_trace_overflow_is_counted(monkeypatch):
+    import jax.numpy as jnp
+    from timetabling_ga_tpu.parallel import islands
+    monkeypatch.setattr(islands, "TRACE_DELTAS_CAP", 3)
+    # strictly decreasing -> every generation improves (8 events, cap 3)
+    tr = np.stack([np.arange(9, 1, -1), np.zeros(8)],
+                  axis=1)[None].astype(np.int32)
+    packed = np.asarray(islands._compress_trace(
+        jnp.asarray(tr), None, "deltas"))
+    events, counts, _ = islands.trace_events(packed, "deltas")
+    assert len(events[0]) == 3           # last K kept, earliest dropped
+    assert counts[0] == 8                # the count exposes the drop
+    # the LAST improvements survive: the dispatch's final best (what
+    # best_seen and the post-feasibility switch read) is never lost
+    assert events[0] == _host_improvements(tr[0], 8)[-3:]
+
+
+def test_full_trace_decode_matches_layouts():
+    from timetabling_ga_tpu.parallel import islands
+    tr = np.arange(2 * 1 * 3 * 2).reshape(2, 1, 3, 2).astype(np.int32)
+    events, counts, moments = islands.trace_events(tr, "full")
+    assert counts is None and moments is None
+    assert events[0] == [(0, 0, 1), (1, 2, 3), (2, 4, 5)]
+
+
+def test_polish_runner_with_passes_is_trajectory_pure():
+    """The with_passes polish program (--trace-mode stats) must return
+    a bit-identical population and (penalty, hcv, scv) block — the
+    pass-count row is the ONLY difference. Pins the invariant the
+    engine-level stats A/B relies on, without the engine's
+    timing-sensitive dispatch scheduling in the loop."""
+    import jax
+    from timetabling_ga_tpu.ops import ga
+    from timetabling_ga_tpu.parallel import islands
+    from timetabling_ga_tpu.problem import load_tim_file
+    pa = load_tim_file(TIM).device_arrays()
+    mesh = islands.make_mesh(2)
+    cfg = ga.GAConfig(pop_size=8, ls_mode="sweep", ls_sweeps=1,
+                      ls_hot_k=4, ls_swap_block=4, init_sweeps=2)
+    state = islands.init_island_population(pa, jax.random.key(7), mesh, 8)
+    outs = {}
+    for wp in (False, True):
+        pol = islands.make_polish_runner(mesh, cfg, n_islands=2,
+                                         with_passes=wp)
+        st, stats = pol(pa, jax.random.key(5), state, 2)
+        outs[wp] = (jax.device_get(st), np.asarray(stats))
+    st0, s0 = outs[False]
+    st1, s1 = outs[True]
+    assert s0.shape[0] == 3 and s1.shape[0] == 4
+    assert np.array_equal(s0, s1[:3])
+    assert (s1[3] >= 1).all()            # executed >= 1 converge pass
+    for a, b in zip(st0, st1):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+# ----------------------------------------------------------- engine A/Bs
+
+
+def _engine_run(trace_mode="full", obs=False, pipeline=True,
+                checkpoint=None, faults=None, **kw):
+    from timetabling_ga_tpu.runtime import engine as eng
+    buf = io.StringIO()
+    base = dict(input=TIM, seed=3, pop_size=8, islands=2,
+                generations=30, migration_period=10, max_steps=8,
+                time_limit=300, backend="cpu", auto_tune=False,
+                trace=True, pipeline=pipeline, obs=obs,
+                trace_mode=trace_mode, metrics_every=1,
+                checkpoint=checkpoint, faults=faults)
+    base.update(kw)
+    cfg = RunConfig(**base)
+    best = eng.run(cfg, out=buf)
+    return best, [json.loads(x) for x in buf.getvalue().splitlines()]
+
+
+@pytest.fixture(scope="module")
+def engine_baseline():
+    """full-trace, obs-off, pipelined reference stream."""
+    return _engine_run()
+
+
+def test_trace_mode_stream_identical_under_pipeline(engine_baseline):
+    """THE acceptance criterion: deltas and stats ship a reduced
+    telemetry leaf but the emitted record stream is identical to full
+    modulo timing, with obs enabled end-to-end under the pipelined
+    engine."""
+    b0, l0 = engine_baseline
+    for mode in ("deltas", "stats"):
+        b, l = _engine_run(trace_mode=mode, obs=True)
+        assert b == b0, mode
+        assert jsonl.strip_timing(l) == jsonl.strip_timing(l0), mode
+        assert any("spanEntry" in r for r in l)
+        assert any("metricsEntry" in r for r in l)
+
+
+def test_trace_mode_stream_identical_serial(engine_baseline):
+    b0, l0 = engine_baseline
+    b, l = _engine_run(trace_mode="deltas", obs=True, pipeline=False)
+    assert b == b0
+    assert jsonl.strip_timing(l) == jsonl.strip_timing(l0)
+
+
+def test_obs_off_emits_no_obs_records(engine_baseline):
+    _, l0 = engine_baseline
+    assert not any("spanEntry" in r or "metricsEntry" in r for r in l0)
+
+
+def test_obs_span_taxonomy_and_metrics_content(engine_baseline):
+    b0, l0 = engine_baseline
+    before = dict(obs_metrics.REGISTRY.snapshot().get("counters", {}))
+    b, l = _engine_run(trace_mode="stats", obs=True)
+    assert b == b0
+    names = {r["spanEntry"]["name"] for r in l if "spanEntry" in r}
+    assert {"init", "dispatch", "fetch", "process"} <= names, names
+    snaps = [r["metricsEntry"] for r in l if "metricsEntry" in r]
+    assert snaps
+    last = snaps[-1]
+    c = last["counters"]
+    assert (c["engine.dispatches"]
+            - before.get("engine.dispatches", 0)) >= 3
+    assert "engine.gens" in c
+    assert "writer.queue_depth" in last["gauges"]
+    # stats mode streams on-device moments into gauges
+    assert "engine.trace_best_min" in last["gauges"]
+    assert "engine.dispatch_seconds" in last["histograms"]
+
+
+def test_trace_mode_with_checkpoint_and_resume(tmp_path, engine_baseline):
+    """The checkpoint's in-flight trace fold decodes the compressed
+    leaf: a pipelined checkpointed deltas run emits the same stream and
+    lands a loadable checkpoint."""
+    b0, l0 = engine_baseline
+    ck = str(tmp_path / "obs.ck.npz")
+    b, l = _engine_run(trace_mode="deltas", obs=True, checkpoint=ck)
+    assert b == b0
+    assert jsonl.strip_timing(l) == jsonl.strip_timing(l0)
+    assert os.path.exists(ck)
+    with np.load(ck, allow_pickle=False) as z:
+        assert int(z["generation"]) == 30
+
+
+def test_trace_mode_fault_recovery_stream_identical(engine_baseline):
+    """A recovered deltas-mode run matches the uninjected full-mode
+    stream modulo timing+fault records — the recovery paths (poisoned
+    buffer teardown, snapshot rehydrate, emitted-floor replay) all
+    decode the compressed leaf."""
+    b0, l0 = engine_baseline
+    b, l = _engine_run(trace_mode="deltas", obs=True,
+                       faults="dispatch:2:unavailable")
+    assert b == b0
+    assert jsonl.strip_timing(l) == jsonl.strip_timing(l0)
+    assert any("faultEntry" in r for r in l)
+    names = {r["spanEntry"]["name"] for r in l if "spanEntry" in r}
+    assert "recover" in names
+
+
+def test_polish_pass_counts_ride_stats_mode(monkeypatch):
+    """--trace-mode stats adds the sweep-pass-count row to the polish
+    stats fetch (islands.make_polish_runner with_passes); the stream
+    stays identical to full mode and the gauge is populated.
+
+    The A/B needs BOTH runs to see the same dispatch/polish schedule
+    (the schedule feeds fold_in offsets, so it IS the trajectory):
+    precompile both configs first — engine.run alone does not, so the
+    first run would enter the init polish with a cold _SPS_CACHE and
+    chunk it 1+1 while the warm second run chunks it 2 (exactly how
+    bench.measure_obs pre-warms its A/B) — pin DISPATCH_CAP_S out of
+    range (sweep generations cost ~seconds on CPU, close enough to
+    the watchdog boundary for timing noise to flip static dispatches
+    into timing-SIZED dynamic ones), and keep the sweep cheap via
+    ls_hot_k (the trajectory-purity of with_passes itself is pinned
+    by the direct runner A/B above)."""
+    from timetabling_ga_tpu.runtime import engine as eng
+    monkeypatch.setattr(eng, "DISPATCH_CAP_S", 1e9)
+    kw = dict(ls_mode="sweep", ls_sweeps=1, init_sweeps=2,
+              ls_hot_k=4, ls_swap_block=4, generations=20)
+    base = dict(input=TIM, seed=3, pop_size=8, islands=2,
+                migration_period=10, max_steps=8, time_limit=300,
+                backend="cpu", auto_tune=False, trace=True,
+                metrics_every=1, **kw)
+    eng.precompile(RunConfig(**base))
+    eng.precompile(RunConfig(trace_mode="stats", **base))
+    b0, l0 = _engine_run(**kw)
+    b, l = _engine_run(trace_mode="stats", obs=True, **kw)
+    assert b == b0
+    assert jsonl.strip_timing(l) == jsonl.strip_timing(l0)
+    snaps = [r["metricsEntry"] for r in l if "metricsEntry" in r]
+    assert snaps and snaps[-1]["gauges"].get("engine.polish_passes", 0) >= 1
+
+
+def test_run_counters_backcompat_dict():
+    from timetabling_ga_tpu.runtime import engine as eng
+    c = eng.run_counters()
+    assert set(c) == {"recoveries", "faults_injected"}
+    assert isinstance(c["recoveries"], int)
+    assert c["recoveries"] == int(
+        obs_metrics.REGISTRY.counter("engine.recoveries").value)
+
+
+# ------------------------------------------------------------ serve A/Bs
+
+
+def _serve_run(trace_mode="full", obs=False, requests=None):
+    from timetabling_ga_tpu.serve.service import serve_stream
+    cfg = ServeConfig(backend="cpu", lanes=2, quantum=10, pop_size=8,
+                      generations=20, obs=obs, trace_mode=trace_mode,
+                      metrics_every=1)
+    reqs = requests or [
+        {"submit": {"id": "a", "instance": TIM, "seed": 1}},
+        {"submit": {"id": "b", "instance": TIM, "seed": 2}},
+    ]
+    inp = io.StringIO("\n".join(json.dumps(r) for r in reqs) + "\n")
+    out = io.StringIO()
+    svc = serve_stream(cfg, inp, out)
+    return svc, [json.loads(x) for x in out.getvalue().splitlines()]
+
+
+@pytest.fixture(scope="module")
+def serve_baseline():
+    return _serve_run()
+
+
+def test_serve_trace_modes_stream_identical(serve_baseline):
+    _, l0 = serve_baseline
+    for mode in ("deltas", "stats"):
+        svc, l = _serve_run(trace_mode=mode, obs=True)
+        assert jsonl.strip_timing(l) == jsonl.strip_timing(l0), mode
+        names = {r["spanEntry"]["name"] for r in l if "spanEntry" in r}
+        assert {"admit", "pack", "quantum", "park", "resume",
+                "init"} <= names, names
+
+
+def test_serve_stats_command_and_prometheus(serve_baseline):
+    _, l0 = serve_baseline
+    reqs = [
+        {"submit": {"id": "a", "instance": TIM, "seed": 1}},
+        {"submit": {"id": "b", "instance": TIM, "seed": 2}},
+        {"drain": True},
+        {"stats": True},
+        {"stats": "prometheus"},
+    ]
+    svc, l = _serve_run(obs=True, requests=reqs)
+    snaps = [r["metricsEntry"] for r in l if "metricsEntry" in r]
+    assert len(snaps) >= 2
+    plain, prom = snaps[-2], snaps[-1]
+    assert "prometheus" not in plain
+    assert "tt_serve_dispatches_total" in prom["prometheus"]
+    assert "tt_serve_job_seconds_bucket" in prom["prometheus"]
+    assert prom["counters"]["serve.jobs_done"] >= 2
+    # the protocol records are unaffected by the stats traffic
+    assert jsonl.strip_timing(l) == jsonl.strip_timing(
+        serve_baseline[1])
+    # live Python API mirrors the stream
+    assert "serve.job_seconds" in svc.stats().get("histograms", {})
+
+
+# -------------------------------------------------------------------- CLI
+
+
+@pytest.fixture(scope="module")
+def obs_log(tmp_path_factory):
+    """One obs-enabled engine run's JSONL log on disk."""
+    _, recs = _engine_run(trace_mode="stats", obs=True)
+    p = tmp_path_factory.mktemp("obs") / "run.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    return str(p)
+
+
+def test_tt_trace_emits_wellformed_chrome_trace(obs_log, tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = str(tmp_path / "trace.json")
+    r = subprocess.run(
+        [sys.executable, "-m", "timetabling_ga_tpu", "trace", obs_log,
+         "-o", out],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240)
+    assert r.returncode == 0, r.stderr
+    with open(out) as fh:
+        doc = json.load(fh)           # well-formed JSON or this raises
+    events = doc["traceEvents"]
+    assert events, "no trace events exported"
+    for ev in events:
+        assert ev["ph"] in ("X", "C")
+        assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        assert "name" in ev and "pid" in ev and "tid" in ev
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+    phs = {ev["ph"] for ev in events}
+    assert phs == {"X", "C"}          # spans+phases AND counter tracks
+    names = {ev["name"] for ev in events if ev["ph"] == "X"}
+    assert "dispatch" in names
+
+
+def test_export_tolerates_torn_tail_line(tmp_path):
+    p = tmp_path / "torn.jsonl"
+    p.write_text('{"spanEntry": {"name": "a", "cat": "t", "ts": 0.0, '
+                 '"dur": 1.0, "depth": 0, "tid": 0}}\n{"spanEnt')
+    recs = read_jsonl(str(p))
+    assert len(recs) == 1
+    assert export_chrome_trace(recs)["traceEvents"]
+
+
+def test_tt_stats_summarizes_log(obs_log):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "timetabling_ga_tpu", "stats", obs_log],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=240)
+    assert r.returncode == 0, r.stderr
+    assert "best-so-far" in r.stdout
+    assert "last metrics snapshot" in r.stdout
+    assert "faults: none" in r.stdout
+
+
+def test_stats_summarize_jobs_and_faults():
+    recs = [
+        {"logEntry": {"procID": 0, "best": 9, "time": 0.5, "job": "j1"}},
+        {"logEntry": {"procID": 0, "best": 2, "time": 1.0, "job": "j1"}},
+        {"solution": {"procID": 0, "totalBest": 2, "feasible": True,
+                      "totalTime": 1.5, "job": "j1"}},
+        {"jobEntry": {"job": "j1", "event": "admitted"}},
+        {"jobEntry": {"job": "j1", "event": "done", "best": 2,
+                      "gens": 20}},
+        {"faultEntry": {"site": "dispatch", "action": "recover",
+                        "level": 1, "error": "UNAVAILABLE"}},
+    ]
+    text = summarize(recs)
+    assert "job j1" in text
+    assert "dispatch/recover" in text
+    assert "latency p50" in text
+
+
+def test_tt_trace_and_stats_work_without_jax(obs_log, tmp_path):
+    """The offline obs surfaces must run on a machine with no
+    accelerator stack: the package __init__ is PEP 562-lazy and cli.py
+    defers every runtime import past the trace/stats dispatch, so a
+    blocked `import jax` never fires."""
+    out = str(tmp_path / "trace.json")
+    blocker = (
+        "import sys\n"
+        "class _NoJax:\n"
+        "    def find_spec(self, name, path=None, target=None):\n"
+        "        if name == 'jax' or name.startswith('jax.'):\n"
+        "            raise ImportError('BLOCKED import of jax')\n"
+        "sys.meta_path.insert(0, _NoJax())\n"
+        "from timetabling_ga_tpu.cli import main\n"
+        "assert main(['trace', %r, '-o', %r]) == 0\n"
+        "assert main(['stats', %r]) == 0\n" % (obs_log, out, obs_log))
+    r = subprocess.run([sys.executable, "-c", blocker],
+                       capture_output=True, text=True, cwd=REPO,
+                       timeout=240)
+    assert r.returncode == 0, r.stderr
+    with open(out) as fh:
+        assert json.load(fh)["traceEvents"]
+
+
+def test_gauge_bind_none_freezes_and_releases():
+    reg = MetricsRegistry()
+    g = reg.gauge_fn("w.depth", lambda: 5)
+    assert g.value == 5.0
+    g.set(2.0)
+    g.bind(None)                      # unbind: engine.run's finally
+    assert g.value == 2.0             # frozen at the set() value
+
+
+def test_engine_run_unbinds_writer_gauges(engine_baseline):
+    """engine.run must not leave the process-global registry holding a
+    closure over the finished run's writer (and its output stream)."""
+    for name in ("writer.records", "writer.queue_depth"):
+        assert obs_metrics.REGISTRY.gauge(name)._fn is None, name

@@ -19,22 +19,53 @@ reference MPI+OpenMP C++ solver (nelilepo/timetabling-ga-mpi-openmp):
   ``pmin`` (reference: MPI_Sendrecv ring + MPI_Allreduce, ga.cpp:479-541).
 """
 
-from timetabling_ga_tpu.problem import (
-    Problem, dump_tim, load_tim, load_tim_file)
-from timetabling_ga_tpu.ops.fitness import (
-    compute_hcv,
-    compute_scv,
-    compute_penalty,
-    batch_penalty,
-)
-from timetabling_ga_tpu.ops.ga import GAConfig, PopState, init_population
-from timetabling_ga_tpu.ops.rooms import (
-    assign_rooms, batch_assign_rooms, batch_parallel_assign_rooms)
-from timetabling_ga_tpu.ops.local_search import batch_local_search
-from timetabling_ga_tpu.ops.sweep import sweep_local_search
-from timetabling_ga_tpu.ops.lahc import init_lahc, lahc_steps
-from timetabling_ga_tpu.parallel import (
-    make_mesh, init_island_population, make_island_runner)
-from timetabling_ga_tpu.runtime import RunConfig, parse_args, run
+# The public API is lazy (PEP 562): importing the package must NOT pull
+# in jax, so the device-free surfaces — `tt trace` / `tt stats`
+# (obs/trace_export.py, obs/logstats.py) and `python -m
+# timetabling_ga_tpu.cli -h` — work on a machine with no accelerator
+# stack at all (the log may have been copied anywhere). `import
+# timetabling_ga_tpu as tt; tt.load_tim(...)` resolves on first touch
+# exactly as before.
+_EXPORTS = {
+    "Problem": "timetabling_ga_tpu.problem",
+    "dump_tim": "timetabling_ga_tpu.problem",
+    "load_tim": "timetabling_ga_tpu.problem",
+    "load_tim_file": "timetabling_ga_tpu.problem",
+    "compute_hcv": "timetabling_ga_tpu.ops.fitness",
+    "compute_scv": "timetabling_ga_tpu.ops.fitness",
+    "compute_penalty": "timetabling_ga_tpu.ops.fitness",
+    "batch_penalty": "timetabling_ga_tpu.ops.fitness",
+    "GAConfig": "timetabling_ga_tpu.ops.ga",
+    "PopState": "timetabling_ga_tpu.ops.ga",
+    "init_population": "timetabling_ga_tpu.ops.ga",
+    "assign_rooms": "timetabling_ga_tpu.ops.rooms",
+    "batch_assign_rooms": "timetabling_ga_tpu.ops.rooms",
+    "batch_parallel_assign_rooms": "timetabling_ga_tpu.ops.rooms",
+    "batch_local_search": "timetabling_ga_tpu.ops.local_search",
+    "sweep_local_search": "timetabling_ga_tpu.ops.sweep",
+    "init_lahc": "timetabling_ga_tpu.ops.lahc",
+    "lahc_steps": "timetabling_ga_tpu.ops.lahc",
+    "make_mesh": "timetabling_ga_tpu.parallel",
+    "init_island_population": "timetabling_ga_tpu.parallel",
+    "make_island_runner": "timetabling_ga_tpu.parallel",
+    "RunConfig": "timetabling_ga_tpu.runtime",
+    "parse_args": "timetabling_ga_tpu.runtime",
+    "run": "timetabling_ga_tpu.runtime",
+}
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    obj = getattr(importlib.import_module(mod), name)
+    globals()[name] = obj      # cache: subsequent access skips this hook
+    return obj
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

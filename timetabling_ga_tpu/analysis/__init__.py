@@ -23,6 +23,10 @@ Rules (see README "Static analysis & sanitizers"):
   TT502  jax.* ATTRIBUTE access outside the pinned table — the
          `jax.profiler.*` / `jax.distributed.*` uses TT501's import
          scanner cannot see
+  TT601  wall-clock reads (time.time/monotonic/perf_counter) and span
+         tracer calls inside trace targets — they execute at TRACE
+         time and bake the compile's clock into the program; timing is
+         host-side by design (tt-obs, README "Observability")
 
 Suppress one finding inline with `# tt-analyze: ignore[TT301]` (on the
 line, or on a comment line directly above). Configure via
@@ -58,8 +62,8 @@ class _Context:
 
 def _rule_modules():
     from timetabling_ga_tpu.analysis import (
-        rules_api, rules_donate, rules_recompile, rules_rng, rules_sync,
-        rules_trace)
+        rules_api, rules_donate, rules_obs, rules_recompile, rules_rng,
+        rules_sync, rules_trace)
     return {
         "TT101": rules_trace,
         "TT102": rules_trace,
@@ -72,6 +76,7 @@ def _rule_modules():
         "TT402": rules_rng,
         "TT501": rules_api,
         "TT502": rules_api,
+        "TT601": rules_obs,
     }
 
 

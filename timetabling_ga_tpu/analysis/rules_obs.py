@@ -1,0 +1,92 @@
+"""TT601 — wall-clock reads and span emission inside trace targets.
+
+A `time.time()` / `time.monotonic()` / `time.perf_counter()` call (or a
+span tracer's `span()` / `record()` — obs/spans.py) inside a function
+that jit / vmap / shard_map / lax control flow traces executes at TRACE
+time, not at run time: the clock value is read once while XLA builds
+the program and baked into it as a constant, so every later dispatch
+reports the COMPILE's wall clock — telemetry that looks alive and is
+wrong forever after. The tt-obs design rule is that all timing is
+host-side (runtime/engine.py brackets its dispatches from the host;
+spans ride the AsyncWriter); on-device observability ships *data* the
+host timestamps (`--trace-mode` improvement events, streamed moments),
+never clock reads.
+
+The rule reuses TT101's trace-target collection: any function handed to
+a tracing callee (decorator or call argument) is scanned, including its
+nested lambdas/defs (anything lexically inside traced code is traced
+with it).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from timetabling_ga_tpu.analysis.core import Finding, qual_matches, qualname
+from timetabling_ga_tpu.analysis.rules_trace import _collect_targets
+
+RULE = "TT601"
+
+# dotted clock callees (tail-matched, so `time.monotonic` also catches
+# an aliased `t.monotonic` import form) plus the bare from-imports.
+# `time` alone is deliberately absent: a bare `time()` cannot be told
+# from a local named `time`, and the dotted form covers real usage.
+_CLOCK_CALLEES = {
+    "time.time", "time.time_ns",
+    "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "time.process_time", "time.process_time_ns",
+    "time.thread_time", "time.thread_time_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "monotonic", "monotonic_ns", "perf_counter", "perf_counter_ns",
+    "process_time", "process_time_ns",
+}
+
+# span-tracer entry points: `<receiver>.span(...)` / `.record(...)`
+# where the receiver is tracer-shaped (`tracer`, `self.tracer`,
+# `self._tracer`, `NULL_TRACER`, a SpanTracer(...) literal)
+_SPAN_METHODS = {"span", "record"}
+_TRACER_RECV = re.compile(r"(^|\.)_?(tracer|null_tracer|span_tracer)$",
+                          re.IGNORECASE)
+
+
+def _is_span_call(call: ast.Call) -> bool:
+    fn = call.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr in _SPAN_METHODS):
+        return False
+    recv = fn.value
+    if isinstance(recv, ast.Call):          # SpanTracer(...).span(...)
+        return qual_matches(qualname(recv.func),
+                            {"SpanTracer", "spans.SpanTracer"})
+    qn = qualname(recv)
+    return qn is not None and bool(_TRACER_RECV.search(qn))
+
+
+def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
+    findings: list[Finding] = []
+    for fn in _collect_targets(tree):
+        name = getattr(fn, "name", "<lambda>")
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            qn = qualname(node.func)
+            if qual_matches(qn, _CLOCK_CALLEES):
+                findings.append(Finding(
+                    RULE, path, node.lineno, node.col_offset,
+                    f"wall-clock read `{qn}` inside jit/vmap/shard_map "
+                    f"target `{name}` — executes at TRACE time and "
+                    f"bakes the compile's clock into the program; time "
+                    f"on the host (engine/scheduler brackets) and ship "
+                    f"data, not clock reads (README \"Observability\")"))
+            elif _is_span_call(node):
+                findings.append(Finding(
+                    RULE, path, node.lineno, node.col_offset,
+                    f"span tracer call "
+                    f"`{qualname(node.func) or 'tracer.span'}` inside "
+                    f"jit/vmap/shard_map target `{name}` — spans are "
+                    f"host-side telemetry (obs/spans.py); a span "
+                    f"entered under tracing measures the COMPILE, "
+                    f"emits at trace time only, and its writer I/O is "
+                    f"a side effect XLA may drop"))
+    return findings

@@ -14,14 +14,21 @@ job-tagged JSONL records out:
 
     python -m timetabling_ga_tpu.cli serve --lanes 4 --quantum 25 \
         -i requests.jsonl -o records.jsonl
+
+`trace` / `stats` subcommands — offline observability (README
+"Observability"; timetabling_ga_tpu/obs). Device-free: they read a
+JSONL record stream, never a device.
+
+    python -m timetabling_ga_tpu.cli trace run.jsonl -o trace.json
+        export spanEntry/phase/metricsEntry records as Chrome
+        trace-event JSON (Perfetto / chrome://tracing)
+    python -m timetabling_ga_tpu.cli stats run.jsonl
+        summarize: best-so-far curves, recoveries, per-job latency
 """
 
 from __future__ import annotations
 
 import sys
-
-from timetabling_ga_tpu.runtime import parse_args
-from timetabling_ga_tpu.runtime.engine import precompile, run
 
 
 def main(argv=None) -> int:
@@ -31,7 +38,21 @@ def main(argv=None) -> int:
         # subsystem's import, and vice versa
         from timetabling_ga_tpu.serve.service import main_serve
         return main_serve(argv[1:])
+    if argv and argv[0] == "trace":
+        # deferred + jax-free: log exporting must work on any machine
+        # the log was copied to (obs/trace_export.py docstring)
+        from timetabling_ga_tpu.obs.trace_export import main_trace
+        return main_trace(argv[1:])
+    if argv and argv[0] == "stats":
+        from timetabling_ga_tpu.obs.logstats import main_stats
+        return main_stats(argv[1:])
+    # runtime imports deferred past the subcommand dispatch (and the
+    # package __init__ is PEP 562-lazy): `tt trace`/`tt stats` must
+    # work without importing jax (the log may be on a machine with no
+    # accelerator stack at all)
+    from timetabling_ga_tpu.runtime import parse_args
     cfg = parse_args(argv)
+    from timetabling_ga_tpu.runtime.engine import precompile, run
     # compile-then-run, like the reference binary (mpicxx compiles
     # before anyone races it): XLA compilation happens BEFORE the per-
     # try clock starts, so -t bounds solve time, not compile time — a

@@ -1,0 +1,32 @@
+"""tt-obs — span tracing, the unified metrics registry, and streaming
+telemetry glue (README "Observability").
+
+Three layers:
+
+  obs.spans     SpanTracer — nestable host-side timing spans, emitted
+                as `spanEntry` JSONL records through the run's
+                AsyncWriter; `tt trace` exports them as Chrome
+                trace-event JSON (obs.trace_export)
+  obs.metrics   MetricsRegistry (counters / gauges / histograms) — ONE
+                namespace for the engine, the serve scheduler and the
+                writer; snapshotted as `metricsEntry` records, served
+                live by `tt serve`'s `stats` command, and exported as
+                Prometheus text exposition
+  obs.logstats  `tt stats` — offline summarizer for any record stream
+
+The device-side half of the story — `--trace-mode full|deltas|stats`,
+which shrinks the per-generation telemetry leaf the engine fetches —
+lives with the island programs (parallel/islands.py) and the engine
+(runtime/engine.py); this package is the host side.
+
+Stdlib-only: every module here imports without JAX or a device (the
+CLI subcommands and the analyzer depend on that).
+"""
+
+from timetabling_ga_tpu.obs.metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, REGISTRY)
+from timetabling_ga_tpu.obs.spans import (  # noqa: F401
+    NULL_TRACER, SpanTracer)
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "REGISTRY", "NULL_TRACER", "SpanTracer"]

@@ -1,0 +1,162 @@
+"""`tt stats` — human-readable summary of a JSONL record stream.
+
+    tt stats run.jsonl
+
+Answers the questions people were answering with jq one-liners: what
+did each island/job converge to and how fast (best-so-far curve,
+time-to-feasible), did the run recover from faults (sites, actions,
+degradation levels), how long did serve jobs take (per-job latency from
+their solution records), and what did the last metrics snapshot say.
+
+Stdlib-only and device-free, like the trace exporter.
+"""
+
+from __future__ import annotations
+
+import json
+
+from timetabling_ga_tpu.obs.trace_export import read_jsonl
+
+FEASIBLE_LIMIT = 1_000_000
+
+
+def _key(proc_id, job):
+    return f"job {job}" if job is not None else f"island {proc_id}"
+
+
+def summarize(records) -> str:
+    """The `tt stats` report text for a list of record dicts."""
+    curves: dict = {}       # stream key -> list of (best, time)
+    solutions: dict = {}    # stream key -> solution record
+    runs = []
+    faults: list = []
+    jobs: dict = {}         # job id -> lifecycle events
+    counts: dict = {}
+    last_metrics = None
+    for rec in records:
+        kind = next(iter(rec), None)
+        counts[kind] = counts.get(kind, 0) + 1
+        body = rec.get(kind)
+        if kind == "logEntry":
+            k = _key(body.get("procID"), body.get("job"))
+            curves.setdefault(k, []).append(
+                (body.get("best"), body.get("time", 0.0)))
+        elif kind == "solution":
+            solutions[_key(body.get("procID"), body.get("job"))] = body
+        elif kind == "runEntry":
+            runs.append(body)
+        elif kind == "faultEntry":
+            faults.append(body)
+        elif kind == "jobEntry":
+            jobs.setdefault(body.get("job"), []).append(body)
+        elif kind == "metricsEntry":
+            last_metrics = body
+
+    lines = ["== record stream"]
+    lines.append("  " + "  ".join(f"{k}:{v}" for k, v in
+                                  sorted(counts.items())))
+
+    if curves or solutions:
+        lines.append("== best-so-far")
+        for k in sorted(set(curves) | set(solutions)):
+            pts = curves.get(k, [])
+            sol = solutions.get(k)
+            parts = [f"  {k}:"]
+            if pts:
+                first_b, first_t = pts[0]
+                last_b, last_t = pts[-1]
+                parts.append(f"{first_b} @ {first_t:.1f}s -> "
+                             f"{last_b} @ {last_t:.1f}s "
+                             f"({len(pts)} improvements)")
+                feas = next((t for b, t in pts if b < FEASIBLE_LIMIT),
+                            None)
+                if feas is not None:
+                    parts.append(f"feasible @ {feas:.1f}s")
+            if sol is not None:
+                feas_s = ("feasible" if sol.get("feasible")
+                          else "INFEASIBLE")
+                parts.append(f"final {sol.get('totalBest')} ({feas_s}, "
+                             f"{sol.get('totalTime', 0.0):.1f}s)")
+            lines.append(" ".join(parts))
+
+    if runs:
+        final = runs[-1]
+        lines.append(f"== run: totalBest {final.get('totalBest')} "
+                     f"feasible={final.get('feasible')}"
+                     + (f" totalTime {final['totalTime']:.1f}s"
+                        if "totalTime" in final else ""))
+
+    if faults:
+        lines.append(f"== faults ({len(faults)} records)")
+        by_site: dict = {}
+        for f in faults:
+            by_site.setdefault((f.get("site"), f.get("action")), []
+                               ).append(f)
+        for (site, action), fs in sorted(by_site.items()):
+            worst = max(f.get("level", 0) for f in fs)
+            lines.append(f"  {site}/{action}: {len(fs)}x "
+                         f"(max level {worst}); last: "
+                         f"{str(fs[-1].get('error', ''))[:80]}")
+    else:
+        lines.append("== faults: none")
+
+    if jobs:
+        lines.append(f"== jobs ({len(jobs)})")
+        lats = []
+        for jid, evs in sorted(jobs.items()):
+            events = [e.get("event") for e in evs]
+            sol = solutions.get(f"job {jid}")
+            lat = sol.get("totalTime") if sol else None
+            if lat is not None:
+                lats.append(lat)
+            done = next((e for e in evs if e.get("event") == "done"),
+                        None)
+            lines.append(
+                f"  {jid}: {'->'.join(events)}"
+                + (f" best {done.get('best')} gens {done.get('gens')}"
+                   if done else "")
+                + (f" latency {lat:.2f}s" if lat is not None else ""))
+        if lats:
+            lats.sort()
+            p = (lambda q: lats[min(len(lats) - 1,
+                                    int(q * len(lats)))])
+            lines.append(f"  latency p50 {p(0.5):.2f}s "
+                         f"p95 {p(0.95):.2f}s max {lats[-1]:.2f}s")
+
+    if last_metrics is not None:
+        lines.append("== last metrics snapshot")
+        for kind in ("counters", "gauges"):
+            for name, v in sorted((last_metrics.get(kind) or {}).items()):
+                lines.append(f"  {name}: {v}")
+        for name, h in sorted((last_metrics.get("histograms")
+                               or {}).items()):
+            if h.get("count"):
+                lines.append(f"  {name}: n={h['count']} "
+                             f"p50={h.get('p50')} p95={h.get('p95')} "
+                             f"max={h.get('max')}")
+    return "\n".join(lines)
+
+
+def main_stats(argv) -> int:
+    """`tt stats <log.jsonl>` entry point."""
+    inp = None
+    for a in argv:
+        if a in ("-h", "--help"):
+            print("usage: tt stats <log.jsonl>\n\n"
+                  "summarize a JSONL record stream: best-so-far curves, "
+                  "time-to-feasible, recoveries and fault sites, per-job "
+                  "latency, last metrics snapshot")
+            return 0
+        if inp is None:
+            inp = a
+        else:
+            raise SystemExit(f"unknown argument: {a}")
+    if inp is None:
+        raise SystemExit("usage: tt stats <log.jsonl>")
+    print(summarize(read_jsonl(inp)))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main_stats(sys.argv[1:]))

@@ -1,0 +1,137 @@
+"""tt-obs span tracer: nestable host-side timing spans.
+
+A span is one bracketed interval of HOST time — a dispatch enqueue-to-
+fence, a control fetch, a checkpoint, a serve quantum. Spans ride the
+run's existing `jsonl.AsyncWriter` as `spanEntry` records, so the
+control-vs-telemetry fence rule (runtime/engine.py module docstring) is
+preserved by construction: emitting a span costs one bounded-queue
+enqueue on the dispatch path, and the serialization happens on the
+writer thread. `tt trace <log.jsonl>` exports the records as Chrome
+trace-event JSON loadable in Perfetto (obs/trace_export.py), next to
+any `--trace-profile` device timeline.
+
+Two emission shapes:
+
+  with tracer.span("checkpoint", cat="engine", gens=n):   # bracketed
+      ...
+  tracer.record("dispatch", t0, dur, cat="device", ...)   # measured
+                                                          # elsewhere
+
+`record` exists because the engine's dispatch bracket is measured by
+the pipeline's OWN clocks (td0/fence times that also feed the budget
+predictor) — re-timing it would drift from the numbers the engine
+acts on. `t0` is a raw `time.monotonic()` value; the tracer converts
+to its epoch-relative timeline.
+
+Clock discipline: all timestamps are `time.monotonic()` offsets from
+the tracer's construction epoch — monotone, NTP-immune, and cheap.
+Spans are HOST-side only: a wall-clock read inside a jitted function
+executes at trace time and stamps compile time into the program
+(tt-analyze rule TT601 bans exactly that).
+
+Disabled tracers (the default) are pure no-ops: `span()` yields through
+a reusable null context and `record` returns immediately — the hot
+path pays one attribute read. Nesting depth is tracked per thread, so
+serve-loop spans and engine spans never interleave their stacks.
+
+Stdlib-only: the CLI trace exporter imports this module without JAX.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+
+class SpanTracer:
+    """Emits spanEntry records onto a (writer-wrapped) stream.
+
+    `out` is anything the jsonl emitters accept — normally the run's
+    AsyncWriter, so span serialization rides the telemetry thread.
+    `enabled=False` (or out=None) makes every call a no-op."""
+
+    def __init__(self, out=None, enabled: bool = True,
+                 clock=time.monotonic):
+        self.enabled = bool(enabled) and out is not None
+        self._out = out
+        self._clock = clock
+        self._epoch = clock()
+        self._local = threading.local()
+        self._tids: dict[int, int] = {}
+        self._tid_lock = threading.Lock()
+
+    # -- clocks ---------------------------------------------------------
+
+    def now(self) -> float:
+        """Seconds since the tracer epoch (the spanEntry `ts` domain)."""
+        return self._clock() - self._epoch
+
+    def _tid(self) -> int:
+        """Small stable per-thread id (0 = first thread seen, normally
+        the main loop) — the Chrome trace `tid` lane."""
+        ident = threading.get_ident()
+        t = self._tids.get(ident)
+        if t is None:
+            with self._tid_lock:
+                t = self._tids.setdefault(ident, len(self._tids))
+        return t
+
+    def _depth_stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    # -- emission -------------------------------------------------------
+
+    def _emit(self, name: str, cat: str, ts: float, dur: float,
+              depth: int, **attrs) -> None:
+        # local import: obs must stay importable without the runtime
+        # package half-initialized (jsonl imports faults only — cheap)
+        from timetabling_ga_tpu.runtime import jsonl
+        jsonl.span_entry(self._out, name, cat, ts, dur, depth,
+                         self._tid(), **attrs)
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "engine", **attrs):
+        """Bracketed span; nests (depth = enclosing spans on this
+        thread). Exceptions propagate after the span is emitted with
+        `error=True`, so a failed phase is visible in the timeline."""
+        if not self.enabled:
+            yield self
+            return
+        stack = self._depth_stack()
+        depth = len(stack)
+        stack.append(name)
+        t0 = self._clock()
+        try:
+            yield self
+        except BaseException:
+            attrs = dict(attrs, error=True)
+            raise
+        finally:
+            stack.pop()
+            t1 = self._clock()
+            try:
+                self._emit(name, cat, t0 - self._epoch, t1 - t0, depth,
+                           **attrs)
+            except Exception:
+                # a dying writer must not mask the body's own outcome;
+                # its error re-raises at the next direct write anyway
+                pass
+
+    def record(self, name: str, start_monotonic: float, dur: float,
+               cat: str = "engine", **attrs) -> None:
+        """Emit a span measured by the caller's own monotonic clocks
+        (`start_monotonic` = a raw time.monotonic() reading)."""
+        if not self.enabled:
+            return
+        self._emit(name, cat, start_monotonic - self._epoch,
+                   max(0.0, dur), len(self._depth_stack()), **attrs)
+
+
+# Shared disabled tracer: callers that may or may not have obs wired
+# (e.g. _polish_chunks' default argument) use this instead of None-
+# checking at every site.
+NULL_TRACER = SpanTracer(out=None, enabled=False)

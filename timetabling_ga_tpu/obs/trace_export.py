@@ -1,0 +1,128 @@
+"""`tt trace` — export a JSONL log's spans as Chrome trace-event JSON.
+
+    tt trace run.jsonl -o trace.json
+
+The output is the Trace Event Format's "JSON object" flavor
+({"traceEvents": [...]}) loadable in Perfetto / chrome://tracing, so a
+run's host-side span timeline (dispatch / fetch / process / checkpoint
+/ serve quanta) can be read next to a `--trace-profile` device
+timeline. Mapping:
+
+  spanEntry    -> complete event (ph "X"): ts/dur in microseconds,
+                  tid = the tracer's per-thread lane, args = every
+                  extra attribute the span carried
+  phase        -> complete event on its own lane ("phases"): the legacy
+                  `--trace` records have no start timestamp, so they
+                  are laid end-to-end in record order — coarse, but it
+                  puts pre-obs logs on the same screen
+  metricsEntry -> counter events (ph "C") for every numeric counter/
+                  gauge, at the snapshot's `ts` — Perfetto renders
+                  them as tracks (gens/sec, queue depth over time)
+
+Stdlib-only and device-free: exporting a log must work on any machine
+the log was copied to.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _span_event(e: dict) -> dict:
+    args = {k: v for k, v in e.items()
+            if k not in ("name", "cat", "ts", "dur", "depth", "tid")}
+    args["depth"] = e.get("depth", 0)
+    return {"name": e.get("name", "?"), "cat": e.get("cat", "engine"),
+            "ph": "X", "pid": 0, "tid": int(e.get("tid", 0)),
+            "ts": round(float(e.get("ts", 0.0)) * 1e6, 3),
+            "dur": round(max(0.0, float(e.get("dur", 0.0))) * 1e6, 3),
+            "args": args}
+
+
+def _counter_events(rec: dict) -> list[dict]:
+    ts = rec.get("ts")
+    if ts is None:
+        return []
+    out = []
+    for kind in ("counters", "gauges"):
+        for name, v in (rec.get(kind) or {}).items():
+            if isinstance(v, (int, float)) and v == v:
+                out.append({"name": name, "ph": "C", "pid": 0, "tid": 0,
+                            "ts": round(float(ts) * 1e6, 3),
+                            "args": {"value": v}})
+    return out
+
+
+def export_chrome_trace(records) -> dict:
+    """JSONL record dicts -> Chrome trace-event JSON object."""
+    events: list[dict] = []
+    phase_t = 0.0
+    for rec in records:
+        if "spanEntry" in rec:
+            events.append(_span_event(rec["spanEntry"]))
+        elif "metricsEntry" in rec:
+            events.extend(_counter_events(rec["metricsEntry"]))
+        elif "phase" in rec:
+            p = rec["phase"]
+            dur = max(0.0, float(p.get("seconds", 0.0)))
+            args = {k: v for k, v in p.items()
+                    if k not in ("name", "seconds")}
+            events.append({"name": p.get("name", "?"), "cat": "phase",
+                           "ph": "X", "pid": 0, "tid": 999,
+                           "ts": round(phase_t * 1e6, 3),
+                           "dur": round(dur * 1e6, 3), "args": args})
+            phase_t += dur
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"source": "tt trace",
+                          "format": "timetabling_ga_tpu JSONL"}}
+
+
+def read_jsonl(path: str) -> list[dict]:
+    records = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                # a torn tail line (killed run) must not block export
+                continue
+    return records
+
+
+def main_trace(argv) -> int:
+    """`tt trace <log.jsonl> [-o trace.json]` entry point."""
+    inp, out = None, None
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("-h", "--help"):
+            print("usage: tt trace <log.jsonl> [-o trace.json]\n\n"
+                  "export spanEntry/phase/metricsEntry records as "
+                  "Chrome trace-event JSON (Perfetto / chrome://tracing)")
+            return 0
+        if a == "-o":
+            if i + 1 >= len(argv):
+                raise SystemExit("flag -o needs a value")
+            out = argv[i + 1]
+            i += 2
+            continue
+        if inp is None:
+            inp = a
+            i += 1
+            continue
+        raise SystemExit(f"unknown argument: {a}")
+    if inp is None:
+        raise SystemExit("usage: tt trace <log.jsonl> [-o trace.json]")
+    doc = export_chrome_trace(read_jsonl(inp))
+    if out is None:
+        out = inp + ".trace.json"
+    with open(out, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    n = len(doc["traceEvents"])
+    print(f"tt trace: {n} event{'s' if n != 1 else ''} -> {out}",
+          file=sys.stderr)
+    return 0

@@ -543,7 +543,8 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
 def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
                        swap_block: int = 8, converge: bool = False,
                        block_events: int = 1, sideways: float = 0.0,
-                       hot_k: int = 0, p3: float = 0.0):
+                       hot_k: int = 0, p3: float = 0.0,
+                       return_passes: bool = False):
     """Run up to `n_sweeps` sweep passes over a (P, E) population.
 
     Candidate budget per pass per individual: K * (T + swap_block
@@ -559,6 +560,14 @@ def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
     (its pass counter resets on every improvement and the search ends
     after one improving-free pass, Solution.cpp:524, 653), with
     `n_sweeps` as the hard pass bound standing in for maxSteps.
+
+    return_passes=True additionally returns the number of passes
+    actually EXECUTED (the converge loop's exit count; `n_sweeps` in
+    fixed-pass mode) as an int32 scalar — telemetry for the `--trace-
+    mode stats` polish path (tt-obs): pass counts are the on-device
+    convergence signal the host otherwise cannot see without fetching
+    per-individual state. The count is already the loop carry, so
+    shipping it costs nothing and perturbs no trajectory.
     """
     state = init_state(pa, slots, rooms_arr)
 
@@ -578,7 +587,7 @@ def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
                                       hot_k, p3)
             return st, i + 1, improved
 
-        state, _, _ = lax.while_loop(
+        state, passes, _ = lax.while_loop(
             cond, body, (state, jnp.int32(0), jnp.bool_(True)))
     else:
         def one(st, i):
@@ -588,17 +597,21 @@ def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
             return st, None
 
         state, _ = lax.scan(one, state, jnp.arange(n_sweeps))
+        passes = jnp.int32(n_sweeps)
+    if return_passes:
+        return state.slots, state.rooms, passes
     return state.slots, state.rooms
 
 
 @functools.partial(jax.jit,
                    static_argnames=("n_sweeps", "swap_block", "converge",
                                     "block_events", "sideways", "hot_k",
-                                    "p3"))
+                                    "p3", "return_passes"))
 def jit_sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
                            swap_block: int = 8, converge: bool = False,
                            block_events: int = 1, sideways: float = 0.0,
-                           hot_k: int = 0, p3: float = 0.0):
+                           hot_k: int = 0, p3: float = 0.0,
+                           return_passes: bool = False):
     return sweep_local_search(pa, key, slots, rooms_arr, n_sweeps,
                               swap_block, converge, block_events, sideways,
-                              hot_k, p3)
+                              hot_k, p3, return_passes)

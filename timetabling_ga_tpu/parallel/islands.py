@@ -33,9 +33,11 @@ from __future__ import annotations
 
 import collections
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -231,17 +233,22 @@ def _migrate(state: ga.PopState, n_islands: int, L: int = 1
 
 def make_island_runner(mesh: Mesh, cfg: ga.GAConfig, n_epochs: int,
                        gens_per_epoch: int, n_islands: int = None,
-                       donate: bool = False):
+                       donate: bool = False, trace_mode: str = "full"):
     """Build the jitted multi-island evolution step.
 
     Returns `run(pa, key, state) -> (state, best_trace, global_best)`:
       - state: global PopState sharded over the mesh
-      - best_trace: (n_islands, n_epochs, gens_per_epoch, 2) int32 —
-        per-GENERATION (hcv, scv) of each island's best individual,
-        tracked on-device inside the scan so mid-epoch improvements are
-        visible to the JSONL logEntry protocol (ga.cpp:203-228) without
-        any per-epoch host fetch; the host reads the whole trace once per
-        dispatch
+      - best_trace (trace_mode="full"): (n_islands, n_epochs,
+        gens_per_epoch, 2) int32 — per-GENERATION (hcv, scv) of each
+        island's best individual, tracked on-device inside the scan so
+        mid-epoch improvements are visible to the JSONL logEntry
+        protocol (ga.cpp:203-228) without any per-epoch host fetch; the
+        host reads the whole trace once per dispatch
+      - best_trace (trace_mode="deltas"/"stats"): the ON-DEVICE
+        compressed form (_compress_trace): (n_islands,
+        trace_leaf_width(...)) int32 of improvement events + count
+        [+ moments] — the telemetry leaf shrinks from O(gens) to O(K)
+        per island while the emitted record stream stays identical
       - global_best: scalar = pmin over islands of the final best penalty
         (the reference's MPI_Allreduce MIN, ga.cpp:237)
     One dispatch runs n_epochs x gens_per_epoch generations on all islands
@@ -285,6 +292,10 @@ def make_island_runner(mesh: Mesh, cfg: ga.GAConfig, n_epochs: int,
         # (n_epochs, gens, L, 2) -> (L, n_epochs, gens, 2): concat over
         # devices then yields island-major (n_islands, n_epochs, gens, 2)
         trace = jnp.transpose(trace, (2, 0, 1, 3))
+        if trace_mode != "full":
+            trace = _compress_trace(
+                trace.reshape(L, n_epochs * gens_per_epoch, 2), None,
+                trace_mode)
         best_local = jnp.min(_blocks(state, L, pop).penalty[:, 0])
         global_best = lax.pmin(best_local, AXIS)
         return state, trace, global_best
@@ -297,9 +308,156 @@ def make_island_runner(mesh: Mesh, cfg: ga.GAConfig, n_epochs: int,
 # engine's later jax_platforms switch (backend="cpu")
 _SENTINEL = 2 ** 31 - 1
 
+# --- device-side telemetry reduction (tt-obs; ROADMAP dispatch-pipeline
+# follow-up, EvoX-style streaming stats — PAPERS.md arXiv:2301.12457 /
+# 2405.03605). The runners' per-GENERATION (hcv, scv) best trace is the
+# biggest leaf the host fetches every dispatch: n_islands x n_epochs x
+# gens x 2 int32, growing linearly with fused-dispatch depth. But the
+# logEntry protocol only ever EMITS the strict-improvement subsequence
+# of that trace, and every control read (phase switch, kick,
+# checkpoint best fold) only needs its minimum — so `deltas` mode
+# compresses the trace ON DEVICE to the dispatch-local improvement
+# events (gen index, hcv, scv), and `stats` mode adds streamed moments
+# (mean/var/min/max of the per-generation best) while still shipping
+# the same events. The emitted record stream is IDENTICAL to full mode
+# (tests/test_obs.py pins it): an emitted generation is by definition a
+# dispatch-local improvement, and the host re-applies its exact
+# emission floor over the shipped events.
+
+# Improvement-event capacity per island per dispatch. Overflow (more
+# strict improvements than slots — only plausible in a first dispatch
+# at very long fusion) drops the tail on device; the shipped count
+# exposes it and the engine warns + counts it (obs metric
+# `engine.trace_delta_overflow`) instead of silently under-reporting.
+TRACE_DELTAS_CAP = int(os.environ.get("TT_TRACE_DELTAS_CAP", "64"))
+
+TRACE_MODES = ("full", "deltas", "stats")
+
+# moments shipped in stats mode (float32, bitcast through the int32
+# telemetry leaf): mean/var/min/max of the per-generation best's
+# reported value across the dispatch
+TRACE_N_MOMENTS = 4
+
+
+def trace_leaf_width(n_gens: int, trace_mode: str) -> int:
+    """Packed telemetry columns per island for a compressed trace:
+    K events x (gen, hcv, scv) + the improvement count [+ moments]."""
+    k = min(n_gens, TRACE_DELTAS_CAP)
+    return 3 * k + 1 + (TRACE_N_MOMENTS if trace_mode == "stats" else 0)
+
+
+def _compress_trace(trace, n_valid, trace_mode: str):
+    """(L, T, 2) per-generation (hcv, scv) trace -> (L, W) packed int32.
+
+    Per island: a scan computes the dispatch-local running lex-min of
+    (hcv, scv) — lex order equals reported-value order under the
+    protocol's own scv < 1e6 packing assumption (jsonl.reported_best) —
+    and marks the strict improvements; a cumsum-indexed scatter packs
+    the LAST K improvement rows (gen, hcv, scv) into a sentinel-padded
+    (K, 3) block (overflow rows land in a discarded K+1th slot). On
+    overflow the EARLIEST events are the ones dropped — each is
+    superseded by a later shipped event, so the dispatch's final best
+    (the value control reads: best_seen, the post-feasibility switch)
+    always survives; dropping the tail instead would lose exactly the
+    best values. The improvement count rides along so the host can
+    detect overflow.
+    `n_valid` masks trailing sentinel rows of a dynamic-gens trace —
+    None (every row real), a scalar (the dynamic runner's shared
+    n_gens), or an (L,) vector (the lane runner's per-lane quantum
+    counts). Stats mode appends bitcast float32 moments over the valid
+    rows."""
+    T = trace.shape[1]
+    K = min(T, TRACE_DELTAS_CAP)
+    gidx = jnp.arange(T, dtype=jnp.int32)
+    if n_valid is None:
+        nv = jnp.full((trace.shape[0],), T, jnp.int32)
+    else:
+        nv = jnp.broadcast_to(jnp.asarray(n_valid, jnp.int32),
+                              (trace.shape[0],))
+
+    def one(tr, n_val):
+        valid = gidx < n_val
+        h, s = tr[:, 0], tr[:, 1]
+
+        def step(carry, x):
+            bh, bs = carry
+            hh, ss, ok = x
+            imp = ok & ((hh < bh) | ((hh == bh) & (ss < bs)))
+            return ((jnp.where(imp, hh, bh), jnp.where(imp, ss, bs)),
+                    imp)
+
+        _, mask = lax.scan(
+            step, (jnp.int32(_SENTINEL), jnp.int32(_SENTINEL)),
+            (h, s, valid))
+        n_imp = jnp.sum(mask.astype(jnp.int32))
+        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        # keep the last K: slot < K is guaranteed (pos <= n_imp - 1)
+        slot = pos - jnp.maximum(n_imp - K, 0)
+        idx = jnp.where(mask & (slot >= 0), slot, K)
+        rows = jnp.stack([gidx, h, s], axis=1)
+        ev = jnp.full((K + 1, 3), _SENTINEL, jnp.int32).at[idx].set(rows)
+        parts = [ev[:K].reshape(-1), n_imp[None]]
+        if trace_mode == "stats":
+            repf = jnp.where(h == 0, s.astype(jnp.float32),
+                             h.astype(jnp.float32) * 1e6
+                             + s.astype(jnp.float32))
+            w = valid.astype(jnp.float32)
+            n = jnp.maximum(jnp.sum(w), 1.0)
+            mean = jnp.sum(repf * w) / n
+            var = jnp.maximum(jnp.sum(repf * repf * w) / n
+                              - mean * mean, 0.0)
+            mn = jnp.min(jnp.where(valid, repf, jnp.inf))
+            mx = jnp.max(jnp.where(valid, repf, -jnp.inf))
+            parts.append(lax.bitcast_convert_type(
+                jnp.stack([mean, var, mn, mx]), jnp.int32))
+        return jnp.concatenate(parts)
+
+    return jax.vmap(one)(trace, nv)
+
+
+def trace_events(trace, trace_mode: str):
+    """HOST-side decode of one fetched telemetry leaf.
+
+    Returns `(events, counts, moments)` where `events[i]` is island i's
+    ordered `(gen, hcv, scv)` candidate list, `counts` the on-device
+    improvement counts (None in full mode — every row ships), and
+    `moments` an (n_islands, 4) float32 `[mean, var, min, max]` array
+    (stats mode only). The emitters apply their own best/emitted floors
+    over the events, so full and compressed leaves yield the SAME
+    record stream: full mode lists every generation and the floor
+    selects the improvements; deltas/stats ship the improvements
+    pre-selected (gen indices ride along) and the floor is a no-op on
+    everything the full path would also have skipped.
+
+    Accepts the full trace at any of its shapes ((n_islands, E, G, 2)
+    static, (n_islands, 1, G, 2) dynamic post-slice) and the packed
+    (n_islands, W) int32 leaf — the layouts are unambiguous by ndim.
+    Sentinel rows (a dynamic tail's unexecuted generations, unused
+    event slots) are dropped; numpy only, no device access."""
+    tr = np.asarray(trace)
+    if tr.ndim != 2:               # full per-generation trace
+        flat = tr.reshape(tr.shape[0], -1, 2)
+        events = [[(g, int(row[0]), int(row[1]))
+                   for g, row in enumerate(isl) if row[0] != _SENTINEL]
+                  for isl in flat]
+        return events, None, None
+    n_isl, W = tr.shape
+    n_mom = TRACE_N_MOMENTS if trace_mode == "stats" else 0
+    K = (W - 1 - n_mom) // 3
+    ev = tr[:, :3 * K].reshape(n_isl, K, 3)
+    counts = tr[:, 3 * K].copy()
+    moments = None
+    if n_mom:
+        moments = np.ascontiguousarray(
+            tr[:, 3 * K + 1:]).view(np.float32)
+    events = [[(int(g), int(h), int(s)) for g, h, s in isl
+               if g != _SENTINEL] for isl in ev]
+    return events, counts, moments
+
 
 def make_polish_runner(mesh: Mesh, cfg: ga.GAConfig,
-                       n_islands: int = None, donate: bool = False):
+                       n_islands: int = None, donate: bool = False,
+                       with_passes: bool = False):
     """Initial-population LS polish as its own dispatchable program:
     `polish(pa, key, state, n_sweeps) -> state` runs up to `n_sweeps`
     (a RUNTIME argument) convergence-bounded sweep passes on every
@@ -317,7 +475,13 @@ def make_polish_runner(mesh: Mesh, cfg: ga.GAConfig,
     as one (3, n_islands*pop) int32 array — the engine's between-chunk
     bookkeeping (stall detection + logEntry emission) then costs ONE
     host fetch per chunk instead of three (each fetch is a multi-second
-    round trip on tunneled devices; VERDICT round-3 weak #3)."""
+    round trip on tunneled devices; VERDICT round-3 weak #3).
+
+    with_passes=True (tt-obs `--trace-mode stats`) appends one extra
+    stats ROW carrying each device's executed sweep-pass count
+    (sweep_local_search return_passes): the on-device convergence
+    signal rides the same single fetch. The trajectory is untouched —
+    the determinism A/Bs across trace modes depend on that."""
     L = local_islands(mesh, n_islands)
     pop = cfg.pop_size
 
@@ -334,16 +498,25 @@ def make_polish_runner(mesh: Mesh, cfg: ga.GAConfig,
         my_key = jax.random.fold_in(key, lax.axis_index(AXIS))
         # the sweep LS is per-individual, so it runs on the flat shard;
         # only the sort inside evaluate is per-island
-        slots, rooms = sweep_local_search(
+        out = sweep_local_search(
             pa, my_key, state.slots, state.rooms, n_sweeps=n_sweeps,
             swap_block=cfg.ls_swap_block, converge=True,
             block_events=cfg.ls_block_events, sideways=cfg.ls_sideways,
-            hot_k=cfg.ls_hot_k, p3=cfg.p3)
+            hot_k=cfg.ls_hot_k, p3=cfg.p3, return_passes=with_passes)
+        slots, rooms = out[0], out[1]
         sb = _blocks(ga.PopState(slots, rooms, state.penalty, state.hcv,
                                  state.scv), L, pop)
         st = _flat(jax.vmap(
             lambda b: ga.evaluate(pa, b.slots, b.rooms))(sb))
         stats = jnp.stack([st.penalty, st.hcv, st.scv])
+        if with_passes:
+            # one extra stats ROW with the device's pass count broadcast
+            # across its columns: rows are the unsharded axis, so the
+            # global array stays a clean (4, n_islands*pop) — the host
+            # reads row 3 and slices it off before its (3, ...) reshape
+            stats = jnp.concatenate(
+                [stats, jnp.full((1, stats.shape[1]), out[2],
+                                 jnp.int32)], axis=0)
         return st, stats
 
     return _donate(_polish, donate, 2)
@@ -536,7 +709,8 @@ def make_lahc_runners(mesh: Mesh, cfg: ga.GAConfig, hist_len: int,
 
 def make_island_runner_dynamic(mesh: Mesh, cfg: ga.GAConfig,
                                max_gens: int, n_islands: int = None,
-                               donate: bool = False):
+                               donate: bool = False,
+                               trace_mode: str = "full"):
     """Like `make_island_runner(n_epochs=1)` but the generation count is
     a RUNTIME argument `n_gens <= max_gens`: `run(pa, key, state, n_gens)`.
 
@@ -547,6 +721,9 @@ def make_island_runner_dynamic(mesh: Mesh, cfg: ga.GAConfig,
     (Solution.cpp:499); our granularity is one generation. Trace rows at
     index >= n_gens hold INT_MAX sentinels (the host slices them off).
     Migration still closes the epoch (ga.cpp:522-535 cadence).
+    trace_mode "deltas"/"stats" ships the compressed telemetry leaf
+    instead (_compress_trace, with rows >= n_gens masked out of the
+    moments; sentinel rows can never register as improvements).
     """
     if n_islands is None:
         n_islands = mesh.devices.size
@@ -579,9 +756,13 @@ def make_island_runner_dynamic(mesh: Mesh, cfg: ga.GAConfig,
 
         state, trace = lax.fori_loop(0, n_gens, body, (state, tr0))
         state = _migrate(state, n_islands, L)
-        # (max_gens, L, 2) -> (L, 1, max_gens, 2): island-major like the
-        # static runner's trace
-        trace = jnp.transpose(trace, (1, 0, 2))[:, None]
+        if trace_mode != "full":
+            trace = _compress_trace(jnp.transpose(trace, (1, 0, 2)),
+                                    n_gens, trace_mode)
+        else:
+            # (max_gens, L, 2) -> (L, 1, max_gens, 2): island-major like
+            # the static runner's trace
+            trace = jnp.transpose(trace, (1, 0, 2))[:, None]
         best_local = jnp.min(_blocks(state, L, pop).penalty[:, 0])
         global_best = lax.pmin(best_local, AXIS)
         return state, trace, global_best
@@ -635,7 +816,8 @@ def make_lane_init(mesh: Mesh, pop_size: int, cfg: ga.GAConfig,
 
 
 def make_lane_runner(mesh: Mesh, cfg: ga.GAConfig, max_gens: int,
-                     n_lanes: int, donate: bool = False):
+                     n_lanes: int, donate: bool = False,
+                     trace_mode: str = "full"):
     """The serve dispatch program:
     `run(pa_l, seeds, chunks, state, gens) -> (state, trace)`.
 
@@ -649,7 +831,11 @@ def make_lane_runner(mesh: Mesh, cfg: ga.GAConfig, max_gens: int,
       gens    (n_lanes,) int32 — generations to run this quantum
               (0 for idle/filler lanes; <= max_gens)
       trace   (n_lanes, max_gens, 2) int32 per-generation (hcv, scv) of
-              each lane's best row; rows >= gens hold INT_MAX sentinels
+              each lane's best row; rows >= gens hold INT_MAX sentinels.
+              trace_mode "deltas"/"stats" ships the packed (n_lanes,
+              trace_leaf_width(max_gens, mode)) leaf instead
+              (_compress_trace, per-lane gens as the valid mask) — the
+              serve path's telemetry shrinks exactly like the engine's
 
     One compile serves every quantum size and every job mix of a
     bucket. Each device iterates to the max of ITS lanes' counts and
@@ -694,6 +880,8 @@ def make_lane_runner(mesh: Mesh, cfg: ga.GAConfig, max_gens: int,
             return st, tr
 
         sb, trace = lax.fori_loop(0, n_steps, body, (sb, tr0))
+        if trace_mode != "full":
+            trace = _compress_trace(trace, gens, trace_mode)
         return _flat(sb), trace
 
     def run(pa_l, seeds, chunks, state, gens):

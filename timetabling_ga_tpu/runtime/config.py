@@ -63,6 +63,17 @@ supervisor + runtime/faults.py):
     --faults <spec>       deterministic fault injection plan
                           (site:nth:action, comma-separated — see
                           runtime/faults.py); defaults to $TT_FAULTS
+
+Observability (README "Observability"; timetabling_ga_tpu/obs):
+    --obs                 emit spanEntry timing spans and metricsEntry
+                          registry snapshots on the JSONL stream
+                          (`tt trace` / `tt stats` read them)
+    --trace-mode <mode>   device-side telemetry reduction: full |
+                          deltas (per-island improvement events only) |
+                          stats (events + streamed on-device moments);
+                          the emitted record stream is identical
+    --metrics-every <n>   dispatches between metricsEntry snapshots
+                          under --obs (0 = end-of-try only)
 """
 
 from __future__ import annotations
@@ -158,6 +169,27 @@ class RunConfig:
     ls_full_eval: bool = False  # disable delta evaluation (debugging)
     epochs_per_dispatch: int = 1  # epochs fused into one device dispatch
     trace: bool = False       # emit {"phase": ...} timing JSONL records
+    # ---- observability (tt-obs; README "Observability"):
+    obs: bool = False         # emit spanEntry (host-side timing spans)
+    #                           and periodic metricsEntry (registry
+    #                           snapshots) records on the JSONL stream;
+    #                           `tt trace` exports them as Chrome
+    #                           trace-event JSON, `tt stats` summarizes.
+    #                           Counters/gauges update regardless of
+    #                           this flag — it gates only record
+    #                           emission
+    trace_mode: str = "full"  # device-side telemetry reduction:
+    #                           "full" ships the per-generation
+    #                           (hcv, scv) best trace; "deltas" ships
+    #                           only per-island improvement events
+    #                           (gen, hcv, scv) + count; "stats" adds
+    #                           streamed on-device moments and the
+    #                           polish pass counts. The emitted record
+    #                           stream is identical across modes
+    #                           (tests/test_obs.py pins it)
+    metrics_every: int = 10   # dispatches between metricsEntry
+    #                           snapshots under --obs (0 = only the
+    #                           end-of-try snapshot)
     trace_profile: Optional[str] = None  # capture a jax.profiler trace of
     #                           one mid-run dispatch into this directory
     #                           (SURVEY section 5 tracing; view with
@@ -358,6 +390,8 @@ _FLAG_MAP = {
     "--epochs-per-dispatch": ("epochs_per_dispatch", int),
     "--kick-stall": ("kick_stall", int),
     "--trace-profile": ("trace_profile", str),
+    "--trace-mode": ("trace_mode", str),
+    "--metrics-every": ("metrics_every", int),
     "--max-recoveries": ("max_recoveries", int),
     "--fetch-timeout": ("fetch_timeout", float),
     "--faults": ("faults", str),
@@ -368,8 +402,13 @@ _FLAG_MAP = {
 
 _BOOL_FLAGS = {"--resume": "resume", "--nsga2": "nsga2",
                "--ls-full-eval": "ls_full_eval", "--trace": "trace",
-               "--ls-converge": "ls_converge",
+               "--ls-converge": "ls_converge", "--obs": "obs",
                "--distributed": "distributed"}
+
+# device-side telemetry reduction modes (mirrors islands.TRACE_MODES —
+# duplicated literally because this module must parse flags without
+# importing jax)
+TRACE_MODES = ("full", "deltas", "stats")
 _NEG_BOOL_FLAGS = {"--no-auto-tune": "auto_tune",
                    "--no-precompile": "precompile",
                    "--no-pipeline": "pipeline",
@@ -450,6 +489,12 @@ def parse_args(argv) -> RunConfig:
         raise SystemExit(f"unknown ls-mode: {cfg.ls_mode}")
     if cfg.rooms_mode not in ("scan", "parallel"):
         raise SystemExit(f"unknown rooms-mode: {cfg.rooms_mode}")
+    if cfg.trace_mode not in TRACE_MODES:
+        raise SystemExit(f"unknown trace-mode: {cfg.trace_mode} "
+                         f"(one of {', '.join(TRACE_MODES)})")
+    if cfg.metrics_every < 0:
+        raise SystemExit("--metrics-every must be >= 0 dispatches "
+                         "(0 = only the end-of-try snapshot)")
     if cfg.coordinator is not None and (cfg.num_processes is None
                                         or cfg.process_id is None):
         raise SystemExit("--coordinator requires --num-processes and "
@@ -525,6 +570,14 @@ class ServeConfig:
     max_steps: int = 32           # LS budget per generation (see
     #                               RunConfig.resolved_max_steps)
     ls_candidates: int = 8
+    # ---- observability (tt-obs, same semantics as RunConfig's):
+    obs: bool = False             # spanEntry spans (admit/pack/quantum/
+    #                               park/resume) + periodic metricsEntry
+    #                               snapshots on the record stream
+    trace_mode: str = "full"      # lane-runner telemetry reduction
+    #                               (full | deltas | stats)
+    metrics_every: int = 10       # dispatches between metricsEntry
+    #                               snapshots under --obs
 
 
 _SERVE_FLAG_MAP = {
@@ -544,7 +597,11 @@ _SERVE_FLAG_MAP = {
     "--bucket-ratio": ("bucket_ratio", float),
     "-m": ("max_steps", int),
     "--ls-candidates": ("ls_candidates", int),
+    "--trace-mode": ("trace_mode", str),
+    "--metrics-every": ("metrics_every", int),
 }
+
+_SERVE_BOOL_FLAGS = {"--obs": "obs"}
 
 
 def _serve_usage() -> str:
@@ -552,16 +609,22 @@ def _serve_usage() -> str:
         ["usage: python -m timetabling_ga_tpu serve [flags]", "",
          "multi-tenant solver service (line-JSON jobs on -i/stdin, "
          "job-tagged JSONL records on -o/stdout):"],
-        _SERVE_FLAG_MAP)
+        _SERVE_FLAG_MAP, (_SERVE_BOOL_FLAGS,))
 
 
 def parse_serve_args(argv) -> ServeConfig:
     """Parse the `serve` subcommand's flags (same -key value model as
     parse_args — _parse_flag_stream is the shared loop)."""
     cfg = ServeConfig()
-    _parse_flag_stream(argv, cfg, _SERVE_FLAG_MAP, _serve_usage)
+    _parse_flag_stream(argv, cfg, _SERVE_FLAG_MAP, _serve_usage,
+                       _SERVE_BOOL_FLAGS)
     if cfg.backend not in ("tpu", "cpu"):
         raise SystemExit(f"unknown backend: {cfg.backend}")
+    if cfg.trace_mode not in TRACE_MODES:
+        raise SystemExit(f"unknown trace-mode: {cfg.trace_mode} "
+                         f"(one of {', '.join(TRACE_MODES)})")
+    if cfg.metrics_every < 0:
+        raise SystemExit("--metrics-every must be >= 0 dispatches")
     if cfg.lanes < 1:
         raise SystemExit("--lanes must be >= 1")
     if cfg.quantum < 1:

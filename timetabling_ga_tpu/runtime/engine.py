@@ -73,6 +73,25 @@ degradation ladder: pipelined -> serial -> halved dispatch chunks.
 Recovery events are {"faultEntry": ...} JSONL records;
 runtime/faults.py injects every failure mode deterministically on the
 CPU backend (TT_FAULTS) so tier-1 exercises each path.
+
+Observability (tt-obs; README "Observability"). Under --obs every hot-
+path phase (dispatch / fetch / process / checkpoint / init / polish /
+lahc / recover) emits a host-side timing span as a {"spanEntry": ...}
+record riding the SAME AsyncWriter — spans are telemetry by
+construction and never fence. `tt trace` exports them as Chrome
+trace-event JSON. Counters and gauges (dispatches, gens/sec, host-gap
+ms/gen, device-busy fraction, recoveries, writer queue occupancy) live
+in the process metrics registry (obs/metrics.py) regardless of --obs;
+--obs additionally snapshots the registry as {"metricsEntry": ...}
+records (every --metrics-every dispatches and at each try's end).
+`--trace-mode deltas|stats` moves the telemetry REDUCTION on device
+(parallel/islands.py _compress_trace): the runner ships per-island
+best-delta events (+ streamed moments under `stats`) instead of the
+full per-generation trace array, shrinking the fetched leaf from
+O(gens) to O(improvements) per island while the emitted bestEver
+stream stays identical to `full` (an emitted generation is by
+definition a dispatch-local improvement; tests/test_obs.py pins the
+A/B across modes, pipelining, and obs).
 """
 
 from __future__ import annotations
@@ -87,6 +106,8 @@ import time
 import jax
 import numpy as np
 
+from timetabling_ga_tpu.obs import metrics as obs_metrics
+from timetabling_ga_tpu.obs.spans import NULL_TRACER, SpanTracer
 from timetabling_ga_tpu.ops import ga
 from timetabling_ga_tpu.parallel import islands
 from timetabling_ga_tpu.problem import load_tim_file
@@ -151,36 +172,44 @@ def _clone(state):
 
 
 def cached_runner(mesh, gacfg: ga.GAConfig, n_epochs: int, gens: int,
-                  sig, n_islands: int, donate: bool = False):
+                  sig, n_islands: int, donate: bool = False,
+                  trace_mode: str = "full"):
     """Returns (runner, was_cached). was_cached=False means this
     (program, instance shape) pair is fresh, so its first call will pay
     an XLA compile. `donate` is part of the cache key (as in every
     cached_* factory here): the donating and non-donating jits are
     DIFFERENT executables, and colliding them would hand a
-    buffer-deleting program to a caller that reuses its input."""
-    k = (_mesh_key(mesh), gacfg, n_epochs, gens, sig, n_islands, donate)
+    buffer-deleting program to a caller that reuses its input.
+    `trace_mode` likewise: full/deltas/stats runners return
+    differently-shaped telemetry leaves (islands._compress_trace)."""
+    k = (_mesh_key(mesh), gacfg, n_epochs, gens, sig, n_islands, donate,
+         trace_mode)
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
     r = islands.make_island_runner(mesh, gacfg, n_epochs=n_epochs,
                                    gens_per_epoch=gens,
-                                   n_islands=n_islands, donate=donate)
+                                   n_islands=n_islands, donate=donate,
+                                   trace_mode=trace_mode)
     _RUNNER_CACHE[k] = r
     return r, False
 
 
 def cached_dynamic_runner(mesh, gacfg: ga.GAConfig, max_gens: int, sig,
-                          n_islands: int, donate: bool = False):
+                          n_islands: int, donate: bool = False,
+                          trace_mode: str = "full"):
     """Tail-dispatch runner with a RUNTIME generation count (one compile
     serves every n_gens <= max_gens), used to spend the last slice of a
     wall-clock budget instead of idling through it."""
-    k = ("dyn", _mesh_key(mesh), gacfg, max_gens, sig, n_islands, donate)
+    k = ("dyn", _mesh_key(mesh), gacfg, max_gens, sig, n_islands, donate,
+         trace_mode)
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
     r = islands.make_island_runner_dynamic(mesh, gacfg, max_gens,
                                            n_islands=n_islands,
-                                           donate=donate)
+                                           donate=donate,
+                                           trace_mode=trace_mode)
     _RUNNER_CACHE[k] = r
     return r, False
 
@@ -197,19 +226,23 @@ def cached_init(mesh, pop_size: int, gacfg: ga.GAConfig,
 
 
 def cached_lane_runner(mesh, gacfg: ga.GAConfig, max_gens: int,
-                       n_lanes: int, donate: bool = False):
+                       n_lanes: int, donate: bool = False,
+                       trace_mode: str = "full"):
     """Multi-tenant lane program (islands.make_lane_runner) for the
     serve scheduler: one compiled program per (mesh, config, quantum
     bound, lane count) serves EVERY job whose padded instance shares
     the bucket shape — the compile-cache key is the bucket, not the
     instance (serve/bucket.py). Lives in _RUNNER_CACHE so recovery's
-    _purge_programs covers it like every other compiled program."""
-    k = ("lane", _mesh_key(mesh), gacfg, max_gens, n_lanes, donate)
+    _purge_programs covers it like every other compiled program.
+    `trace_mode` is part of the key (different telemetry leaf shapes,
+    like cached_runner)."""
+    k = ("lane", _mesh_key(mesh), gacfg, max_gens, n_lanes, donate,
+         trace_mode)
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
     r = islands.make_lane_runner(mesh, gacfg, max_gens, n_lanes,
-                                 donate=donate)
+                                 donate=donate, trace_mode=trace_mode)
     _RUNNER_CACHE[k] = r
     return r, False
 
@@ -356,15 +389,20 @@ def cached_shrink_runner(mesh, pop_in: int, pop_out: int,
 
 
 def cached_polish_runner(mesh, gacfg: ga.GAConfig, sig,
-                         n_islands: int, donate: bool = False):
+                         n_islands: int, donate: bool = False,
+                         with_passes: bool = False):
     """Init-polish runner with a RUNTIME sweep count (one compile serves
-    every chunk size); see islands.make_polish_runner."""
-    k = ("polish", _mesh_key(mesh), gacfg, sig, n_islands, donate)
+    every chunk size); see islands.make_polish_runner. `with_passes`
+    (--trace-mode stats) adds the sweep-pass-count stats row and is a
+    DIFFERENT traced program, hence part of the key."""
+    k = ("polish", _mesh_key(mesh), gacfg, sig, n_islands, donate,
+         with_passes)
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
     r = islands.make_polish_runner(mesh, gacfg, n_islands=n_islands,
-                                   donate=donate)
+                                   donate=donate,
+                                   with_passes=with_passes)
     _RUNNER_CACHE[k] = r
     return r, False
 
@@ -433,17 +471,14 @@ def build_post_config(cfg: RunConfig, gacfg: ga.GAConfig):
 _Chunk = collections.namedtuple(
     "_Chunk", "td0 n_ep gens_run dyn_gens trace warm do_prof")
 
-# process-lifetime recovery count (all engine.run calls); bench.py legs
-# record per-leg deltas so a perf number that absorbed a sick window is
-# visible in the trajectory
-_RECOVERIES_TOTAL = 0
-
-
 def run_counters() -> dict:
-    """Cumulative robustness counters for this process: supervisor
-    recoveries and triggered fault injections. Callers (bench.py)
-    snapshot before/after a measurement and record the delta."""
-    return {"recoveries": _RECOVERIES_TOTAL,
+    """Back-compat view of the process robustness counters, now held by
+    the obs metrics registry (`engine.recoveries`, `faults.injected` —
+    obs/metrics.py REGISTRY). Callers (bench.py) snapshot before/after
+    a measurement and record the delta, exactly as they did when these
+    were module globals."""
+    return {"recoveries": int(
+                obs_metrics.REGISTRY.counter("engine.recoveries").value),
             "faults_injected": faults.injected_total()}
 
 
@@ -874,8 +909,9 @@ def precompile(cfg: RunConfig) -> None:
         if gacfg.init_sweeps <= 0 and g.ls_mode != "sweep":
             continue
         g_spg_key = (_mesh_key(mesh), g, fingerprint)
-        polish, pwarm = cached_polish_runner(mesh, g, sig, n_islands,
-                                             donate)
+        polish, pwarm = cached_polish_runner(
+            mesh, g, sig, n_islands, donate,
+            with_passes=(cfg.trace_mode == "stats"))
         # timing fences are data fetches of the stats output, not
         # block_until_ready, which can early-ack on the tunneled device
         # (BASELINE.md round-5 fence audit) — a near-zero sec/sweep
@@ -919,7 +955,8 @@ def precompile(cfg: RunConfig) -> None:
         # at migration_period 10 — dies inside even the n_ep=1 static
         # shape; executing that shape to measure it is the bug)
         dyn, _ = cached_dynamic_runner(mesh, g, cfg.migration_period,
-                                       sig, n_islands, donate)
+                                       sig, n_islands, donate,
+                                       cfg.trace_mode)
         g_state, tr0, _ = dyn(pa, wk[4], g_state, 1)
         _fetch(tr0)
         spg_est = _SPG_CACHE.get(g_spg_key)
@@ -940,7 +977,8 @@ def precompile(cfg: RunConfig) -> None:
                 # long-kernel watchdog — don't even build the shape
                 break
             runner, warm = cached_runner(mesh, g, n_ep, gens, sig,
-                                         n_islands, donate)
+                                         n_islands, donate,
+                                         cfg.trace_mode)
             g_state, tr2, _ = runner(pa, wk[5], g_state)
             _fetch(tr2)
             if not warm:
@@ -1016,6 +1054,7 @@ def run(cfg: RunConfig, out=None) -> int:
         else:
             out = sys.stdout
 
+    writer = None
     try:
         # all record emission (and checkpoint serialization, via
         # submit()) rides the background writer thread so the dispatch
@@ -1026,14 +1065,31 @@ def run(cfg: RunConfig, out=None) -> int:
         # (retry logic matches on the propagating error), so close()
         # only re-raises when nothing else is in flight.
         writer = jsonl.AsyncWriter(out)
+        # obs wiring: the span tracer emits through the SAME writer
+        # (spans are telemetry; the writer thread serializes them), and
+        # the registry's writer gauges re-bind to THIS run's writer —
+        # pull gauges, sampled at snapshot time
+        tracer = SpanTracer(writer, enabled=cfg.obs)
+        obs_metrics.REGISTRY.gauge_fn("writer.queue_depth", writer.qsize)
+        obs_metrics.REGISTRY.gauge_fn(
+            "writer.records", lambda: writer.records_written)
         try:
-            ret = _run_tries(cfg, writer)
+            ret = _run_tries(cfg, writer, tracer)
         except BaseException:
             writer.close(raise_error=False)
             raise
         writer.close()
         return ret
     finally:
+        # unbind the writer pull gauges: the registry is process-global,
+        # so a bound closure would keep THIS run's writer (and its
+        # output stream) alive for the process lifetime. Freeze at the
+        # final counts instead. (writer is None if AsyncWriter
+        # construction itself failed — nothing was bound.)
+        if writer is not None:
+            obs_metrics.REGISTRY.freeze(
+                "writer.records", writer.records_written)
+            obs_metrics.REGISTRY.freeze("writer.queue_depth", 0.0)
         # uninstall the fault plan: leftover unfired entries must not
         # ambush later non-run code (precompile, direct checkpoint
         # saves, other writers) outside any supervised region. Triggered
@@ -1052,7 +1108,7 @@ def _phase(out, enabled: bool, name: str, trial: int, seconds: float,
 def _polish_chunks(out, cfg, pa, polish, state, base_key, t_try, reserve,
                    sec_per_sweep, n_islands, best_seen, emitted, trial,
                    phase_name, max_sweeps, sideways, warm,
-                   sps_cache_key=None):
+                   sps_cache_key=None, tracer=NULL_TRACER):
     """Budget-aware chunked polish loop, shared by the initial-population
     polish (ga.cpp:429-434 analogue) and the budget-tail polish. Chunks
     of up to 4 runtime-counted sweep passes are dispatched while (a) the
@@ -1107,6 +1163,17 @@ def _polish_chunks(out, cfg, pa, polish, state, base_key, t_try, reserve,
         stats = _fetch(stats)
         tp1 = time.monotonic()
         _phase(out, cfg.trace, phase_name, trial, tp1 - tp0, sweeps=chunk)
+        tracer.record(phase_name, tp0, tp1 - tp0, cat="device",
+                      sweeps=chunk)
+        if stats.shape[0] == 4:
+            # --trace-mode stats: row 3 is the per-device executed
+            # sweep-pass count (islands.make_polish_runner with_passes)
+            # broadcast across its shard columns — the on-device
+            # convergence signal. Record the slowest device's count and
+            # slice the row off before the (3, ...) protocol reads.
+            obs_metrics.REGISTRY.gauge("engine.polish_passes").set(
+                int(stats[3].max()))
+            stats = stats[:3]
         if warm:
             sps = (tp1 - tp0) / chunk
             sec_per_sweep = (sps if sec_per_sweep is None
@@ -1137,7 +1204,7 @@ def _polish_chunks(out, cfg, pa, polish, state, base_key, t_try, reserve,
 
 def _lahc_loop(out, cfg, pa, mesh, state, base_key, t_try, reserve,
                n_islands, best_seen, emitted, trial, gacfg_post, sig,
-               fingerprint):
+               fingerprint, tracer=NULL_TRACER):
     """Late-Acceptance Hill Climbing endgame (--post-lahc): consume the
     try's remaining wall-clock budget with LAHC walker chunks, then
     return the best snapshots as a PopState for the endTry fetch.
@@ -1186,6 +1253,7 @@ def _lahc_loop(out, cfg, pa, mesh, state, base_key, t_try, reserve,
         stats = _fetch(stats)              # blocks on the dispatch
         dt = time.monotonic() - t0
         _phase(out, cfg.trace, "lahc", trial, dt, steps=n)
+        tracer.record("lahc", t0, dt, cat="device", steps=n)
         if warm:
             sps = dt / n
             sec_per_step = (sps if sec_per_step is None
@@ -1206,9 +1274,13 @@ def _lahc_loop(out, cfg, pa, mesh, state, base_key, t_try, reserve,
     return state
 
 
-def _run_tries(cfg: RunConfig, out) -> int:
-    global _RECOVERIES_TOTAL
+def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
     t0 = time.monotonic()
+    mreg = obs_metrics.REGISTRY
+    trace_mode = cfg.trace_mode
+    # stats mode also rides the polish runner: one extra stats row
+    # carries the executed sweep-pass count (the same single fetch)
+    with_passes = trace_mode == "stats"
     # Runners come from the module-level compiled-program cache (keyed on
     # mesh + gacfg + dispatch shape), so repeated engine.run calls with
     # the same configuration — e.g. a warm-up run followed by a timed
@@ -1317,6 +1389,8 @@ def _run_tries(cfg: RunConfig, out) -> int:
                     #                         acks on the tunnel)
                     _phase(out, cfg.trace, "init", trial,
                            time.monotonic() - t)
+                    tracer.record("init", t, time.monotonic() - t,
+                                  cat="device")
                     # Initial-population LS polish (ga.cpp:429-434),
                     # CHUNKED so the wall clock is checked between
                     # dispatches — one fused 30-pass converge polish at
@@ -1330,7 +1404,8 @@ def _run_tries(cfg: RunConfig, out) -> int:
                     # predicted not to fit the time budget.
                     if gacfg.init_sweeps > 0:
                         polish, pwarm = cached_polish_runner(
-                            mesh, gacfg, sig, n_islands, cfg.donate)
+                            mesh, gacfg, sig, n_islands, cfg.donate,
+                            with_passes)
                         # same deliberate reuse as k_init above
                         # tt-analyze: ignore[TT402]
                         state, _ = _polish_chunks(
@@ -1339,7 +1414,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
                             n_islands, best_seen, emitted, trial,
                             "polish", gacfg.init_sweeps,
                             gacfg.ls_sideways, pwarm,
-                            sps_cache_key=spg_key)
+                            sps_cache_key=spg_key, tracer=tracer)
                     break
                 except Exception as e:
                     if (init_attempt + 1 >= init_tries
@@ -1384,7 +1459,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
                 state = _lahc_loop(
                     out, cfg, pa, mesh, state, k_lahc, t_try, reserve,
                     n_islands, best_seen, emitted, trial, cur, sig,
-                    fingerprint)
+                    fingerprint, tracer=tracer)
                 lahc_done = True
         sec_per_gen = _spg_for(cur_key, cur, gacfg, spg_key)
         time_stopped = False
@@ -1437,6 +1512,11 @@ def _run_tries(cfg: RunConfig, out) -> int:
         pending = None     # the one in-flight chunk (pipelined mode)
         n_dispatch = 0
         last_fence = None  # wall time of the previous chunk's fence
+        host_gap_s = 0.0   # device-idle time between chunks (obs gauges
+        #                    host_gap_ms_per_gen / device_busy_frac —
+        #                    the numbers bench.py's pipeline A/B derives
+        #                    offline, live)
+        overflow_warned = False
         t_loop = time.monotonic()
 
         def _process(chunk, inflight=None):
@@ -1449,13 +1529,19 @@ def _run_tries(cfg: RunConfig, out) -> int:
             everything below overlaps device compute."""
             nonlocal state, key, cur, cur_key, sec_per_gen, lahc_done
             nonlocal kick_stall, kick_best, kick_streak, profiled
-            nonlocal epochs_at_ckpt, last_fence
+            nonlocal epochs_at_ckpt, last_fence, host_gap_s
+            nonlocal overflow_warned
             (td0, n_ep, gens_run, dyn_gens, trace_dev, warm,
              do_prof) = chunk                  # _Chunk fields
+            tf0 = time.monotonic()
             trace = _fetch(trace_dev)          # blocks on the dispatch
-            if dyn_gens is not None:
+            if dyn_gens is not None and trace_mode == "full":
+                # compressed leaves carry their own validity (sentinel
+                # event rows); only the full trace needs the tail slice
                 trace = trace[:, :, :dyn_gens]
             td1 = time.monotonic()
+            tracer.record("fetch", tf0, td1 - tf0, cat="engine",
+                          gens=gens_run)
             if do_prof:
                 jax.profiler.stop_trace()
                 profiled = True
@@ -1474,9 +1560,29 @@ def _run_tries(cfg: RunConfig, out) -> int:
                        if pipelined and last_fence is not None
                        else td0)
             dt = td1 - t_start
+            if last_fence is not None:
+                # device-idle gap between the previous fence and this
+                # chunk's enqueue (<= 0 pipelined: the next chunk was
+                # already running) — the live form of bench.py's
+                # pipeline-A/B host-gap metric
+                host_gap_s += max(0.0, td0 - last_fence)
             last_fence = td1
             _phase(out, cfg.trace, "dispatch", trial, dt,
                    epochs=n_ep, gens=gens_run)
+            tracer.record("dispatch", t_start, dt, cat="device",
+                          epochs=n_ep, gens=gens_run)
+            mreg.counter("engine.dispatches").inc()
+            mreg.counter("engine.gens").inc(gens_run)
+            mreg.histogram("engine.dispatch_seconds").observe(dt)
+            if dt > 0:
+                mreg.gauge("engine.gens_per_sec").set(gens_run / dt)
+            loop_s = td1 - t_loop
+            if loop_s > 0:
+                mreg.gauge("engine.device_busy_frac").set(
+                    max(0.0, 1.0 - host_gap_s / loop_s))
+            if gens_done > 0:
+                mreg.gauge("engine.host_gap_ms_per_gen").set(
+                    1e3 * host_gap_s / gens_done)
             if warm and (gens_run >= cfg.migration_period or dt >= 5.0):
                 # compiling dispatches are excluded: compile time would
                 # inflate the estimate, and the poisoned value would both
@@ -1505,12 +1611,18 @@ def _run_tries(cfg: RunConfig, out) -> int:
             # same generations as an uninjected run) while emitted
             # stays at the live stream's floor (so replayed chunks do
             # not re-emit records the pre-failure stream already has).
-            flat = trace.reshape(n_islands, gens_run, 2)
+            # trace_mode full: events = every generation, the floors
+            # select the improvements. deltas/stats: the device already
+            # selected the dispatch-local improvements (gen indices ride
+            # along), so the floors skip exactly what they would have
+            # skipped on the full trace — the record stream is identical
+            # across modes (tests/test_obs.py pins it).
+            events, ev_counts, ev_moments = islands.trace_events(
+                trace, trace_mode)
             total = gens_run
             for i in range(n_islands):
-                for g in range(total):
-                    rep = jsonl.reported_best(flat[i, g, 0],
-                                              flat[i, g, 1])
+                for g, h, s in events[i]:
+                    rep = jsonl.reported_best(h, s)
                     if rep < best_seen[i]:
                         best_seen[i] = rep
                     if rep < emitted[i]:
@@ -1518,6 +1630,38 @@ def _run_tries(cfg: RunConfig, out) -> int:
                         tg = ((t_start - t_try)
                               + (g + 1) / total * (td1 - t_start))
                         jsonl.log_entry(out, i, 0, rep, tg)
+            if ev_counts is not None:
+                # on-device event capacity overflow: the count says how
+                # many improvements happened, the event block holds at
+                # most TRACE_DELTAS_CAP — surface the dropped tail
+                # instead of silently under-reporting
+                dropped = int(sum(max(0, int(c) - len(e))
+                                  for c, e in zip(ev_counts, events)))
+                if dropped:
+                    mreg.counter("engine.trace_delta_overflow").inc(
+                        dropped)
+                    if not overflow_warned:
+                        overflow_warned = True
+                        print(f"warning: --trace-mode {trace_mode} "
+                              f"dropped {dropped} improvement event(s) "
+                              f"this dispatch (cap "
+                              f"{islands.TRACE_DELTAS_CAP}; raise "
+                              f"TT_TRACE_DELTAS_CAP)", file=sys.stderr)
+            if ev_moments is not None:
+                # streamed on-device moments of the per-generation best
+                # (stats mode): aggregate across islands into gauges
+                mreg.gauge("engine.trace_best_mean").set(
+                    float(ev_moments[:, 0].mean()))
+                mreg.gauge("engine.trace_best_min").set(
+                    float(ev_moments[:, 2].min()))
+                mreg.gauge("engine.trace_best_max").set(
+                    float(ev_moments[:, 3].max()))
+            tracer.record("process", td1, time.monotonic() - td1,
+                          cat="engine", gens=gens_run)
+            if (cfg.obs and cfg.metrics_every > 0
+                    and n_dispatch % cfg.metrics_every == 0):
+                jsonl.metrics_entry(out, mreg.snapshot(),
+                                    ts=tracer.now())
 
             # post-feasibility switch (reference phase-2 analogue): a
             # CONTROL read — it picks the next dispatch's program — so
@@ -1544,7 +1688,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
                     state = _lahc_loop(
                         out, cfg, pa, mesh, state, k_lahc, t_try,
                         reserve, n_islands, best_seen, emitted, trial,
-                        cur, sig, fingerprint)
+                        cur, sig, fingerprint, tracer=tracer)
                     lahc_done = True
                     return
 
@@ -1596,6 +1740,9 @@ def _run_tries(cfg: RunConfig, out) -> int:
                     _phase(out, cfg.trace, "kick", trial,
                            time.monotonic() - t, at_gen=gens_done,
                            moves=n_moves)
+                    tracer.record("kick", t, time.monotonic() - t,
+                                  cat="device", moves=n_moves)
+                    mreg.counter("engine.kicks").inc()
                     kick_stall = 0
                     kick_streak += 1
 
@@ -1631,11 +1778,13 @@ def _run_tries(cfg: RunConfig, out) -> int:
                     # stays untouched so the chunk's logEntries still
                     # emit normally when it retires.
                     tr_in = _fetch(inflight.trace)
-                    if inflight.dyn_gens is not None:
+                    if (inflight.dyn_gens is not None
+                            and trace_mode == "full"):
                         tr_in = tr_in[:, :, :inflight.dyn_gens]
-                    fl_in = tr_in.reshape(n_islands, -1, 2)
+                    ev_in, _, _ = islands.trace_events(tr_in,
+                                                       trace_mode)
                     for i in range(n_islands):
-                        for h, s in fl_in[i]:
+                        for _g, h, s in ev_in[i]:
                             bs[i] = min(bs[i],
                                         jsonl.reported_best(h, s))
                     tr_fold = tr_in
@@ -1668,6 +1817,9 @@ def _run_tries(cfg: RunConfig, out) -> int:
                              inflight_trace=tr_fold)
                 _phase(out, cfg.trace, "checkpoint", trial,
                        time.monotonic() - t)
+                tracer.record("checkpoint", t, time.monotonic() - t,
+                              cat="engine", gens=gens_done)
+                mreg.counter("engine.checkpoints").inc()
 
         # ---- supervised region (in-run fault recovery) ----------------
         # Everything from here to the endTry fetch can die of a
@@ -1801,12 +1953,13 @@ def _run_tries(cfg: RunConfig, out) -> int:
                     if dyn_gens is not None:
                         runner, warm = cached_dynamic_runner(
                             mesh, cur, cfg.migration_period, sig, n_islands,
-                            cfg.donate)
+                            cfg.donate, trace_mode)
                         args = (pa, k_epoch, state, dyn_gens)
                         gens_run = dyn_gens
                     else:
                         runner, warm = cached_runner(mesh, cur, n_ep, gens,
-                                                     sig, n_islands, cfg.donate)
+                                                     sig, n_islands, cfg.donate,
+                                                     trace_mode)
                         args = (pa, k_epoch, state)
                         gens_run = n_ep * gens
                     # fault-injection point (runtime/faults.py `dispatch`
@@ -1871,7 +2024,8 @@ def _run_tries(cfg: RunConfig, out) -> int:
                                  else None)
                 if sec_per_sweep is not None and sec_per_sweep > 0:
                     polish, pwarm = cached_polish_runner(mesh, cur, sig,
-                                                         n_islands, cfg.donate)
+                                                         n_islands, cfg.donate,
+                                                         with_passes)
                     if pwarm:   # never compile inside the budget
                         key, k_tail = jax.random.split(key)
                         # no sps_cache_key: tail timings of converged
@@ -1881,7 +2035,7 @@ def _run_tries(cfg: RunConfig, out) -> int:
                             out, cfg, pa, polish, state, k_tail, t_try,
                             reserve, sec_per_sweep, n_islands, best_seen,
                             emitted, trial, "tail-polish", None,
-                            cur.ls_sideways, True)
+                            cur.ls_sideways, True, tracer=tracer)
 
                 # final per-island solution records (endTry, ga.cpp:169-197).
                 # P is the ACTIVE phase's population (the post phase may have
@@ -1890,6 +2044,8 @@ def _run_tries(cfg: RunConfig, out) -> int:
                 P = cur.pop_size
                 slots, rooms, hcv, scv = _fetch_final(state, n_islands, P)
                 _phase(out, cfg.trace, "fetch", trial, time.monotonic() - t)
+                tracer.record("fetch", t, time.monotonic() - t,
+                              cat="engine", endTry=True)
                 break
             except Exception as e:
                 site = sup.classify(e)
@@ -1916,7 +2072,8 @@ def _run_tries(cfg: RunConfig, out) -> int:
                             print(f"warning: final abort checkpoint "
                                   f"failed: {e3}", file=sys.stderr)
                     raise
-                _RECOVERIES_TOTAL += 1
+                mreg.counter("engine.recoveries").inc()
+                t_rec = time.monotonic()
                 snap = sup.snap
                 jsonl.fault_entry(
                     out, site, "recover", e, trial, sup.recoveries,
@@ -1995,16 +2152,20 @@ def _run_tries(cfg: RunConfig, out) -> int:
                     # fence): emit them now, in stream order, before
                     # resuming — emitted-floor gating keeps records the
                     # pre-failure stream already carries from repeating
-                    fl = snap.inflight_trace.reshape(n_islands, -1, 2)
+                    ev_fl, _, _ = islands.trace_events(
+                        snap.inflight_trace, trace_mode)
                     tnow = time.monotonic() - t_try
                     for i in range(n_islands):
-                        for h, s in fl[i]:
+                        for _g, h, s in ev_fl[i]:
                             rep = jsonl.reported_best(h, s)
                             if rep < best_seen[i]:
                                 best_seen[i] = rep
                             if rep < emitted[i]:
                                 emitted[i] = rep
                                 jsonl.log_entry(out, i, 0, rep, tnow)
+                tracer.record("recover", t_rec,
+                              time.monotonic() - t_rec, cat="engine",
+                              site=site, level=sup.level)
         total_time = time.monotonic() - t_try
         for i in range(n_islands):
             feas = hcv[i] == 0
@@ -2025,6 +2186,10 @@ def _run_tries(cfg: RunConfig, out) -> int:
         jsonl.run_entry(out, trial_best, feasible,
                         procs_num=n_islands, threads_num=cfg.threads,
                         total_time=total_time)
+        if cfg.obs:
+            # end-of-try registry snapshot: the last metricsEntry of a
+            # try always reflects its final counter state
+            jsonl.metrics_entry(out, mreg.snapshot(), ts=tracer.now())
         global_best = min(global_best, trial_best)
 
     return global_best

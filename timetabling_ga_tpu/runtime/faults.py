@@ -132,6 +132,17 @@ class FaultPlan:
             act = self._entries.get(site, {}).pop(n, None)
             if act is not None:
                 self.injected += 1
+                # mirror into the obs metrics registry (tt-obs) so
+                # `faults.injected` shows up in metricsEntry snapshots.
+                # Lazy import on the injection path only: module LOAD
+                # stays stdlib-only (the contract above), and a plan
+                # only ever fires inside an engine/serve run where the
+                # package is long imported.
+                try:
+                    from timetabling_ga_tpu.obs import metrics as _obs
+                    _obs.REGISTRY.counter("faults.injected").inc()
+                except Exception:
+                    pass   # telemetry must never break injection
             return act
 
 

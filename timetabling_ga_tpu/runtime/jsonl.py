@@ -75,6 +75,7 @@ class AsyncWriter:
     def __init__(self, stream: IO, maxsize: int = 1024):
         self._stream = stream
         self._q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self._records = 0      # lines enqueued (obs: writer.records)
         self._error: BaseException | None = None
         self._failed = False   # worker latch, never cleared: once the
         #                        stream failed mid-record, writing more
@@ -167,7 +168,21 @@ class AsyncWriter:
     def write(self, s: str) -> None:
         self._check_open()
         self._raise_pending()
+        self._records += 1
         self._put(s)
+
+    def qsize(self) -> int:
+        """Current queue occupancy — the obs metrics registry samples
+        this through a pull gauge (`writer.queue_depth`): a queue
+        sitting near its bound means the disk, not the device, is the
+        bottleneck."""
+        return self._q.qsize()
+
+    @property
+    def records_written(self) -> int:
+        """Lines enqueued over this writer's lifetime (obs:
+        `writer.records` pull gauge)."""
+        return self._records
 
     def flush(self) -> None:
         """No-op: the worker flushes after every record. (The emitters
@@ -296,6 +311,45 @@ def fault_entry(stream: IO, site: str, action: str, error, trial: int,
     _write(stream, {"faultEntry": rec})
 
 
+def span_entry(stream: IO, name: str, cat: str, ts: float, dur: float,
+               depth: int = 0, tid: int = 0, **extra) -> None:
+    """Observability EXTENSION record (tt-obs, README "Observability";
+    emitted only when a run's span tracer is enabled): one host-side
+    timing span —
+
+      {"spanEntry":{"name":"dispatch","cat":"device","ts":1.234,
+                    "dur":0.087,"depth":0,"tid":0, ...}}
+
+    `ts` is seconds since the tracer epoch (time.monotonic domain),
+    `dur` the span length, `depth` the nesting level on `tid`'s thread.
+    `tt trace` exports these as Chrome trace-event JSON. Pure timing:
+    strip_timing drops the whole record (like phase records), so span
+    emission never enters the determinism A/Bs' byte-identity domain."""
+    rec = {"name": str(name), "cat": str(cat),
+           "ts": round(max(0.0, float(ts)), 6),
+           "dur": round(max(0.0, float(dur)), 6),
+           "depth": int(depth), "tid": int(tid)}
+    for k, v in extra.items():
+        rec[k] = v
+    _write(stream, {"spanEntry": rec})
+
+
+def metrics_entry(stream: IO, snapshot: dict, ts=None) -> None:
+    """Observability EXTENSION record: one metrics-registry snapshot
+    (obs/metrics.py MetricsRegistry.snapshot) —
+
+      {"metricsEntry":{"ts":12.3,"counters":{...},"gauges":{...},
+                       "histograms":{...}}}
+
+    `ts` (tracer-epoch seconds) is optional — `tt trace` turns stamped
+    snapshots into Perfetto counter tracks. Wall-clock-dependent
+    throughout, so strip_timing drops the record."""
+    rec = dict(snapshot)
+    if ts is not None:
+        rec["ts"] = round(max(0.0, float(ts)), 6)
+    _write(stream, {"metricsEntry": rec})
+
+
 def phase_record(stream: IO, name: str, trial: int, seconds: float,
                  **extra) -> None:
     """Observability EXTENSION record (not in the reference protocol;
@@ -319,17 +373,25 @@ def phase_record(stream: IO, name: str, trial: int, seconds: float,
 TIMING_FIELDS = {"logEntry": ("time",), "solution": ("totalTime",),
                  "runEntry": ("totalTime",)}
 
+# record types that are timing through and through — the determinism
+# A/Bs drop them entirely rather than field-stripping them. phase and
+# the obs records (spanEntry/metricsEntry) are wall-clock measurements;
+# faultEntry is excluded by the fault-recovery contract (a recovered
+# run matches an uninjected one MODULO fault records).
+TIMING_RECORDS = ("phase", "faultEntry", "spanEntry", "metricsEntry")
+
 
 def strip_timing(records: List[dict]) -> List[dict]:
-    """Protocol records minus phase/fault records and timing fields —
-    the byte-identity domain of the pipeline A/B (bench.py
-    extra.pipeline, tests/test_runtime.py pipeline determinism) AND of
-    the fault-recovery determinism contract (a recovered run matches an
-    uninjected one modulo timing and fault records — tests/
-    test_faults.py)."""
+    """Protocol records minus timing-only records (TIMING_RECORDS) and
+    timing fields — the byte-identity domain of the pipeline A/B
+    (bench.py extra.pipeline, tests/test_runtime.py pipeline
+    determinism), of the fault-recovery determinism contract (a
+    recovered run matches an uninjected one modulo timing and fault
+    records — tests/test_faults.py), AND of the obs / trace-mode A/Bs
+    (obs on vs off, full vs deltas vs stats — tests/test_obs.py)."""
     out = []
     for rec in records:
-        if "phase" in rec or "faultEntry" in rec:
+        if any(k in rec for k in TIMING_RECORDS):
             continue
         rec = json.loads(json.dumps(rec))   # deep copy, JSON domain
         for kind, fields in TIMING_FIELDS.items():

@@ -49,10 +49,13 @@ recovery item.
 
 from __future__ import annotations
 
+import sys
 import time
 
 import numpy as np
 
+from timetabling_ga_tpu.obs import metrics as obs_metrics
+from timetabling_ga_tpu.obs.spans import NULL_TRACER
 from timetabling_ga_tpu.ops import ga
 from timetabling_ga_tpu.parallel import islands
 from timetabling_ga_tpu.runtime import jsonl
@@ -91,12 +94,20 @@ class Scheduler:
     """Drives a JobQueue through the engine's lane programs."""
 
     def __init__(self, cfg: ServeConfig, queue: JobQueue, out,
-                 now=None):
+                 now=None, tracer=NULL_TRACER):
         import jax
         self.cfg = cfg
         self.queue = queue
         self.out = out
+        self.tracer = tracer
         self._now = now or time.monotonic
+        self._dispatches = 0
+        self._overflow_warned = False
+        self._metrics = obs_metrics.REGISTRY
+        # queue occupancy is only meaningful at read time: a pull gauge
+        # sampled when the registry is snapshotted
+        self._metrics.gauge_fn("serve.queue_depth",
+                               lambda: len(queue.active()))
         self.spec = bucket_mod.BucketSpec(
             event_floor=cfg.bucket_events, room_floor=cfg.bucket_rooms,
             feature_floor=cfg.bucket_features,
@@ -126,10 +137,12 @@ class Scheduler:
 
     def admit(self, job: Job) -> None:
         """Record the admission (after queue.submit succeeds)."""
-        jsonl.job_entry(self.out, job.id, "admitted",
-                        bucket=list(job.bucket),
-                        generations=job.generations,
-                        priority=job.priority)
+        with self.tracer.span("admit", cat="serve", job=job.id):
+            jsonl.job_entry(self.out, job.id, "admitted",
+                            bucket=list(job.bucket),
+                            generations=job.generations,
+                            priority=job.priority)
+        self._metrics.counter("serve.jobs_admitted").inc()
 
     # -- one dispatch cycle --------------------------------------------
 
@@ -149,6 +162,7 @@ class Scheduler:
                     job.error = "deadline before first slice"
                     jsonl.job_entry(self.out, job.id, "failed",
                                     reason="deadline", gens=0)
+                    self._metrics.counter("serve.jobs_failed").inc()
 
     def _buckets_ready(self) -> list[tuple]:
         seen: list[tuple] = []
@@ -169,53 +183,90 @@ class Scheduler:
 
         lanes = self.cfg.lanes
         pop = self.cfg.pop_size
-        jobs = self.queue.ready(bkey)[:lanes]
-        fresh = [j for j in jobs if j.snapshot is None]
-        if fresh:
-            self._init_jobs(fresh)
-        for job in jobs:
-            if job.state != JobState.RUNNING:
-                job.state = JobState.RUNNING
+        with self.tracer.span("pack", cat="serve", bucket=list(bkey)):
+            jobs = self.queue.ready(bkey)[:lanes]
+            fresh = [j for j in jobs if j.snapshot is None]
+            if fresh:
+                self._init_jobs(fresh)
+            for job in jobs:
+                if job.state != JobState.RUNNING:
+                    job.state = JobState.RUNNING
 
-        Ep = jobs[0].padded.n_events
-        pa_stack = self._jax.tree.map(
-            lambda *ls: self._jax.numpy.stack(ls),
-            *[j.pa_dev for j in jobs],
-            *([jobs[0].pa_dev] * (lanes - len(jobs))))
-        seeds = np.zeros((lanes,), np.int32)
-        chunks = np.zeros((lanes,), np.int32)
-        gens = np.zeros((lanes,), np.int32)
-        for lane, job in enumerate(jobs):
-            seeds[lane] = job.seed
-            chunks[lane] = job.chunks
-            gens[lane] = min(self.cfg.quantum, job.remaining())
+            Ep = jobs[0].padded.n_events
+            pa_stack = self._jax.tree.map(
+                lambda *ls: self._jax.numpy.stack(ls),
+                *[j.pa_dev for j in jobs],
+                *([jobs[0].pa_dev] * (lanes - len(jobs))))
+            seeds = np.zeros((lanes,), np.int32)
+            chunks = np.zeros((lanes,), np.int32)
+            gens = np.zeros((lanes,), np.int32)
+            for lane, job in enumerate(jobs):
+                seeds[lane] = job.seed
+                chunks[lane] = job.chunks
+                gens[lane] = min(self.cfg.quantum, job.remaining())
 
         from timetabling_ga_tpu.runtime import engine
-        host0 = _stack_states([j.snapshot for j in jobs], pop, lanes, Ep)
-        state = engine.reshard_state(host0, self.mesh)
-        runner, _ = engine.cached_lane_runner(
-            self.mesh, self.gacfg, self.cfg.quantum, lanes, donate=True)
-        state, trace = runner(pa_stack, seeds, chunks, state, gens)
-        trace = np.asarray(trace)            # (lanes, quantum, 2)
-        host = engine.fetch_state(state)
-
-        now = self._now()
-        for lane, job in enumerate(jobs):
-            job.snapshot = _slice_state(host, lane, pop)
-            job.chunks += 1
-            job.gens_done += int(gens[lane])
-            for g in range(int(gens[lane])):
-                h, s = int(trace[lane, g, 0]), int(trace[lane, g, 1])
-                rep = jsonl.reported_best(h, s)
-                if rep < job.best:
-                    job.best = rep
-                if rep < job.emitted:
-                    job.emitted = rep
-                    jsonl.log_entry(self.out, 0, 0, rep,
-                                    now - job.submitted_t, job=job.id)
-            job.state = JobState.PARKED
-            if job.remaining() == 0:
-                self._finalize(job)
+        with self.tracer.span("resume", cat="serve", jobs=len(jobs)):
+            # parked host snapshots -> one stacked device placement
+            host0 = _stack_states([j.snapshot for j in jobs], pop,
+                                  lanes, Ep)
+            state = engine.reshard_state(host0, self.mesh)
+        with self.tracer.span("quantum", cat="device", jobs=len(jobs),
+                              gens=int(gens.sum())):
+            runner, _ = engine.cached_lane_runner(
+                self.mesh, self.gacfg, self.cfg.quantum, lanes,
+                donate=True, trace_mode=self.cfg.trace_mode)
+            state, trace = runner(pa_stack, seeds, chunks, state, gens)
+            trace = np.asarray(trace)   # (lanes, quantum, 2) | packed
+        with self.tracer.span("park", cat="serve", jobs=len(jobs)):
+            host = engine.fetch_state(state)
+            # the telemetry decode shared with the engine: full traces
+            # list every executed generation, compressed leaves the
+            # pre-selected improvement events — the per-job emitted
+            # floor below makes the record stream identical either way
+            events, ev_counts, _ = islands.trace_events(
+                trace, self.cfg.trace_mode)
+            if ev_counts is not None:
+                # same overflow surfacing as the engine: the count says
+                # how many improvements happened on device, the event
+                # block holds at most TRACE_DELTAS_CAP — never
+                # under-report silently
+                dropped = int(sum(max(0, int(c) - len(e))
+                                  for c, e in zip(ev_counts, events)))
+                if dropped:
+                    self._metrics.counter(
+                        "serve.trace_delta_overflow").inc(dropped)
+                    if not self._overflow_warned:
+                        self._overflow_warned = True
+                        print(f"warning: serve --trace-mode "
+                              f"{self.cfg.trace_mode} dropped {dropped}"
+                              f" improvement event(s) this dispatch "
+                              f"(cap {islands.TRACE_DELTAS_CAP}; raise "
+                              f"TT_TRACE_DELTAS_CAP)", file=sys.stderr)
+            now = self._now()
+            for lane, job in enumerate(jobs):
+                job.snapshot = _slice_state(host, lane, pop)
+                job.chunks += 1
+                job.gens_done += int(gens[lane])
+                for _g, h, s in events[lane]:
+                    rep = jsonl.reported_best(h, s)
+                    if rep < job.best:
+                        job.best = rep
+                    if rep < job.emitted:
+                        job.emitted = rep
+                        jsonl.log_entry(self.out, 0, 0, rep,
+                                        now - job.submitted_t,
+                                        job=job.id)
+                job.state = JobState.PARKED
+                if job.remaining() == 0:
+                    self._finalize(job)
+        self._dispatches += 1
+        self._metrics.counter("serve.dispatches").inc()
+        self._metrics.counter("serve.gens").inc(int(gens.sum()))
+        if (self.cfg.obs and self.cfg.metrics_every > 0
+                and self._dispatches % self.cfg.metrics_every == 0):
+            jsonl.metrics_entry(self.out, self._metrics.snapshot(),
+                                ts=self.tracer.now())
         return bool(self.queue.ready())
 
     def drive(self) -> None:
@@ -234,16 +285,17 @@ class Scheduler:
         Idle lanes replicate the first job's data and are discarded."""
         from timetabling_ga_tpu.runtime import engine
         lanes = self.cfg.lanes
-        init = engine.cached_lane_init(self.mesh, self.cfg.pop_size,
-                                       self.gacfg, n_lanes=lanes)
-        pa_stack = self._jax.tree.map(
-            lambda *ls: self._jax.numpy.stack(ls),
-            *[j.pa_dev for j in jobs],
-            *([jobs[0].pa_dev] * (lanes - len(jobs))))
-        seeds = np.zeros((lanes,), np.int32)
-        for lane, job in enumerate(jobs):
-            seeds[lane] = job.seed
-        host = engine.fetch_state(init(pa_stack, seeds))
+        with self.tracer.span("init", cat="device", jobs=len(jobs)):
+            init = engine.cached_lane_init(self.mesh, self.cfg.pop_size,
+                                           self.gacfg, n_lanes=lanes)
+            pa_stack = self._jax.tree.map(
+                lambda *ls: self._jax.numpy.stack(ls),
+                *[j.pa_dev for j in jobs],
+                *([jobs[0].pa_dev] * (lanes - len(jobs))))
+            seeds = np.zeros((lanes,), np.int32)
+            for lane, job in enumerate(jobs):
+                seeds[lane] = job.seed
+            host = engine.fetch_state(init(pa_stack, seeds))
         for lane, job in enumerate(jobs):
             job.snapshot = _slice_state(host, lane, self.cfg.pop_size)
             jsonl.job_entry(self.out, job.id, "started",
@@ -275,6 +327,8 @@ class Scheduler:
                         deadline_hit=deadline_hit)
         job.state = JobState.DONE
         job.finished_t = self._now()
+        self._metrics.counter("serve.jobs_done").inc()
+        self._metrics.histogram("serve.job_seconds").observe(total_time)
         job.result = {"best": job.best, "feasible": feasible,
                       "hcv": hcv, "scv": scv, "gens": job.gens_done,
                       "deadline_hit": deadline_hit,

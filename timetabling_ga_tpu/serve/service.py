@@ -20,12 +20,23 @@ the engine's JSONL protocol with each record tagged `"job"`, plus the
                 "seed": 42, "generations": 200, "deadline": 30.0}}
     {"submit": {"id": "j2", "tim": "4 2 2 5\\n..."}}   inline instance
     {"cancel": "j1"}
+    {"stats": true}                    live metricsEntry snapshot
+    {"stats": "prometheus"}            snapshot + Prometheus text
     {"drain": true}                    run everything admitted so far
 
 Requests are processed in order; `drain` (and end-of-input) hands the
 queue to the scheduler. A malformed request or a rejected submission
 emits a jobEntry (event "rejected") and the stream continues — one bad
 tenant must not take down the service.
+
+Observability (README "Observability"): `--obs` emits spanEntry spans
+(admit / pack / quantum / park / resume) and periodic metricsEntry
+snapshots; the `stats` request answers with a metricsEntry on the
+record stream at any time (obs or not), and `{"stats": "prometheus"}`
+embeds the registry's Prometheus text exposition in the record so a
+sidecar can relay it to a scrape endpoint. `--trace-mode deltas|stats`
+compresses the lane runner's telemetry leaf on device exactly like the
+engine's (parallel/islands.py), with an identical record stream.
 """
 
 from __future__ import annotations
@@ -33,6 +44,8 @@ from __future__ import annotations
 import json
 import sys
 
+from timetabling_ga_tpu.obs import metrics as obs_metrics
+from timetabling_ga_tpu.obs.spans import SpanTracer
 from timetabling_ga_tpu.problem import load_tim, load_tim_file
 from timetabling_ga_tpu.runtime import jsonl
 from timetabling_ga_tpu.runtime.config import ServeConfig, parse_serve_args
@@ -61,8 +74,16 @@ class SolveService:
                 out = sys.stdout
         self._raw_out = out
         self.writer = jsonl.AsyncWriter(out)
+        # obs wiring, mirroring engine.run's: spans ride the writer,
+        # the registry's writer gauges re-bind to this service's writer
+        self.tracer = SpanTracer(self.writer, enabled=cfg.obs)
+        obs_metrics.REGISTRY.gauge_fn("writer.queue_depth",
+                                      self.writer.qsize)
+        obs_metrics.REGISTRY.gauge_fn(
+            "writer.records", lambda: self.writer.records_written)
         self.queue = JobQueue(cfg.backlog, now=now)
-        self.scheduler = Scheduler(cfg, self.queue, self.writer, now=now)
+        self.scheduler = Scheduler(cfg, self.queue, self.writer,
+                                   now=now, tracer=self.tracer)
         self._auto_id = 0
 
     # -- API -------------------------------------------------------------
@@ -109,10 +130,37 @@ class SolveService:
     def state(self, job_id: str) -> str:
         return self.queue.get(job_id).state
 
+    def stats(self) -> dict:
+        """Live metrics-registry snapshot (the metricsEntry payload)."""
+        return obs_metrics.REGISTRY.snapshot()
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition of the registry (format 0.0.4)."""
+        return obs_metrics.REGISTRY.to_prometheus()
+
+    def emit_stats(self, prometheus: bool = False) -> None:
+        """Answer a `stats` request: one metricsEntry on the record
+        stream, optionally carrying the Prometheus text exposition so a
+        sidecar can relay it to a scrape endpoint."""
+        snap = self.stats()
+        if prometheus:
+            snap["prometheus"] = self.prometheus()
+        jsonl.metrics_entry(self.writer, snap, ts=self.tracer.now())
+
     def close(self) -> None:
-        self.writer.close()
-        if self._close_out:
-            self._raw_out.close()
+        try:
+            self.writer.close()
+        finally:
+            # same unbind as engine.run's finally — and like there it
+            # must run even when close() re-raises a latched writer
+            # error: drop the process-global registry's closures over
+            # this service's writer and queue
+            obs_metrics.REGISTRY.freeze(
+                "writer.records", self.writer.records_written)
+            obs_metrics.REGISTRY.freeze("writer.queue_depth", 0.0)
+            obs_metrics.REGISTRY.freeze("serve.queue_depth", 0.0)
+            if self._close_out:
+                self._raw_out.close()
 
 
 def _load_submit_problem(req: dict):
@@ -160,6 +208,8 @@ def serve_stream(cfg: ServeConfig, in_stream, out_stream=None,
                                     "rejected", reason=str(e)[:200])
             elif "cancel" in req:
                 svc.cancel(str(req["cancel"]))
+            elif "stats" in req:
+                svc.emit_stats(prometheus=req["stats"] == "prometheus")
             elif "drain" in req:
                 svc.drive()
             else:

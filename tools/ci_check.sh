@@ -28,13 +28,18 @@ step "compileall"
 python -m compileall -q timetabling_ga_tpu tests tools bench.py || fail=1
 
 if [ "${1:-}" = "--fast" ]; then
-    # fast mode still exercises the serve subsystem end-to-end: its
-    # test module is minutes not tens of minutes, and the serve stack
-    # (bucketing neutrality, compile-once, scheduler fairness) spans
-    # enough layers that a lint-only gate would miss real breakage
+    # fast mode still exercises the serve + obs subsystems end-to-end:
+    # their test modules are minutes not tens of minutes, and together
+    # they span enough layers (bucketing neutrality, compile-once,
+    # scheduler fairness, span/metrics record fencing, trace-mode
+    # stream equivalence) that a lint-only gate would miss real
+    # breakage
     step "serve tests (tests/test_serve.py)"
     timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_serve.py -q -p no:cacheprovider || fail=1
+    step "obs tests (tests/test_obs.py)"
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_obs.py -q -p no:cacheprovider || fail=1
     [ "$fail" -eq 0 ] && step "OK (fast mode: full test tier skipped)"
     exit $fail
 fi
