@@ -783,6 +783,93 @@ def measure_serve() -> dict:
     }
 
 
+def measure_scrape() -> dict:
+    """extra.scrape leg (ISSUE 6): the pull front's cost on a live
+    serve stream.
+
+    Same jobs, same seeds, three streams: an untimed warm-up (compiles
+    the bucket programs so neither timed leg pays them), listener OFF,
+    and listener ON with a 1 Hz scraper hammering /metrics + /readyz
+    from a sidecar thread the whole time. Reports the overhead per
+    dispatch and asserts the record streams are identical modulo
+    timing — a scraper must be a pure observer (obs/http.py)."""
+    import io
+    import threading
+    import urllib.request
+
+    from timetabling_ga_tpu.obs import metrics as obs_metrics
+    from timetabling_ga_tpu.problem import random_instance
+    from timetabling_ga_tpu.runtime import jsonl
+    from timetabling_ga_tpu.runtime.config import ServeConfig
+    from timetabling_ga_tpu.serve.service import SolveService
+
+    problems = [random_instance(2000 + i, n_events=80, n_rooms=8,
+                                n_features=4, n_students=50,
+                                attend_prob=0.06) for i in range(4)]
+    gens = 60
+
+    def run_stream(listen):
+        buf = io.StringIO()
+        cfg = ServeConfig(lanes=2, quantum=10, pop_size=16,
+                          max_steps=32, obs=True, metrics_every=1,
+                          obs_listen=listen)
+        svc = SolveService(cfg, out=buf)
+        stop = threading.Event()
+        n_scrapes = [0]
+        thr = None
+        if svc.obs_server is not None:
+            url = svc.obs_server.url
+
+            def scraper():
+                while not stop.is_set():
+                    for ep in ("/metrics", "/readyz"):
+                        try:
+                            urllib.request.urlopen(
+                                url + ep, timeout=2).read()
+                        except Exception:
+                            pass          # 503 /readyz is an answer
+                    n_scrapes[0] += 1
+                    stop.wait(1.0)
+
+            thr = threading.Thread(target=scraper, daemon=True)
+            thr.start()
+        d0 = obs_metrics.REGISTRY.counter("serve.dispatches").value
+        t0 = time.perf_counter()
+        for i, p in enumerate(problems):
+            svc.submit(p, generations=gens, seed=i)
+        svc.drive()
+        wall = time.perf_counter() - t0
+        disp = (obs_metrics.REGISTRY.counter("serve.dispatches").value
+                - d0)
+        stop.set()
+        if thr is not None:
+            thr.join(timeout=5)
+        svc.close()
+        recs = [json.loads(x) for x in buf.getvalue().splitlines()]
+        return wall, int(disp), jsonl.strip_timing(recs), n_scrapes[0]
+
+    run_stream(None)                              # warm-up (compiles)
+    off_wall, off_disp, off_recs, _ = run_stream(None)
+    on_wall, on_disp, on_recs, scrapes = run_stream("127.0.0.1:0")
+    out = {
+        "jobs": len(problems), "generations_per_job": gens,
+        "dispatches": on_disp,
+        "wall_s_listener_off": round(off_wall, 3),
+        "wall_s_listener_on": round(on_wall, 3),
+        "scrapes": scrapes,
+        "scrape_overhead_ms_per_dispatch": round(
+            (on_wall - off_wall) / max(1, on_disp) * 1e3, 3),
+        "records_identical_modulo_timing": off_recs == on_recs,
+    }
+    print(f"# scrape A/B ({len(problems)} jobs, {on_disp} dispatches): "
+          f"wall {off_wall:.3f}s off vs {on_wall:.3f}s on with "
+          f"{scrapes} 1 Hz scrape rounds "
+          f"({out['scrape_overhead_ms_per_dispatch']} ms/dispatch); "
+          f"records identical={out['records_identical_modulo_timing']}",
+          file=sys.stderr)
+    return out
+
+
 def measure_obs(problem, pop: int = 256, gens: int = 600) -> dict:
     """extra.obs leg (ISSUE 5): span+metrics overhead and the
     telemetry-leaf reduction, same-session A/B.
@@ -903,6 +990,7 @@ def main() -> None:
             ("pipeline", lambda: measure_pipeline(problem)),
             ("obs", lambda: measure_obs(problem)),
             ("serve", measure_serve),
+            ("scrape", measure_scrape),
             ("scale_2000ev", measure_scale),
             ("ls_shootout", lambda: measure_ls_shootout(problem)),
             ("ls_shootout_feasible",

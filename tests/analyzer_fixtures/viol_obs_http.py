@@ -1,0 +1,62 @@
+"""TT602 fixture: blocking I/O / registry mutation in handler paths.
+
+Not imported or executed — parsed by tests/test_analysis.py. The pull
+front's design rule (obs/http.py): an HTTP handler is a PURE OBSERVER —
+it reads registry snapshots/expositions and writes its own response
+socket, nothing else. Mutation (including the get-or-create accessors)
+changes the numbers every other consumer reads; foreign blocking I/O
+on a handler thread is how a listener learns to stall the run.
+"""
+import http.server
+import time
+
+from timetabling_ga_tpu.obs import metrics as obs_metrics
+
+REGISTRY = obs_metrics.REGISTRY
+
+
+class ScrapeHandler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        REGISTRY.counter("scrapes").inc()            # EXPECT TT602
+        time.sleep(0.5)                              # EXPECT TT602
+        body = self.server.registry.to_prometheus()  # OK: read-only
+        self._audit(body)
+        self._reply(200, body.encode())
+
+    def _audit(self, body):
+        # reachable via self._audit() from do_GET — still handler path
+        with open("/tmp/scrapes.log", "a") as fh:    # EXPECT TT602
+            fh.write(str(len(body)))
+        touch_gauge()
+
+    def _reply(self, status, body):
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)                       # OK: own socket
+
+
+def touch_gauge():
+    # reachable from _audit by bare-name call — still handler path;
+    # gauge() is get-or-create, a registry WRITE when the name is new
+    obs_metrics.REGISTRY.gauge("scrape.last").set(1.0)   # EXPECT TT602
+
+
+class DuckHandler:
+    """No http.server base — the `do_*` method convention alone marks
+    it a handler (duck-typed routing)."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def do_POST(self):
+        self.registry.histogram("scrape.lat")        # EXPECT TT602
+
+
+def host_side_is_fine():
+    # OK: not reachable from any handler — services and engines mutate
+    # their registry (and sleep, and open files) freely
+    obs_metrics.REGISTRY.counter("serve.jobs_done").inc()
+    time.sleep(0.001)
+    with open("/tmp/ok", "w") as fh:
+        fh.write("x")

@@ -66,6 +66,8 @@ def analyze_fixture(fixture: str):
     "viol_api.py",         # TT501 pinned API surface
     "viol_attr_api.py",    # TT502 attribute-access API pinning
     "viol_obs_clock.py",   # TT601 wall clocks / spans in trace targets
+    "viol_obs_http.py",    # TT602 blocking I/O / registry writes in
+    #                        HTTP handler paths
 ])
 def test_rule_fires_at_expected_lines(fixture):
     """Each rule family fires exactly at the marked (rule, line) pairs —

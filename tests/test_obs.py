@@ -260,9 +260,10 @@ def test_full_trace_decode_matches_layouts():
 def test_polish_runner_with_passes_is_trajectory_pure():
     """The with_passes polish program (--trace-mode stats) must return
     a bit-identical population and (penalty, hcv, scv) block — the
-    pass-count row is the ONLY difference. Pins the invariant the
-    engine-level stats A/B relies on, without the engine's
-    timing-sensitive dispatch scheduling in the loop."""
+    pass-count row and the bitcast moment rows (the tail-polish
+    endgame's streamed-moment telemetry) are the ONLY difference. Pins
+    the invariant the engine-level stats A/B relies on, without the
+    engine's timing-sensitive dispatch scheduling in the loop."""
     import jax
     from timetabling_ga_tpu.ops import ga
     from timetabling_ga_tpu.parallel import islands
@@ -280,10 +281,55 @@ def test_polish_runner_with_passes_is_trajectory_pure():
         outs[wp] = (jax.device_get(st), np.asarray(stats))
     st0, s0 = outs[False]
     st1, s1 = outs[True]
-    assert s0.shape[0] == 3 and s1.shape[0] == 4
+    assert s0.shape[0] == 3
+    assert s1.shape[0] == 4 + islands.TRACE_N_MOMENTS
     assert np.array_equal(s0, s1[:3])
     assert (s1[3] >= 1).all()            # executed >= 1 converge pass
+    # rows 4..: bitcast float32 mean/var/min/max of reported values
+    mom = np.ascontiguousarray(
+        s1[4:4 + islands.TRACE_N_MOMENTS]).view(np.float32)
+    mean, var, mn, mx = (mom[i, 0] for i in range(4))
+    assert mn <= mean <= mx and var >= 0.0
     for a, b in zip(st0, st1):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_lahc_runner_with_moments_is_trajectory_pure():
+    """The with_moments LAHC run program (--trace-mode stats on the
+    endgame) must walk the IDENTICAL trajectory: lahc_state and the
+    (penalty, hcv, scv) stats block are bit-equal with and without the
+    moment rows — which decode to sane walker-ensemble float32
+    mean/var/min/max per island. This is what makes the engine's
+    across-mode stream identity hold through the LAHC endgame."""
+    import jax
+    from timetabling_ga_tpu.ops import ga
+    from timetabling_ga_tpu.parallel import islands
+    from timetabling_ga_tpu.problem import load_tim_file
+    pa = load_tim_file(TIM).device_arrays()
+    mesh = islands.make_mesh(2)
+    cfg = ga.GAConfig(pop_size=4, ls_mode="sweep", ls_sweeps=1,
+                      ls_hot_k=4, ls_swap_block=4)
+    state = islands.init_island_population(pa, jax.random.key(3), mesh, 4)
+    outs = {}
+    for wm in (False, True):
+        init_r, run_r, fin_r = islands.make_lahc_runners(
+            mesh, cfg, hist_len=8, k_cands=2, n_islands=2,
+            with_moments=wm)
+        lstate = init_r(pa, state)
+        lstate, stats = run_r(pa, jax.random.key(9), lstate, 5)
+        outs[wm] = (jax.device_get(lstate), np.asarray(stats))
+    ls0, s0 = outs[False]
+    ls1, s1 = outs[True]
+    assert s0.shape[0] == 3
+    assert s1.shape[0] == 3 + islands.TRACE_N_MOMENTS
+    assert np.array_equal(s0, s1[:3])
+    mom = np.ascontiguousarray(
+        s1[3:3 + islands.TRACE_N_MOMENTS]).view(np.float32)
+    for isl in range(mom.shape[1]):
+        mean, var, mn, mx = mom[:, isl]
+        assert mn <= mean <= mx and var >= 0.0
+    for a, b in zip(jax.tree_util.tree_leaves(ls0),
+                    jax.tree_util.tree_leaves(ls1)):
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
@@ -512,15 +558,27 @@ def test_tt_trace_emits_wellformed_chrome_trace(obs_log, tmp_path):
     events = doc["traceEvents"]
     assert events, "no trace events exported"
     for ev in events:
-        assert ev["ph"] in ("X", "C")
+        assert ev["ph"] in ("X", "C", "s", "t", "f")
         assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
         assert "name" in ev and "pid" in ev and "tid" in ev
         if ev["ph"] == "X":
             assert ev["dur"] >= 0
+        if ev["ph"] in ("s", "t", "f"):
+            assert ev["id"] > 0       # flow chains carry their id
     phs = {ev["ph"] for ev in events}
-    assert phs == {"X", "C"}          # spans+phases AND counter tracks
+    # spans+phases, counter tracks, AND flow arrows (each dispatch
+    # chunk's dispatch->fetch->process chain carries a flow id)
+    assert phs == {"X", "C", "s", "t", "f"}, phs
     names = {ev["name"] for ev in events if ev["ph"] == "X"}
     assert "dispatch" in names
+    # every flow chain is well-formed: one s, one f, >= 0 t's
+    chains = {}
+    for ev in events:
+        if ev["ph"] in ("s", "t", "f"):
+            chains.setdefault(ev["id"], []).append(ev["ph"])
+    assert chains
+    for fid, phs_ in chains.items():
+        assert phs_.count("s") == 1 and phs_.count("f") == 1, (fid, phs_)
 
 
 def test_export_tolerates_torn_tail_line(tmp_path):
@@ -599,3 +657,456 @@ def test_engine_run_unbinds_writer_gauges(engine_baseline):
     closure over the finished run's writer (and its output stream)."""
     for name in ("writer.records", "writer.queue_depth"):
         assert obs_metrics.REGISTRY.gauge(name)._fn is None, name
+
+
+# ------------------------------------------------ exemplars + OpenMetrics
+
+
+def test_openmetrics_exemplars_and_eof():
+    """`observe(v, exemplar=...)` remembers the last exemplar per
+    bucket; to_openmetrics renders it OpenMetrics-style and ends with
+    `# EOF`; the 0.0.4 exposition ignores exemplars entirely."""
+    reg = MetricsRegistry()
+    h = reg.histogram("serve.job_seconds")
+    h.observe(0.3, exemplar={"job": "j1"})
+    h.observe(0.4, exemplar={"job": "j2"})      # same bucket: last wins
+    h.observe(40.0, exemplar={"job": 'sl"ow'})  # quote needs escaping
+    h.observe(0.02)                             # no exemplar: bucket bare
+    reg.counter("serve.jobs_done").inc(3)
+    reg.gauge("serve.queue_depth").set(1)
+    text = reg.to_openmetrics()
+    assert text.endswith("# EOF\n")
+    assert "# TYPE tt_serve_jobs_done counter" in text
+    assert "tt_serve_jobs_done_total 3" in text
+    assert 'le="0.5"} 3 # {job="j2"} 0.4' in text
+    assert '# {job="sl\\"ow"} 40' in text
+    assert '{job="j1"}' not in text             # overwritten in-bucket
+    prom = reg.to_prometheus()
+    assert "# {" not in prom and "# EOF" not in prom
+
+
+def test_histogram_exemplar_ignores_empty():
+    reg = MetricsRegistry()
+    h = reg.histogram("x")
+    h.observe(0.1, exemplar=None)
+    h.observe(0.1, exemplar={})
+    assert all(e is None for e in h._exemplars)
+
+
+# ----------------------------------------------------- pull front (http)
+
+
+def _http_get(url, timeout=5.0):
+    """(status, body, content_type) — 4xx/5xx are answers, not errors."""
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode(), r.headers.get(
+                "Content-Type", "")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(), e.headers.get(
+            "Content-Type", "")
+
+
+def test_parse_listen_specs():
+    from timetabling_ga_tpu.obs.http import parse_listen
+    assert parse_listen("127.0.0.1:9090") == ("127.0.0.1", 9090)
+    assert parse_listen("localhost:0") == ("localhost", 0)
+    for bad in ("nohost", ":9090", "h:not_a_port", "h:70000"):
+        with pytest.raises(ValueError):
+            parse_listen(bad)
+
+
+def test_run_config_rejects_bad_obs_listen():
+    from timetabling_ga_tpu.runtime.config import (
+        parse_args, parse_serve_args)
+    with pytest.raises(SystemExit):
+        parse_args(["-i", TIM, "--obs-listen", "nope"])
+    with pytest.raises(SystemExit):
+        parse_serve_args(["--obs-listen", "host:port"])
+
+
+def test_obs_server_endpoints():
+    """/metrics serves OpenMetrics (with exemplars) from the given
+    registry; /healthz reflects the probes; /readyz derives from
+    registry state alone; unknown routes 404. The handlers never write
+    a record anywhere — there is no stream to write to."""
+    from timetabling_ga_tpu.obs.http import ObsServer
+    reg = MetricsRegistry()
+    reg.histogram("serve.job_seconds").observe(
+        0.3, exemplar={"job": "jX"})
+    probe_ok = [True]
+    srv = ObsServer("127.0.0.1:0", registry=reg,
+                    probes={"writer": lambda: probe_ok[0]}).start()
+    try:
+        st, body, ctype = _http_get(srv.url + "/metrics")
+        assert st == 200
+        assert ctype.startswith("application/openmetrics-text")
+        assert "tt_serve_job_seconds_bucket" in body
+        assert '# {job="jX"} 0.3' in body
+        assert body.endswith("# EOF\n")
+
+        st, body, _ = _http_get(srv.url + "/healthz")
+        assert st == 200 and json.loads(body)["ok"] is True
+        probe_ok[0] = False
+        st, body, _ = _http_get(srv.url + "/healthz")
+        assert st == 503
+        assert json.loads(body)["probes"]["writer"] is False
+
+        # ready: no gauges set at all -> no NOT-READY condition
+        st, body, _ = _http_get(srv.url + "/readyz")
+        assert st == 200 and json.loads(body)["ready"] is True
+        # backlog full flips it
+        reg.gauge("serve.backlog").set(4)
+        reg.gauge("serve.queue_depth").set(4)
+        st, body, _ = _http_get(srv.url + "/readyz")
+        assert st == 503
+        assert "backlog_full" in json.loads(body)["reasons"]
+        reg.gauge("serve.queue_depth").set(1)
+        # degradation ladder level >= 2 flips it
+        reg.gauge("engine.degrade_level").set(2)
+        st, body, _ = _http_get(srv.url + "/readyz")
+        assert st == 503
+        assert "degraded" in json.loads(body)["reasons"]
+        reg.gauge("engine.degrade_level").set(0)
+        # exhausted recovery budget flips it (only when configured)
+        reg.gauge("engine.recovery_budget_configured").set(3)
+        reg.gauge("engine.recovery_budget_remaining").set(0)
+        st, body, _ = _http_get(srv.url + "/readyz")
+        assert st == 503
+        assert "recovery_exhausted" in json.loads(body)["reasons"]
+        reg.gauge("engine.recovery_budget_remaining").set(3)
+        st, _, _ = _http_get(srv.url + "/readyz")
+        assert st == 200
+
+        st, _, _ = _http_get(srv.url + "/nope")
+        assert st == 404
+    finally:
+        srv.close()
+    assert not srv.alive()
+
+
+def test_scrape_faults_stay_on_their_request():
+    """The `scrape` fault site (runtime/faults.py): an injected error
+    or death aborts ITS request only — the next scrape (a fresh
+    connection, a fresh daemon handler thread) succeeds, and close()
+    returns promptly either way."""
+    from timetabling_ga_tpu.obs.http import ObsServer
+    from timetabling_ga_tpu.runtime import faults
+    reg = MetricsRegistry()
+    reg.counter("x").inc()
+    for action in ("error", "die"):
+        faults.install(f"scrape:1:{action}")
+        srv = ObsServer("127.0.0.1:0", registry=reg).start()
+        try:
+            with pytest.raises(Exception):
+                # the injected failure kills the request mid-flight
+                _http_get(srv.url + "/metrics", timeout=5.0)
+            st, body, _ = _http_get(srv.url + "/metrics")
+            assert st == 200 and "tt_x_total 1" in body
+        finally:
+            srv.close()
+            faults.install(None)
+
+
+def test_scrape_hang_parks_only_its_thread():
+    """A hung handler (scrape hang sleeps for TT_FAULT_HANG_S) parks
+    ONE daemon thread: concurrent scrapes on new connections still
+    answer, and close() does not wait for the sleeper."""
+    import time as _time
+    from timetabling_ga_tpu.obs.http import ObsServer
+    from timetabling_ga_tpu.runtime import faults
+    faults.install("scrape:1:hang")
+    srv = ObsServer("127.0.0.1:0", registry=MetricsRegistry()).start()
+    try:
+        with pytest.raises(Exception):
+            _http_get(srv.url + "/healthz", timeout=0.5)   # times out
+        st, _, _ = _http_get(srv.url + "/healthz")
+        assert st == 200
+    finally:
+        t0 = _time.monotonic()
+        srv.close()
+        assert _time.monotonic() - t0 < 5.0
+        faults.install(None)
+
+
+def test_obs_listen_die_kills_only_the_listener():
+    """The `obs_listen` fault site: a death on the server thread at
+    startup takes down the accept loop and NOTHING else — the owner
+    (engine/serve) runs on; close() is safe."""
+    from timetabling_ga_tpu.obs.http import ObsServer
+    from timetabling_ga_tpu.runtime import faults
+    faults.install("obs_listen:1:die")
+    try:
+        srv = ObsServer("127.0.0.1:0", registry=MetricsRegistry())
+        srv.start()
+        srv._thread.join(timeout=5.0)
+        assert not srv.alive()
+        srv.close()                     # no deadlock on the dead loop
+    finally:
+        faults.install(None)
+
+
+# --------------------------------------------- serve + pull front, shed
+
+
+def _serve_api_run(jobs=3, scrape=False, **cfg_kw):
+    """Drive SolveService directly (step loop) so a scraper can hit the
+    pull front BETWEEN dispatches — a live run, deterministically."""
+    from timetabling_ga_tpu.problem import load_tim_file
+    from timetabling_ga_tpu.serve.service import SolveService
+    cfg = ServeConfig(backend="cpu", lanes=2, quantum=10, pop_size=8,
+                      generations=20, obs=True, metrics_every=1,
+                      **cfg_kw)
+    out = io.StringIO()
+    svc = SolveService(cfg, out=out)
+    scrapes = []
+    try:
+        prob = load_tim_file(TIM)
+        for i in range(jobs):
+            svc.submit(prob, job_id=f"sj{i}", seed=i + 1,
+                       priority=jobs - i)
+        def _scrape(ep):
+            try:
+                scrapes.append(_http_get(svc.obs_server.url + ep,
+                                         timeout=2.0))
+            except Exception as e:       # injected hang/die: the
+                scrapes.append(("failed", str(e), ""))   # run goes on
+        while svc.step():
+            if scrape and svc.obs_server is not None:
+                _scrape("/metrics")
+        if scrape and svc.obs_server is not None:
+            _scrape("/metrics")
+            _scrape("/readyz")
+    finally:
+        svc.close()
+    return ([json.loads(x) for x in out.getvalue().splitlines()],
+            scrapes, svc)
+
+
+def test_serve_obs_listen_stream_identical_with_exemplars():
+    """THE tentpole contract: a live serve run with the pull front on
+    and a scraper hitting /metrics between every dispatch emits a
+    record stream identical (modulo timing records) to a listener-off
+    run — and the scrape text carries serve_job_seconds exemplars
+    joining back to real job ids."""
+    l_off, _, _ = _serve_api_run(scrape=False)
+    l_on, scrapes, _ = _serve_api_run(scrape=True,
+                                      obs_listen="127.0.0.1:0")
+    assert jsonl.strip_timing(l_on) == jsonl.strip_timing(l_off)
+    assert scrapes
+    st, last, ctype = scrapes[-2]
+    assert st == 200 and ctype.startswith("application/openmetrics")
+    assert "tt_serve_job_seconds_bucket" in last
+    assert '# {job="sj' in last          # exemplar -> jobEntry join
+    assert last.endswith("# EOF\n")
+    st, ready, _ = scrapes[-1]
+    assert st in (200, 503)              # derived, never an error
+    done = [r["jobEntry"]["job"] for r in l_on
+            if "jobEntry" in r and r["jobEntry"]["event"] == "done"]
+    assert len(done) == 3
+
+
+def test_serve_shed_backpressure():
+    """shed_queue_hwm: while queue depth sits at/over the mark the
+    scheduler sheds the LOWEST-priority runnable work — jobEntry
+    `shed` records, serve.jobs_shed counter, SHED terminal state —
+    and the surviving job still completes."""
+    from timetabling_ga_tpu.serve.queue import JobState
+    before = obs_metrics.REGISTRY.counter("serve.jobs_shed").value
+    recs, _, svc = _serve_api_run(jobs=3, shed_queue_hwm=2)
+    shed = [r["jobEntry"] for r in recs
+            if "jobEntry" in r and r["jobEntry"]["event"] == "shed"]
+    done = [r["jobEntry"] for r in recs
+            if "jobEntry" in r and r["jobEntry"]["event"] == "done"]
+    # depth 3 >= 2 sheds sj2 (lowest priority), depth 2 >= 2 sheds
+    # sj1, depth 1 < 2 -> sj0 (highest priority) runs to completion
+    assert [s["job"] for s in shed] == ["sj2", "sj1"]
+    assert all(s["reason"] == "queue_hwm" for s in shed)
+    assert [d["job"] for d in done] == ["sj0"]
+    assert svc.state("sj2") == JobState.SHED
+    assert svc.result("sj2") is None
+    after = obs_metrics.REGISTRY.counter("serve.jobs_shed").value
+    assert after - before == 2
+
+
+def test_serve_shed_disabled_by_default():
+    recs, _, _ = _serve_api_run(jobs=2)
+    assert not any(r["jobEntry"]["event"] == "shed"
+                   for r in recs if "jobEntry" in r)
+
+
+def test_serve_run_under_scrape_faults_never_stalls():
+    """THE fault-site contract (runtime/faults.py obs_listen/scrape):
+    a live serve run scraped between dispatches while the scrape site
+    hangs one request and kills another still drives every job to
+    completion and drains its writer — the listener can fail, the
+    service cannot notice."""
+    from timetabling_ga_tpu.runtime import faults
+    faults.install("scrape:1:hang,scrape:2:die")
+    try:
+        recs, scrapes, svc = _serve_api_run(
+            jobs=2, scrape=True, obs_listen="127.0.0.1:0")
+    finally:
+        faults.install(None)
+    done = [r["jobEntry"]["job"] for r in recs
+            if "jobEntry" in r and r["jobEntry"]["event"] == "done"]
+    assert sorted(done) == ["sj0", "sj1"]
+    assert any(s[0] == "failed" for s in scrapes)    # faults did fire
+    assert any(s[0] == 200 for s in scrapes)         # ...and later
+    #                                                  scrapes recover
+
+
+# ------------------------------------------------------- flow events
+
+
+def _span(name, ts, dur, tid=0, **extra):
+    return {"spanEntry": dict(name=name, cat="serve", ts=ts, dur=dur,
+                              depth=0, tid=tid, **extra)}
+
+
+_FLOW_RECORDS = [
+    _span("admit", 0.00, 0.01, job="a", flow=1),
+    _span("admit", 0.05, 0.01, job="b", flow=2),
+    _span("pack", 0.10, 0.02, job=["a", "b"], flow=[1, 2]),
+    _span("quantum", 0.20, 0.30, tid=0, job=["a", "b"], flow=[1, 2]),
+    _span("fetch-read", 0.25, 0.01, tid=1, flow=9),   # singleton: no
+    #                                                   arrow drawn
+    _span("finalize", 0.60, 0.02, job="a", flow=1),
+    {"metricsEntry": {"ts": 0.7, "counters": {"c": 1}}},
+    {"phase": {"name": "gen-loop", "seconds": 0.5}},
+]
+
+
+def test_flow_events_connect_chains_across_spans():
+    doc = export_chrome_trace(_FLOW_RECORDS)
+    evs = doc["traceEvents"]
+    flows = [e for e in evs if e["ph"] in ("s", "t", "f")]
+    by_id = {}
+    for e in flows:
+        by_id.setdefault(e["id"], []).append(e)
+    assert set(by_id) == {1, 2}          # singleton chain 9 draws none
+    # chain 1: admit -> pack -> quantum -> finalize = s t t f, in ts
+    # order, each event INSIDE its span (midpoint binding)
+    phs1 = [e["ph"] for e in sorted(by_id[1], key=lambda e: e["ts"])]
+    assert phs1 == ["s", "t", "t", "f"]
+    assert [e["ph"] for e in sorted(by_id[2], key=lambda e: e["ts"])] \
+        == ["s", "t", "f"]
+    assert all(e.get("bp") == "e" for e in flows if e["ph"] == "f")
+    spans = {(e["name"], e["ts"]): e for e in evs if e["ph"] == "X"}
+    for e in flows:
+        inside = [s for s in spans.values()
+                  if s["tid"] == e["tid"]
+                  and s["ts"] <= e["ts"] <= s["ts"] + s["dur"]]
+        assert inside, f"flow event at {e['ts']} binds to no span"
+
+
+def test_flow_export_job_filter():
+    """--job a: only a's spans survive (scalar-tagged and packed), the
+    arrows are a's own chain (flow 1) — not co-tenant b's chain that
+    the shared pack/quantum spans also advanced — and the
+    process-global counter/phase lanes are dropped."""
+    doc = export_chrome_trace(_FLOW_RECORDS, job="a")
+    evs = doc["traceEvents"]
+    assert doc["otherData"]["job"] == "a"
+    xs = [e["name"] for e in evs if e["ph"] == "X"]
+    assert sorted(xs) == ["admit", "finalize", "pack", "quantum"]
+    assert not any(e["ph"] == "C" for e in evs)
+    flow_ids = {e["id"] for e in evs if e["ph"] in ("s", "t", "f")}
+    assert flow_ids == {1}
+    assert [e["ph"] for e in sorted(
+        (e for e in evs if e["ph"] in ("s", "t", "f")),
+        key=lambda e: e["ts"])] == ["s", "t", "t", "f"]
+
+
+def test_tt_trace_job_flag_cli(tmp_path):
+    p = tmp_path / "serve.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in _FLOW_RECORDS))
+    out = str(tmp_path / "a.json")
+    from timetabling_ga_tpu.obs.trace_export import main_trace
+    assert main_trace([str(p), "-o", out, "--job", "a"]) == 0
+    with open(out) as fh:
+        doc = json.load(fh)
+    assert {e["ph"] for e in doc["traceEvents"]} == {"X", "s", "t", "f"}
+
+
+def test_serve_log_job_flows_end_to_end():
+    """A real serve log renders one connected chain per job: every
+    lifecycle span of job sjN carries its flow id, and `tt trace
+    --job` yields exactly one s...f chain through admit -> pack ->
+    quantum -> park -> finalize."""
+    recs, _, _ = _serve_api_run(jobs=2)
+    doc = export_chrome_trace(recs, job="sj0")
+    evs = doc["traceEvents"]
+    names = {e["name"] for e in evs if e["ph"] == "X"}
+    assert {"admit", "pack", "quantum", "park"} <= names, names
+    flows = [e for e in evs if e["ph"] in ("s", "t", "f")]
+    ids = {e["id"] for e in flows}
+    assert len(ids) == 1                 # the job's own chain only
+    phs = [e["ph"] for e in sorted(flows, key=lambda e: e["ts"])]
+    assert phs[0] == "s" and phs[-1] == "f"
+    assert all(p == "t" for p in phs[1:-1])
+
+
+# ----------------------------------------------- tt stats job breakdown
+
+
+def test_stats_job_latency_breakdown():
+    recs = [
+        {"jobEntry": {"job": "a", "event": "admitted"}},
+        _span("admit", 0.0, 0.0, job="a", flow=1),
+        _span("pack", 1.0, 0.2, job=["a"], flow=[1]),
+        _span("quantum", 1.2, 2.0, job=["a"], flow=[1]),
+        _span("park", 3.2, 0.1, job=["a"], flow=[1]),
+        # 1.5s parked gap while a co-tenant holds the lanes
+        _span("resume", 4.8, 0.1, job=["a"], flow=[1]),
+        _span("quantum", 4.9, 1.0, job=["a"], flow=[1]),
+        _span("finalize", 5.9, 0.1, job="a", flow=1),
+        {"jobEntry": {"job": "a", "event": "done", "best": 3,
+                      "gens": 20}},
+    ]
+    text = summarize(recs)
+    assert "job latency breakdown" in text
+    line = next(x for x in text.splitlines()
+                if x.startswith("  a: total "))
+    assert "total 6.00s" in line
+    assert "queued 1.00" in line         # admit 0.0 -> pack 1.0
+    assert "executing 3.00" in line      # 2.0 + 1.0 quantum
+    assert "packed 0.40" in line         # pack + park + resume
+    assert "parked 1.50" in line         # the gap, minus finalize
+    assert "total: p50 6.00s p99 6.00s" in text
+
+
+def test_stats_breakdown_absent_without_spans():
+    text = summarize([{"jobEntry": {"job": "a", "event": "done"}}])
+    assert "job latency breakdown" not in text
+
+
+# --------------------------------------------- engine + pull front
+
+
+def test_engine_run_with_obs_listen_stream_identical(engine_baseline):
+    """An engine run with the pull front on emits the identical record
+    stream — the listener writes no records and shares nothing with
+    the dispatch loop but the registry lock."""
+    b0, l0 = engine_baseline
+    b, l = _engine_run(trace_mode="full", obs=True,
+                       obs_listen="127.0.0.1:0")
+    assert b == b0
+    assert jsonl.strip_timing(l) == jsonl.strip_timing(l0)
+    # the run set the /readyz source gauges on its way through
+    g = obs_metrics.REGISTRY.snapshot()["gauges"]
+    assert g.get("engine.degrade_level") == 0
+    assert g.get("engine.recovery_budget_remaining") is not None
+
+
+def test_engine_dispatch_seconds_carries_dispatch_exemplars(
+        engine_baseline):
+    """engine.dispatch_seconds observations carry the dispatch ordinal
+    as their exemplar, so a latency spike on the scrape joins back to
+    the record stream position. Instruments update with or without
+    --obs, so the baseline run already fed the process registry."""
+    text = obs_metrics.REGISTRY.to_openmetrics()
+    assert "tt_engine_dispatch_seconds_bucket" in text
+    assert '# {dispatch="' in text

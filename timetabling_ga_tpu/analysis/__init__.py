@@ -27,6 +27,10 @@ Rules (see README "Static analysis & sanitizers"):
          tracer calls inside trace targets — they execute at TRACE
          time and bake the compile's clock into the program; timing is
          host-side by design (tt-obs, README "Observability")
+  TT602  blocking I/O and MetricsRegistry mutation reachable from HTTP
+         handler code paths — the pull front's handlers (obs/http.py)
+         must only READ registry snapshots and only write their own
+         response socket; a scrape is a pure observer
 
 Suppress one finding inline with `# tt-analyze: ignore[TT301]` (on the
 line, or on a comment line directly above). Configure via
@@ -62,8 +66,8 @@ class _Context:
 
 def _rule_modules():
     from timetabling_ga_tpu.analysis import (
-        rules_api, rules_donate, rules_obs, rules_recompile, rules_rng,
-        rules_sync, rules_trace)
+        rules_api, rules_donate, rules_http, rules_obs, rules_recompile,
+        rules_rng, rules_sync, rules_trace)
     return {
         "TT101": rules_trace,
         "TT102": rules_trace,
@@ -77,6 +81,7 @@ def _rule_modules():
         "TT501": rules_api,
         "TT502": rules_api,
         "TT601": rules_obs,
+        "TT602": rules_http,
     }
 
 

@@ -21,9 +21,14 @@ JSONL record stream, never a device.
 
     python -m timetabling_ga_tpu.cli trace run.jsonl -o trace.json
         export spanEntry/phase/metricsEntry records as Chrome
-        trace-event JSON (Perfetto / chrome://tracing)
+        trace-event JSON (Perfetto / chrome://tracing), with flow
+        arrows connecting causal chains across thread lanes
+    python -m timetabling_ga_tpu.cli trace --job j42 serve.jsonl
+        one serve job's end-to-end timeline (admit -> pack -> quantum
+        -> park -> resume), co-tenant noise filtered out
     python -m timetabling_ga_tpu.cli stats run.jsonl
         summarize: best-so-far curves, recoveries, per-job latency
+        (for serve logs: queued/packed/executing/parked breakdown)
 """
 
 from __future__ import annotations

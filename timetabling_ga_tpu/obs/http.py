@@ -1,0 +1,254 @@
+"""tt-obs pull front: an opt-in localhost HTTP listener.
+
+Before this module the only way to get metrics OUT of a run was the
+push path — metricsEntry JSONL records a sidecar had to tail and relay.
+`--obs-listen HOST:PORT` (RunConfig and ServeConfig) starts a stdlib
+`http.server` on a daemon thread serving three endpoints, so Prometheus
+scrapes and k8s-style probes need no sidecar at all:
+
+  /metrics   OpenMetrics 1.0 text from the process MetricsRegistry
+             (obs/metrics.py), WITH histogram exemplars: the latest
+             `serve.job_seconds` / `engine.dispatch_seconds`
+             observation per bucket carries its `job=` / `dispatch=`
+             label, so a latency spike on the dashboard joins straight
+             back to that job's jobEntry lifecycle on the record stream
+  /healthz   process + writer-thread liveness (the `probes` dict the
+             owner registers; 503 when any probe fails)
+  /readyz    readiness derived from REGISTRY state alone: queue depth
+             vs the admission bound, the fault supervisor's degradation
+             ladder level, and the remaining recovery budget — 503
+             flips exactly when the stack is shedding or degraded
+
+Design rules (enforced by tt-analyze TT602):
+
+  - handlers only READ registry snapshots/expositions — no counter
+    bumps, no gauge writes, no get-or-create touches. A scraper must be
+    a pure observer: a scrape that mutates the registry changes the
+    numbers every OTHER consumer (metricsEntry, `tt serve` stats)
+    reads, and a scrape storm would contend the registry lock the
+    dispatch path holds.
+  - handlers do no blocking I/O beyond their own response socket. The
+    listener must never be able to stall the run it observes: it
+    shares nothing with the dispatch loop but the registry lock, held
+    only for the snapshot copy.
+
+The server is `ThreadingHTTPServer` with daemon threads and
+`block_on_close=False`: one hung handler (the `scrape` fault site's
+`hang` action — runtime/faults.py) parks its own thread and nothing
+else; close() returns without joining it. The JSONL record stream is
+byte-identical with the listener on or off — this module writes no
+records (tests/test_obs.py and bench.py `extra.scrape` pin it).
+
+Stdlib-only, like the rest of obs/: importable without JAX.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+
+from timetabling_ga_tpu.obs import metrics as obs_metrics
+from timetabling_ga_tpu.runtime import faults
+
+OPENMETRICS_CT = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+
+def parse_listen(spec: str) -> tuple[str, int]:
+    """'HOST:PORT' -> (host, port); port 0 binds an ephemeral port
+    (tests/bench). Raises ValueError on anything else."""
+    host, sep, port_s = str(spec).rpartition(":")
+    if not sep or not host:
+        raise ValueError(
+            f"--obs-listen wants HOST:PORT, got {spec!r}")
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise ValueError(
+            f"--obs-listen port must be an integer, got {port_s!r}"
+        ) from None
+    if not 0 <= port <= 65535:
+        raise ValueError(f"--obs-listen port out of range: {port}")
+    return host, port
+
+
+def readiness(registry) -> tuple[bool, dict]:
+    """Readiness decision from registry state ALONE (read-only: one
+    snapshot). Not ready when any of:
+
+      - `serve.queue_depth` >= `serve.backlog` (admission would reject
+        — new work should be routed to another replica);
+      - `engine.degrade_level` >= 2 (the fault supervisor's ladder is
+        past 'serial': the process is shrinking dispatches to survive);
+      - `engine.recovery_budget_remaining` <= 0 while recovery was
+        configured (the next transient failure aborts the run).
+
+    Absent gauges (an engine run has no serve queue; a serve process
+    may never have set the ladder) are simply not conditions."""
+    gauges = registry.snapshot().get("gauges", {})
+    reasons = []
+    depth = gauges.get("serve.queue_depth")
+    bound = gauges.get("serve.backlog")
+    if depth is not None and bound is not None and bound > 0 \
+            and depth >= bound:
+        reasons.append("backlog_full")
+    level = gauges.get("engine.degrade_level")
+    if level is not None and level >= 2:
+        reasons.append("degraded")
+    budget = gauges.get("engine.recovery_budget_remaining")
+    if budget is not None and budget <= 0 and gauges.get(
+            "engine.recovery_budget_configured", 0) > 0:
+        reasons.append("recovery_exhausted")
+    return not reasons, {"ready": not reasons, "reasons": reasons,
+                         "queue_depth": depth, "backlog": bound,
+                         "degrade_level": level,
+                         "recovery_budget_remaining": budget}
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    """GET router for the three endpoints. READ-ONLY over the registry
+    (TT602): snapshots and expositions, never instrument touches."""
+
+    # the default HTTPServer protocol closes per request; 1.1 lets a
+    # scraper keep its connection
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self):  # noqa: N802 (http.server's naming)
+        # fault-injection point (runtime/faults.py `scrape` site): a
+        # `hang` parks THIS daemon handler thread only; `die`/`error`
+        # abort this request — the serve/dispatch/writer paths never
+        # block on any of it (tests pin that)
+        try:
+            faults.maybe_fail("scrape")
+        except SystemExit:
+            # `die`: this handler ends with no response — the client
+            # sees a dropped connection, nothing else notices. Absorbed
+            # here because a SystemExit escaping the handler thread
+            # trips process-wide thread-excepthook machinery, which is
+            # exactly the cross-thread coupling the listener must not
+            # have.
+            self.close_connection = True
+            return
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = self.server.registry.to_openmetrics().encode()
+            self._reply(200, body, OPENMETRICS_CT)
+        elif path == "/healthz":
+            probes = {}
+            for name, fn in self.server.probes.items():
+                try:
+                    probes[name] = bool(fn())
+                except Exception:
+                    probes[name] = False
+            ok = all(probes.values())
+            self._reply_json(200 if ok else 503,
+                             {"ok": ok, "probes": probes})
+        elif path == "/readyz":
+            ok, detail = readiness(self.server.registry)
+            self._reply_json(200 if ok else 503, detail)
+        else:
+            self._reply_json(404, {"error": f"no route {path!r}"})
+
+    def _reply(self, status: int, body: bytes, ctype: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_json(self, status: int, obj: dict) -> None:
+        self._reply(status, json.dumps(obj).encode(),
+                    "application/json")
+
+    def log_message(self, fmt, *args):
+        """Silence the default stderr access log: the run's stderr
+        carries solver warnings, not scrape noise."""
+
+
+class _Server(http.server.ThreadingHTTPServer):
+    daemon_threads = True      # a hung handler must not survive exit
+    block_on_close = False     # ...nor block close() until it returns
+    allow_reuse_address = True
+
+    def handle_error(self, request, client_address):
+        """Silence per-request tracebacks (the default prints to
+        stderr): a failed scrape — including the `scrape` fault site's
+        die/error actions — aborts its own request and nothing else;
+        the run's stderr carries solver warnings, not scrape noise."""
+
+
+class ObsServer:
+    """The listener lifecycle: bind at construction (so the ephemeral
+    port is known immediately), serve from a daemon thread after
+    `start()`, stop on `close()`.
+
+    `probes` maps name -> zero-arg callable for /healthz (the owner
+    registers e.g. its AsyncWriter's worker liveness). The registry
+    defaults to THE process REGISTRY — the same numbers every other
+    consumer sees."""
+
+    def __init__(self, listen: str, registry=None, probes=None):
+        host, port = parse_listen(listen)
+        self._srv = _Server((host, port), _Handler)
+        self._srv.registry = (obs_metrics.REGISTRY if registry is None
+                              else registry)
+        self._srv.probes = dict(probes or {})
+        self._thread = threading.Thread(
+            target=self._serve, name="tt-obs-listen", daemon=True)
+        self._state_lock = threading.Lock()
+        self._serving = False
+        self._closed = False
+
+    @property
+    def address(self) -> tuple[str, int]:
+        """(host, port) actually bound — port is resolved for ':0'."""
+        return self._srv.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def _serve(self) -> None:
+        # fault-injection point (`obs_listen` site): a `die` here kills
+        # ONLY the accept loop — the process, and every solve path,
+        # runs on untouched
+        try:
+            faults.maybe_fail("obs_listen")
+        except SystemExit:
+            self._srv.server_close()
+            return
+        # handshake with close() under the state lock: close() may only
+        # call shutdown() once serve_forever is (about to be) running —
+        # shutdown() waits on an event ONLY serve_forever sets, so a
+        # never-started accept loop (hang/die injected above) would
+        # deadlock it. And if close() already won the race and closed
+        # the socket, entering serve_forever here would die with a
+        # ValueError on the dead descriptor — exactly the cross-thread
+        # stderr noise this module promises not to make.
+        with self._state_lock:
+            if self._closed:
+                return
+            self._serving = True
+        self._srv.serve_forever(poll_interval=0.1)
+
+    def start(self) -> "ObsServer":
+        self._thread.start()
+        return self
+
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def close(self) -> None:
+        with self._state_lock:
+            if self._closed:
+                return
+            self._closed = True
+            serving = self._serving
+        if serving:
+            try:
+                self._srv.shutdown()
+            except Exception:
+                pass
+        self._srv.server_close()
+        self._thread.join(timeout=2.0)

@@ -8,6 +8,21 @@ time-to-feasible), did the run recover from faults (sites, actions,
 degradation levels), how long did serve jobs take (per-job latency from
 their solution records), and what did the last metrics snapshot say.
 
+For serve logs recorded with `--obs`, the jobEntry lifecycle and the
+job-tagged spanEntry records additionally yield a per-job WALL-TIME
+BREAKDOWN — where each job's latency went:
+
+  queued      admission to its first pack (waiting for a lane)
+  packed      pack / resume / park spans it rode (the per-quantum
+              host-side cost of the park/resume serving model)
+  executing   its quantum spans (device time advancing the job)
+  parked      everything else between admit and finalize — sitting as
+              a host snapshot while co-tenants ran
+
+with p50/p99 across jobs per component — the numbers that say whether
+a slow service needs more lanes (queued), bigger quanta (packed), or
+faster kernels (executing).
+
 Stdlib-only and device-free, like the trace exporter.
 """
 
@@ -24,6 +39,58 @@ def _key(proc_id, job):
     return f"job {job}" if job is not None else f"island {proc_id}"
 
 
+# span taxonomy feeding the per-job breakdown (scheduler.py span names)
+_EXEC_SPANS = ("quantum",)
+_PACKED_SPANS = ("pack", "resume", "park")   # init nests inside pack
+
+
+def _pctl(vals, q):
+    """Nearest-rank percentile over a sorted list (the same estimator
+    the legacy latency line uses)."""
+    return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+
+def _job_breakdown(spans) -> dict:
+    """Per-job wall-time decomposition from job-tagged spans.
+
+    A span tagged with a job LIST (a packed dispatch advancing many
+    lanes) counts fully toward every listed job: each job really did
+    spend that wall time inside the span — concurrency, not
+    attribution error. `parked` is the remainder between admission and
+    the job's last span: time spent as a host snapshot while
+    co-tenants held the lanes."""
+    per: dict = {}
+    for s in spans:
+        j = s.get("job")
+        ids = ([str(x) for x in j] if isinstance(j, list)
+               else [str(j)] if j is not None else [])
+        for jid in ids:
+            per.setdefault(jid, []).append(s)
+    out: dict = {}
+    for jid, ss in sorted(per.items()):
+        ss = sorted(ss, key=lambda s: float(s.get("ts", 0.0)))
+        t0 = float(ss[0].get("ts", 0.0))
+        end = max(float(s.get("ts", 0.0))
+                  + max(0.0, float(s.get("dur", 0.0))) for s in ss)
+        total = max(0.0, end - t0)
+
+        def tally(names, ss=ss):
+            return sum(max(0.0, float(s.get("dur", 0.0))) for s in ss
+                       if s.get("name") in names)
+
+        executing = tally(_EXEC_SPANS)
+        packed = tally(_PACKED_SPANS)
+        first_work = next(
+            (float(s.get("ts", 0.0)) for s in ss
+             if s.get("name") in _EXEC_SPANS + _PACKED_SPANS), end)
+        queued = max(0.0, first_work - t0)
+        fin = tally(("finalize",))
+        parked = max(0.0, total - queued - packed - executing - fin)
+        out[jid] = {"total": total, "queued": queued, "packed": packed,
+                    "executing": executing, "parked": parked}
+    return out
+
+
 def summarize(records) -> str:
     """The `tt stats` report text for a list of record dicts."""
     curves: dict = {}       # stream key -> list of (best, time)
@@ -31,6 +98,7 @@ def summarize(records) -> str:
     runs = []
     faults: list = []
     jobs: dict = {}         # job id -> lifecycle events
+    spans: list = []        # spanEntry bodies (per-job breakdown)
     counts: dict = {}
     last_metrics = None
     for rec in records:
@@ -49,6 +117,9 @@ def summarize(records) -> str:
             faults.append(body)
         elif kind == "jobEntry":
             jobs.setdefault(body.get("job"), []).append(body)
+        elif kind == "spanEntry":
+            if body.get("job") is not None:
+                spans.append(body)
         elif kind == "metricsEntry":
             last_metrics = body
 
@@ -123,6 +194,23 @@ def summarize(records) -> str:
             lines.append(f"  latency p50 {p(0.5):.2f}s "
                          f"p95 {p(0.95):.2f}s max {lats[-1]:.2f}s")
 
+    breakdown = _job_breakdown(spans)
+    if breakdown:
+        lines.append(f"== job latency breakdown ({len(breakdown)} "
+                     f"jobs, from spans)")
+        for jid, b in breakdown.items():
+            lines.append(
+                f"  {jid}: total {b['total']:.2f}s = "
+                f"queued {b['queued']:.2f} + packed {b['packed']:.2f} "
+                f"+ executing {b['executing']:.2f} "
+                f"+ parked {b['parked']:.2f}")
+        for comp in ("total", "queued", "packed", "executing",
+                     "parked"):
+            vals = sorted(b[comp] for b in breakdown.values())
+            lines.append(f"  {comp}: p50 {_pctl(vals, 0.5):.2f}s "
+                         f"p99 {_pctl(vals, 0.99):.2f}s "
+                         f"max {vals[-1]:.2f}s")
+
     if last_metrics is not None:
         lines.append("== last metrics snapshot")
         for kind in ("counters", "gauges"):
@@ -145,7 +233,9 @@ def main_stats(argv) -> int:
             print("usage: tt stats <log.jsonl>\n\n"
                   "summarize a JSONL record stream: best-so-far curves, "
                   "time-to-feasible, recoveries and fault sites, per-job "
-                  "latency, last metrics snapshot")
+                  "latency (serve+obs logs: queued/packed/executing/"
+                  "parked breakdown, p50/p99 across jobs), last metrics "
+                  "snapshot")
             return 0
         if inp is None:
             inp = a

@@ -115,22 +115,31 @@ class Histogram:
     semantics) plus an implicit +Inf bucket. `percentile(q)` linearly
     interpolates within the target bucket's bounds — exact enough for
     p50/p95 dashboards at log-spaced resolution, with O(1) memory
-    (no reservoir: serve streams are unbounded)."""
+    (no reservoir: serve streams are unbounded).
+
+    Exemplars (OpenMetrics): `observe(v, exemplar={"job": "j42"})`
+    remembers the LAST exemplar landing in each bucket — one
+    (labels, value) pair per bucket, O(buckets) memory. A p99 spike on
+    the scrape dashboard then joins back to the concrete job/dispatch
+    that caused it (its jobEntry lifecycle is on the record stream
+    under the same id); `to_openmetrics` renders them, the 0.0.4 text
+    exposition ignores them (no exemplar syntax there)."""
 
     __slots__ = ("name", "buckets", "_counts", "count", "sum",
-                 "_min", "_max", "_lock")
+                 "_min", "_max", "_exemplars", "_lock")
 
     def __init__(self, name: str, lock: threading.Lock, buckets=None):
         self.name = name
         self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
         self._counts = [0] * (len(self.buckets) + 1)
+        self._exemplars: list = [None] * (len(self.buckets) + 1)
         self.count = 0
         self.sum = 0.0
         self._min = math.inf
         self._max = -math.inf
         self._lock = lock
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: dict | None = None) -> None:
         v = float(v)
         with self._lock:
             i = 0
@@ -144,6 +153,9 @@ class Histogram:
             self.sum += v
             self._min = min(self._min, v)
             self._max = max(self._max, v)
+            if exemplar:
+                self._exemplars[i] = (
+                    {str(k): str(w) for k, w in exemplar.items()}, v)
 
     def percentile(self, q: float) -> float:
         """Estimated q-quantile (q in [0, 1]); nan when empty."""
@@ -255,29 +267,78 @@ class MetricsRegistry:
     def to_prometheus(self, prefix: str = "tt") -> str:
         """Prometheus text exposition (format 0.0.4): counters as
         `<prefix>_<name>_total`, gauges plain, histograms as the
-        standard `_bucket{le=...}` / `_sum` / `_count` triplet."""
+        standard `_bucket{le=...}` / `_sum` / `_count` triplet.
+
+        Rendered UNDER the registry lock (one lock shared by every
+        instrument): the pull front scrapes from its own handler
+        threads, and a histogram read racing observe() could otherwise
+        emit `x_count` != its `+Inf` bucket — invalid exposition a
+        strict parser rejects. Render cost is O(metrics) string ops;
+        pull-gauge sources must not touch the registry (none do — they
+        read queue sizes)."""
         lines: list[str] = []
         with self._lock:
-            items = sorted(self._metrics.items())
-        for name, m in items:
-            pn = _prom_name(f"{prefix}.{name}")
-            if isinstance(m, Counter):
-                lines.append(f"# TYPE {pn}_total counter")
-                lines.append(f"{pn}_total {_prom_num(m.value)}")
-            elif isinstance(m, Gauge):
-                lines.append(f"# TYPE {pn} gauge")
-                lines.append(f"{pn} {_prom_num(m.value)}")
-            else:
-                lines.append(f"# TYPE {pn} histogram")
-                cum = 0
-                for i, b in enumerate(m.buckets):
-                    cum += m._counts[i]
-                    lines.append(f'{pn}_bucket{{le="{_prom_num(b)}"}} '
-                                 f"{cum}")
-                lines.append(f'{pn}_bucket{{le="+Inf"}} {m.count}')
-                lines.append(f"{pn}_sum {_prom_num(m.sum)}")
-                lines.append(f"{pn}_count {m.count}")
+            for name, m in sorted(self._metrics.items()):
+                pn = _prom_name(f"{prefix}.{name}")
+                if isinstance(m, Counter):
+                    lines.append(f"# TYPE {pn}_total counter")
+                    lines.append(f"{pn}_total {_prom_num(m.value)}")
+                elif isinstance(m, Gauge):
+                    lines.append(f"# TYPE {pn} gauge")
+                    lines.append(f"{pn} {_prom_num(m.value)}")
+                else:
+                    lines.append(f"# TYPE {pn} histogram")
+                    cum = 0
+                    for i, b in enumerate(m.buckets):
+                        cum += m._counts[i]
+                        lines.append(
+                            f'{pn}_bucket{{le="{_prom_num(b)}"}} {cum}')
+                    lines.append(f'{pn}_bucket{{le="+Inf"}} {m.count}')
+                    lines.append(f"{pn}_sum {_prom_num(m.sum)}")
+                    lines.append(f"{pn}_count {m.count}")
         return "\n".join(lines) + ("\n" if lines else "")
+
+    def to_openmetrics(self, prefix: str = "tt") -> str:
+        """OpenMetrics 1.0 text exposition — what the pull front's
+        `/metrics` endpoint serves (obs/http.py). Same sample names as
+        `to_prometheus` plus histogram bucket EXEMPLARS
+        (`... # {job="j42"} 0.93`) and the mandatory `# EOF` trailer.
+        Counters drop the `_total` suffix from the metric NAME line
+        (OpenMetrics: the family is `x`, the sample `x_total`).
+
+        Rendered under the registry lock, like `to_prometheus` (and
+        more urgently: this IS the scrape endpoint's payload, read
+        from handler threads while the dispatch path observes)."""
+        lines: list[str] = []
+        with self._lock:
+            for name, m in sorted(self._metrics.items()):
+                pn = _prom_name(f"{prefix}.{name}")
+                if isinstance(m, Counter):
+                    lines.append(f"# TYPE {pn} counter")
+                    lines.append(f"{pn}_total {_prom_num(m.value)}")
+                elif isinstance(m, Gauge):
+                    lines.append(f"# TYPE {pn} gauge")
+                    lines.append(f"{pn} {_prom_num(m.value)}")
+                else:
+                    lines.append(f"# TYPE {pn} histogram")
+                    cum = 0
+                    bounds = ([_prom_num(b) for b in m.buckets]
+                              + ["+Inf"])
+                    for i, le in enumerate(bounds):
+                        cum += m._counts[i]
+                        line = f'{pn}_bucket{{le="{le}"}} {cum}'
+                        ex = m._exemplars[i]
+                        if ex is not None:
+                            labels, v = ex
+                            lbl = ",".join(
+                                f'{k}="{_escape_label(w)}"'
+                                for k, w in sorted(labels.items()))
+                            line += f" # {{{lbl}}} {_prom_num(v)}"
+                        lines.append(line)
+                    lines.append(f"{pn}_sum {_prom_num(m.sum)}")
+                    lines.append(f"{pn}_count {m.count}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
         """Drop every instrument (tests only — production code keeps
@@ -291,6 +352,13 @@ _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
 def _prom_name(name: str) -> str:
     return _NAME_RE.sub("_", name)
+
+
+def _escape_label(v: str) -> str:
+    """Label-value escaping per the exposition formats (backslash,
+    double quote, newline)."""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def _prom_num(v: float) -> str:

@@ -23,6 +23,18 @@ predictor) — re-timing it would drift from the numbers the engine
 acts on. `t0` is a raw `time.monotonic()` value; the tracer converts
 to its epoch-relative timeline.
 
+Flow ids (tt-obs v2, causal tracing): `new_flow()` allocates a small
+process-unique id; spans that belong to one causal chain carry it as a
+`flow=` attribute (an int, or a list when one span serves several
+chains — a packed serve dispatch advancing many jobs). Flows are how a
+trace crosses THREAD boundaries: the engine's dispatch (main thread) →
+the fetch watchdog's read (tt-fetch-watchdog) → the writer's checkpoint
+serialization (tt-jsonl-writer) render as connected arrows in Perfetto
+(`tt trace` exports them as `s`/`t`/`f` flow events), and every span of
+a serve job's life — admit → pack → quantum → park → resume → finalize
+— shares the job's flow id so `tt trace --job ID` shows one end-to-end
+timeline.
+
 Clock discipline: all timestamps are `time.monotonic()` offsets from
 the tracer's construction epoch — monotone, NTP-immune, and cheap.
 Spans are HOST-side only: a wall-clock read inside a jitted function
@@ -60,6 +72,23 @@ class SpanTracer:
         self._local = threading.local()
         self._tids: dict[int, int] = {}
         self._tid_lock = threading.Lock()
+        self._next_flow = 0
+
+    # -- flows ----------------------------------------------------------
+
+    def new_flow(self) -> int:
+        """Allocate a flow id for one causal chain (a dispatch's
+        enqueue→fetch→process life, a serve job's admit→...→finalize).
+        Spans of the chain carry it as `flow=<id>` (or `flow=[ids]` when
+        one span advances several chains); `tt trace` turns shared ids
+        into Perfetto flow arrows across thread lanes. Returns 0 when
+        the tracer is disabled — callers thread the id through
+        unconditionally and the no-op spans discard it."""
+        if not self.enabled:
+            return 0
+        with self._tid_lock:
+            self._next_flow += 1
+            return self._next_flow
 
     # -- clocks ---------------------------------------------------------
 

@@ -1,6 +1,7 @@
 """`tt trace` — export a JSONL log's spans as Chrome trace-event JSON.
 
     tt trace run.jsonl -o trace.json
+    tt trace --job j42 serve.jsonl -o j42.json
 
 The output is the Trace Event Format's "JSON object" flavor
 ({"traceEvents": [...]}) loadable in Perfetto / chrome://tracing, so a
@@ -11,6 +12,15 @@ timeline. Mapping:
   spanEntry    -> complete event (ph "X"): ts/dur in microseconds,
                   tid = the tracer's per-thread lane, args = every
                   extra attribute the span carried
+  flow= attrs  -> Perfetto flow events (ph "s"/"t"/"f"): spans sharing
+                  a flow id (SpanTracer.new_flow — one causal chain:
+                  a dispatch's dispatch→fetch-read→process life across
+                  the watchdog thread, a checkpoint's enqueue→write
+                  handoff onto the writer thread, a serve job's
+                  admit→pack→quantum→park→resume→finalize) render as
+                  connected arrows across thread lanes. A span whose
+                  `flow` is a LIST (a packed serve dispatch advancing
+                  many jobs) participates in every listed chain.
   phase        -> complete event on its own lane ("phases"): the legacy
                   `--trace` records have no start timestamp, so they
                   are laid end-to-end in record order — coarse, but it
@@ -18,6 +28,13 @@ timeline. Mapping:
   metricsEntry -> counter events (ph "C") for every numeric counter/
                   gauge, at the snapshot's `ts` — Perfetto renders
                   them as tracks (gens/sec, queue depth over time)
+
+`--job ID` filters to ONE job's causal trace: the spans tagged
+`job=ID` (scalar, or carrying ID in a packed dispatch's job list),
+connected by the job's own flow chain — its end-to-end
+admit→pack→quantum→park→resume→finalize timeline across lanes, parks,
+and co-tenant dispatches, without the other tenants' noise. Counter
+tracks and phase lanes are process-global, so job mode drops them.
 
 Stdlib-only and device-free: exporting a log must work on any machine
 the log was copied to.
@@ -54,16 +71,76 @@ def _counter_events(rec: dict) -> list[dict]:
     return out
 
 
-def export_chrome_trace(records) -> dict:
-    """JSONL record dicts -> Chrome trace-event JSON object."""
+def _flow_ids(e: dict) -> list[int]:
+    """A span's flow memberships: `flow` is an int, or a list when one
+    span advances several causal chains (a packed serve dispatch).
+    0/None entries mean 'no chain' (a disabled tracer's new_flow)."""
+    f = e.get("flow")
+    ids = f if isinstance(f, list) else [f]
+    return [int(i) for i in ids
+            if isinstance(i, (int, float)) and int(i) > 0]
+
+
+def _span_matches_job(e: dict, job: str) -> bool:
+    j = e.get("job")
+    if isinstance(j, list):
+        return job in [str(x) for x in j]
+    return j is not None and str(j) == job
+
+
+def _flow_events(spans: list[dict], only=None) -> list[dict]:
+    """Perfetto flow events binding spans that share a flow id.
+
+    The event timestamp sits at the MIDDLE of its span: flow events
+    bind to the slice open at their ts on that thread lane, and the
+    midpoint is inside the slice regardless of how sub-microsecond
+    rounding moved its edges. Chain members are ORDERED by that same
+    midpoint — not by span start — so the emitted `s` (first), `t`
+    (steps), `f` (finish, bp="e") sequence is monotone in the
+    timestamps it carries even when one member nests inside an
+    earlier-starting sibling (a serve job's `finalize` runs inside the
+    scheduler's `park` span). Chains with a single member draw no
+    arrow — there is nothing to connect. `only` restricts to a set of
+    chain ids (the --job view draws the job's own chain, not every
+    co-tenant chain its packed dispatches also advanced)."""
+    chains: dict[int, list[dict]] = {}
+    for e in spans:
+        for fid in _flow_ids(e):
+            chains.setdefault(fid, []).append(e)
+    out = []
+    for fid, members in sorted(chains.items()):
+        if len(members) < 2 or (only is not None and fid not in only):
+            continue
+        mids = sorted(((float(e.get("ts", 0.0))
+                        + max(0.0, float(e.get("dur", 0.0))) / 2.0, e)
+                       for e in members), key=lambda t: t[0])
+        last = len(mids) - 1
+        for i, (mid, e) in enumerate(mids):
+            ev = {"name": "flow", "cat": "flow",
+                  "ph": "s" if i == 0 else ("f" if i == last else "t"),
+                  "id": fid, "pid": 0, "tid": int(e.get("tid", 0)),
+                  "ts": round(mid * 1e6, 3)}
+            if i == last:
+                ev["bp"] = "e"     # bind to the enclosing slice
+            out.append(ev)
+    return out
+
+
+def export_chrome_trace(records, job: str | None = None) -> dict:
+    """JSONL record dicts -> Chrome trace-event JSON object.
+
+    `job` filters to one serve job's causal trace (see module
+    docstring): its tagged spans, every span sharing its flow ids, and
+    their flow arrows only."""
+    spans: list[dict] = []
     events: list[dict] = []
     phase_t = 0.0
     for rec in records:
         if "spanEntry" in rec:
-            events.append(_span_event(rec["spanEntry"]))
-        elif "metricsEntry" in rec:
+            spans.append(rec["spanEntry"])
+        elif job is None and "metricsEntry" in rec:
             events.extend(_counter_events(rec["metricsEntry"]))
-        elif "phase" in rec:
+        elif job is None and "phase" in rec:
             p = rec["phase"]
             dur = max(0.0, float(p.get("seconds", 0.0)))
             args = {k: v for k, v in p.items()
@@ -73,9 +150,27 @@ def export_chrome_trace(records) -> dict:
                            "ts": round(phase_t * 1e6, 3),
                            "dur": round(dur * 1e6, 3), "args": args})
             phase_t += dur
-    return {"traceEvents": events, "displayTimeUnit": "ms",
-            "otherData": {"source": "tt trace",
-                          "format": "timetabling_ga_tpu JSONL"}}
+    only = None
+    if job is not None:
+        job = str(job)
+        spans = [e for e in spans if _span_matches_job(e, job)]
+        # the job's OWN chain: the flow id its exclusively-tagged spans
+        # (admit / shed / finalize — scalar job=) carry. Packed spans
+        # also list the co-tenants' chain ids; drawing those would wire
+        # the job's timeline to arrows about other tenants. Fallback to
+        # every chain among the kept spans when no scalar tag survived
+        # (a torn log that lost the admit record).
+        only = {fid for e in spans
+                if not isinstance(e.get("job"), list)
+                for fid in _flow_ids(e)} or None
+    events = [_span_event(e) for e in spans] \
+        + _flow_events(spans, only=only) + events
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"source": "tt trace",
+                         "format": "timetabling_ga_tpu JSONL"}}
+    if job is not None:
+        doc["otherData"]["job"] = job
+    return doc
 
 
 def read_jsonl(path: str) -> list[dict]:
@@ -94,20 +189,28 @@ def read_jsonl(path: str) -> list[dict]:
 
 
 def main_trace(argv) -> int:
-    """`tt trace <log.jsonl> [-o trace.json]` entry point."""
-    inp, out = None, None
+    """`tt trace <log.jsonl> [-o trace.json] [--job ID]` entry point."""
+    inp, out, job = None, None, None
     i = 0
     while i < len(argv):
         a = argv[i]
         if a in ("-h", "--help"):
-            print("usage: tt trace <log.jsonl> [-o trace.json]\n\n"
+            print("usage: tt trace <log.jsonl> [-o trace.json] "
+                  "[--job ID]\n\n"
                   "export spanEntry/phase/metricsEntry records as "
-                  "Chrome trace-event JSON (Perfetto / chrome://tracing)")
+                  "Chrome trace-event JSON (Perfetto / chrome://tracing)"
+                  "\nwith flow arrows connecting causal chains across "
+                  "thread lanes; --job ID renders one serve job's\n"
+                  "end-to-end timeline (admit -> pack -> quantum -> "
+                  "park -> resume) and nothing else")
             return 0
-        if a == "-o":
+        if a in ("-o", "--job"):
             if i + 1 >= len(argv):
-                raise SystemExit("flag -o needs a value")
-            out = argv[i + 1]
+                raise SystemExit(f"flag {a} needs a value")
+            if a == "-o":
+                out = argv[i + 1]
+            else:
+                job = argv[i + 1]
             i += 2
             continue
         if inp is None:
@@ -116,13 +219,15 @@ def main_trace(argv) -> int:
             continue
         raise SystemExit(f"unknown argument: {a}")
     if inp is None:
-        raise SystemExit("usage: tt trace <log.jsonl> [-o trace.json]")
-    doc = export_chrome_trace(read_jsonl(inp))
+        raise SystemExit("usage: tt trace <log.jsonl> [-o trace.json] "
+                         "[--job ID]")
+    doc = export_chrome_trace(read_jsonl(inp), job=job)
     if out is None:
         out = inp + ".trace.json"
     with open(out, "w", encoding="utf-8") as fh:
         json.dump(doc, fh)
     n = len(doc["traceEvents"])
-    print(f"tt trace: {n} event{'s' if n != 1 else ''} -> {out}",
+    tag = f" (job {job})" if job is not None else ""
+    print(f"tt trace: {n} event{'s' if n != 1 else ''}{tag} -> {out}",
           file=sys.stderr)
     return 0

@@ -339,6 +339,41 @@ TRACE_MODES = ("full", "deltas", "stats")
 TRACE_N_MOMENTS = 4
 
 
+def _reported_f32(hcv, scv):
+    """The protocol's reported value as one float32 scalar per entry:
+    scv alone once feasible, else hcv*1e6 + scv — the lex order
+    flattened onto a single axis so streamed moments can average it
+    (jsonl.reported_best is the int-domain twin)."""
+    return jnp.where(hcv == 0, scv.astype(jnp.float32),
+                     hcv.astype(jnp.float32) * 1e6
+                     + scv.astype(jnp.float32))
+
+
+def _moment_rows(rep, axis=None, where=None):
+    """TRACE_N_MOMENTS bitcast-int32 rows of (mean, var, min, max) of
+    `rep` over `axis` — THE stats-mode moment layout every consumer
+    decodes (engine reads them back with `.view(np.float32)`). `where`
+    selects the mask-weighted variant (the compressed-trace leaf's
+    valid-generation mask); var is clamped at 0 against fp cancellation
+    either way."""
+    if where is None:
+        mean = jnp.mean(rep, axis=axis)
+        var = jnp.maximum(jnp.mean(rep * rep, axis=axis) - mean * mean,
+                          0.0)
+        mn = jnp.min(rep, axis=axis)
+        mx = jnp.max(rep, axis=axis)
+    else:
+        w = where.astype(jnp.float32)
+        n = jnp.maximum(jnp.sum(w, axis=axis), 1.0)
+        mean = jnp.sum(rep * w, axis=axis) / n
+        var = jnp.maximum(jnp.sum(rep * rep * w, axis=axis) / n
+                          - mean * mean, 0.0)
+        mn = jnp.min(jnp.where(where, rep, jnp.inf), axis=axis)
+        mx = jnp.max(jnp.where(where, rep, -jnp.inf), axis=axis)
+    return lax.bitcast_convert_type(jnp.stack([mean, var, mn, mx]),
+                                    jnp.int32)
+
+
 def trace_leaf_width(n_gens: int, trace_mode: str) -> int:
     """Packed telemetry columns per island for a compressed trace:
     K events x (gen, hcv, scv) + the improvement count [+ moments]."""
@@ -398,18 +433,7 @@ def _compress_trace(trace, n_valid, trace_mode: str):
         ev = jnp.full((K + 1, 3), _SENTINEL, jnp.int32).at[idx].set(rows)
         parts = [ev[:K].reshape(-1), n_imp[None]]
         if trace_mode == "stats":
-            repf = jnp.where(h == 0, s.astype(jnp.float32),
-                             h.astype(jnp.float32) * 1e6
-                             + s.astype(jnp.float32))
-            w = valid.astype(jnp.float32)
-            n = jnp.maximum(jnp.sum(w), 1.0)
-            mean = jnp.sum(repf * w) / n
-            var = jnp.maximum(jnp.sum(repf * repf * w) / n
-                              - mean * mean, 0.0)
-            mn = jnp.min(jnp.where(valid, repf, jnp.inf))
-            mx = jnp.max(jnp.where(valid, repf, -jnp.inf))
-            parts.append(lax.bitcast_convert_type(
-                jnp.stack([mean, var, mn, mx]), jnp.int32))
+            parts.append(_moment_rows(_reported_f32(h, s), where=valid))
         return jnp.concatenate(parts)
 
     return jax.vmap(one)(trace, nv)
@@ -479,9 +503,13 @@ def make_polish_runner(mesh: Mesh, cfg: ga.GAConfig,
 
     with_passes=True (tt-obs `--trace-mode stats`) appends one extra
     stats ROW carrying each device's executed sweep-pass count
-    (sweep_local_search return_passes): the on-device convergence
-    signal rides the same single fetch. The trajectory is untouched —
-    the determinism A/Bs across trace modes depend on that."""
+    (sweep_local_search return_passes), then TRACE_N_MOMENTS rows of
+    bitcast float32 moments (mean/var/min/max of the polished
+    population's reported values across the device's shard rows) — the
+    polish/tail-polish endgame ships the same streamed-moment telemetry
+    as the stats-mode generation runners, on the same single fetch. The
+    trajectory is untouched — the determinism A/Bs across trace modes
+    depend on that."""
     L = local_islands(mesh, n_islands)
     pop = cfg.pop_size
 
@@ -510,13 +538,20 @@ def make_polish_runner(mesh: Mesh, cfg: ga.GAConfig,
             lambda b: ga.evaluate(pa, b.slots, b.rooms))(sb))
         stats = jnp.stack([st.penalty, st.hcv, st.scv])
         if with_passes:
-            # one extra stats ROW with the device's pass count broadcast
-            # across its columns: rows are the unsharded axis, so the
-            # global array stays a clean (4, n_islands*pop) — the host
-            # reads row 3 and slices it off before its (3, ...) reshape
+            # extra stats ROWS, broadcast across the device's columns:
+            # rows are the unsharded axis, so the global array stays a
+            # clean (3+1+4, n_islands*pop) — the host reads row 3
+            # (pass count) and rows 4.. (bitcast float32 moments of the
+            # polished population's reported values) and slices them
+            # off before its (3, ...) protocol reshape
+            cols = stats.shape[1]
             stats = jnp.concatenate(
-                [stats, jnp.full((1, stats.shape[1]), out[2],
-                                 jnp.int32)], axis=0)
+                [stats, jnp.full((1, cols), out[2], jnp.int32)], axis=0)
+            mom = _moment_rows(_reported_f32(st.hcv, st.scv))
+            stats = jnp.concatenate(
+                [stats, jnp.broadcast_to(mom[:, None],
+                                         (TRACE_N_MOMENTS, cols))],
+                axis=0)
         return st, stats
 
     return _donate(_polish, donate, 2)
@@ -641,7 +676,7 @@ def _lahc_specs():
 
 def make_lahc_runners(mesh: Mesh, cfg: ga.GAConfig, hist_len: int,
                       k_cands: int = 1, n_islands: int = None,
-                      donate: bool = False):
+                      donate: bool = False, with_moments: bool = False):
     """Late-Acceptance Hill Climbing endgame programs (ops/lahc.py):
 
       init(pa, state)              -> lahc_state   (walkers = pop rows)
@@ -656,7 +691,15 @@ def make_lahc_runners(mesh: Mesh, cfg: ga.GAConfig, hist_len: int,
     best snapshots as a lex-sorted PopState, so the endTry fetch reads
     it exactly like a GA population. Walkers are per-island independent;
     no migration runs during LAHC (each walker is its own chain — the
-    diversity is the walker ensemble, seeded from the elite rows)."""
+    diversity is the walker ensemble, seeded from the elite rows).
+
+    with_moments=True (tt-obs `--trace-mode stats`) appends
+    TRACE_N_MOMENTS rows of bitcast float32 walker-ensemble moments
+    (mean/var/min/max of each island's per-walker best-so-far reported
+    values) to the run program's stats — the LAHC endgame ships the
+    same streamed-moment telemetry as the stats-mode generation
+    runners, on the same single fetch, with the walker trajectory
+    untouched (the across-mode determinism A/B pins it)."""
     from timetabling_ga_tpu.ops import lahc as lahc_ops
     L = local_islands(mesh, n_islands)
     pop = cfg.pop_size
@@ -684,6 +727,10 @@ def make_lahc_runners(mesh: Mesh, cfg: ga.GAConfig, hist_len: int,
         idx = jax.vmap(lambda p_, s_: fitness.lex_order(p_, s_)[0])(bp, bs)
         la = jnp.arange(L)
         stats = jnp.stack([bp[la, idx], bh[la, idx], bs[la, idx]])
+        if with_moments:
+            # (L, pop) walker reported values -> (4, L) moment rows
+            mom = _moment_rows(_reported_f32(bh, bs), axis=1)
+            stats = jnp.concatenate([stats, mom], axis=0)
         return lstate, stats
 
     @functools.partial(

@@ -74,6 +74,13 @@ Observability (README "Observability"; timetabling_ga_tpu/obs):
                           the emitted record stream is identical
     --metrics-every <n>   dispatches between metricsEntry snapshots
                           under --obs (0 = end-of-try only)
+    --obs-listen <h:p>    opt-in localhost pull front (obs/http.py): a
+                          stdlib HTTP listener on a daemon thread
+                          serving /metrics (OpenMetrics with histogram
+                          exemplars), /healthz (process + writer
+                          liveness) and /readyz (registry-derived
+                          readiness) — no sidecar needed; the JSONL
+                          record stream is identical with it on or off
 """
 
 from __future__ import annotations
@@ -190,6 +197,12 @@ class RunConfig:
     metrics_every: int = 10   # dispatches between metricsEntry
     #                           snapshots under --obs (0 = only the
     #                           end-of-try snapshot)
+    obs_listen: Optional[str] = None  # HOST:PORT of the opt-in pull
+    #                           front (obs/http.py ObsServer): /metrics
+    #                           OpenMetrics + exemplars, /healthz,
+    #                           /readyz — a daemon-thread listener that
+    #                           shares nothing with the dispatch loop
+    #                           but the registry lock (None = off)
     trace_profile: Optional[str] = None  # capture a jax.profiler trace of
     #                           one mid-run dispatch into this directory
     #                           (SURVEY section 5 tracing; view with
@@ -392,6 +405,7 @@ _FLAG_MAP = {
     "--trace-profile": ("trace_profile", str),
     "--trace-mode": ("trace_mode", str),
     "--metrics-every": ("metrics_every", int),
+    "--obs-listen": ("obs_listen", str),
     "--max-recoveries": ("max_recoveries", int),
     "--fetch-timeout": ("fetch_timeout", float),
     "--faults": ("faults", str),
@@ -464,6 +478,19 @@ def _parse_flag_stream(argv, cfg, flag_map, usage_fn,
     return seen
 
 
+def _validate_obs_listen(spec) -> None:
+    """Fail the parse, not the run, on a malformed --obs-listen (the
+    pull front's own parse_listen is the single source of truth; local
+    import keeps this module's import surface flag-parsing-only)."""
+    if spec is None:
+        return
+    from timetabling_ga_tpu.obs.http import parse_listen
+    try:
+        parse_listen(spec)
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+
+
 def _usage() -> str:
     return _format_usage(
         ["usage: python -m timetabling_ga_tpu -i <instance.tim> "
@@ -495,6 +522,7 @@ def parse_args(argv) -> RunConfig:
     if cfg.metrics_every < 0:
         raise SystemExit("--metrics-every must be >= 0 dispatches "
                          "(0 = only the end-of-try snapshot)")
+    _validate_obs_listen(cfg.obs_listen)
     if cfg.coordinator is not None and (cfg.num_processes is None
                                         or cfg.process_id is None):
         raise SystemExit("--coordinator requires --num-processes and "
@@ -578,6 +606,24 @@ class ServeConfig:
     #                               (full | deltas | stats)
     metrics_every: int = 10       # dispatches between metricsEntry
     #                               snapshots under --obs
+    obs_listen: Optional[str] = None  # HOST:PORT pull front (/metrics
+    #                               with exemplars, /healthz, /readyz) —
+    #                               same semantics as RunConfig's
+    # ---- admission/backpressure (the scheduler reads its own metrics
+    # registry at every control fence and sheds the lowest-priority
+    # runnable work while a depth is at/over its high-water mark;
+    # jobEntry event "shed" + counter serve.jobs_shed surface it):
+    shed_queue_hwm: int = 0       # serve.queue_depth high-water mark
+    #                               (0 = never shed on queue depth)
+    shed_writer_hwm: int = 0      # writer.queue_depth high-water mark:
+    #                               a record stream nobody drains is the
+    #                               other way a service drowns
+    #                               (0 = never shed on writer depth)
+    faults: Optional[str] = None  # deterministic fault-injection plan
+    #                               (runtime/faults.py grammar — the
+    #                               serve-relevant sites are writer,
+    #                               obs_listen, scrape); None reads
+    #                               $TT_FAULTS, like the engine
 
 
 _SERVE_FLAG_MAP = {
@@ -599,6 +645,10 @@ _SERVE_FLAG_MAP = {
     "--ls-candidates": ("ls_candidates", int),
     "--trace-mode": ("trace_mode", str),
     "--metrics-every": ("metrics_every", int),
+    "--obs-listen": ("obs_listen", str),
+    "--shed-queue-hwm": ("shed_queue_hwm", int),
+    "--shed-writer-hwm": ("shed_writer_hwm", int),
+    "--faults": ("faults", str),
 }
 
 _SERVE_BOOL_FLAGS = {"--obs": "obs"}
@@ -625,6 +675,10 @@ def parse_serve_args(argv) -> ServeConfig:
                          f"(one of {', '.join(TRACE_MODES)})")
     if cfg.metrics_every < 0:
         raise SystemExit("--metrics-every must be >= 0 dispatches")
+    _validate_obs_listen(cfg.obs_listen)
+    if cfg.shed_queue_hwm < 0 or cfg.shed_writer_hwm < 0:
+        raise SystemExit("--shed-queue-hwm / --shed-writer-hwm must be "
+                         ">= 0 (0 disables that shed trigger)")
     if cfg.lanes < 1:
         raise SystemExit("--lanes must be >= 1")
     if cfg.quantum < 1:

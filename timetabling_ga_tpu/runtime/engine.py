@@ -361,17 +361,22 @@ def _lahc_key(mesh, gacfg: ga.GAConfig, hist_len: int, k_cands: int,
 
 def cached_lahc_runners(mesh, gacfg: ga.GAConfig, hist_len: int,
                         k_cands: int, sig, n_islands: int,
-                        donate: bool = False):
+                        donate: bool = False,
+                        with_moments: bool = False):
     """(init, run, finalize) LAHC endgame programs
     (islands.make_lahc_runners). The traced programs depend only on
     (pop_size, p1/p2/p3, hist_len, k_cands) of the POST config, whose
-    pop_size may be the shrunk one."""
+    pop_size may be the shrunk one. `with_moments` (--trace-mode stats)
+    appends walker-ensemble moment rows to the run program's stats
+    fetch and is a DIFFERENT traced program, hence part of the key."""
     k = ("lahc", _mesh_key(mesh), gacfg.pop_size, gacfg.p1, gacfg.p2,
-         gacfg.p3, hist_len, k_cands, sig, n_islands, donate)
+         gacfg.p3, hist_len, k_cands, sig, n_islands, donate,
+         with_moments)
     r = _RUNNER_CACHE.get(k)
     if r is None:
         r = islands.make_lahc_runners(mesh, gacfg, hist_len, k_cands,
-                                      n_islands, donate=donate)
+                                      n_islands, donate=donate,
+                                      with_moments=with_moments)
         _RUNNER_CACHE[k] = r
     return r
 
@@ -467,9 +472,11 @@ def build_post_config(cfg: RunConfig, gacfg: ga.GAConfig):
 
 # one dispatched-but-not-yet-retired chunk of the pipelined run loop
 # (see _run_tries): `trace` is the chunk's DEVICE-side telemetry array,
-# fenced only when the chunk is retired by _process
+# fenced only when the chunk is retired by _process; `flow` is the
+# chunk's causal flow id (obs/spans.py new_flow) connecting its
+# dispatch / fetch / fetch-read / process spans across threads
 _Chunk = collections.namedtuple(
-    "_Chunk", "td0 n_ep gens_run dyn_gens trace warm do_prof")
+    "_Chunk", "td0 n_ep gens_run dyn_gens trace warm do_prof flow")
 
 def run_counters() -> dict:
     """Back-compat view of the process robustness counters, now held by
@@ -643,7 +650,7 @@ class FetchTimeout(TimeoutError):
     case) is a sick window, not a program bug."""
 
 
-def _fetch(x) -> np.ndarray:
+def _fetch(x, tracer=NULL_TRACER, flow=None) -> np.ndarray:
     """Device->host fetch that also works for multi-host global arrays:
     single-process it is a plain np.asarray; multi-process the shards
     are allgathered so every process sees the global value (the
@@ -671,9 +678,17 @@ def _fetch(x) -> np.ndarray:
     box: dict = {}
 
     def _read():
+        tr0 = time.monotonic()
         try:
             faults.maybe_fail("fetch")
             box["value"] = np.asarray(x)
+            if flow is not None:
+                # the watchdog THREAD's half of the fetch: a span on its
+                # own tid, tied to the dispatch's flow id so `tt trace`
+                # draws the arrow across the thread boundary
+                tracer.record("fetch-read", tr0,
+                              time.monotonic() - tr0, cat="engine",
+                              flow=flow)
         except BaseException as e:   # re-raised on the main thread
             box["error"] = e
 
@@ -882,7 +897,8 @@ def precompile(cfg: RunConfig) -> None:
     if cfg.post_lahc > 0 and gacfg_post is not None:
         init_r, run_r, fin_r = cached_lahc_runners(
             mesh, gacfg_post, cfg.post_lahc, cfg.post_lahc_k, sig,
-            n_islands, donate)
+            n_islands, donate,
+            with_moments=(cfg.trace_mode == "stats"))
         lkey = _lahc_key(mesh, gacfg_post, cfg.post_lahc,
                          cfg.post_lahc_k, fingerprint)
         # donating programs: state_for's entry is needed again below, so
@@ -1055,6 +1071,7 @@ def run(cfg: RunConfig, out=None) -> int:
             out = sys.stdout
 
     writer = None
+    obs_srv = None
     try:
         # all record emission (and checkpoint serialization, via
         # submit()) rides the background writer thread so the dispatch
@@ -1073,6 +1090,18 @@ def run(cfg: RunConfig, out=None) -> int:
         obs_metrics.REGISTRY.gauge_fn("writer.queue_depth", writer.qsize)
         obs_metrics.REGISTRY.gauge_fn(
             "writer.records", lambda: writer.records_written)
+        if cfg.obs_listen:
+            # the pull front (obs/http.py): /metrics OpenMetrics with
+            # exemplars, /healthz probing THIS run's writer thread,
+            # /readyz from registry state. Daemon-thread listener — it
+            # shares nothing with the dispatch loop but the registry
+            # lock, and it writes NO records (the JSONL stream is
+            # identical with it on or off).
+            from timetabling_ga_tpu.obs import http as obs_http
+            obs_srv = obs_http.ObsServer(
+                cfg.obs_listen,
+                probes={"process": lambda: True,
+                        "writer": writer.alive}).start()
         try:
             ret = _run_tries(cfg, writer, tracer)
         except BaseException:
@@ -1081,6 +1110,8 @@ def run(cfg: RunConfig, out=None) -> int:
         writer.close()
         return ret
     finally:
+        if obs_srv is not None:
+            obs_srv.close()
         # unbind the writer pull gauges: the registry is process-global,
         # so a bound closure would keep THIS run's writer (and its
         # output stream) alive for the process lifetime. Freeze at the
@@ -1165,14 +1196,28 @@ def _polish_chunks(out, cfg, pa, polish, state, base_key, t_try, reserve,
         _phase(out, cfg.trace, phase_name, trial, tp1 - tp0, sweeps=chunk)
         tracer.record(phase_name, tp0, tp1 - tp0, cat="device",
                       sweeps=chunk)
-        if stats.shape[0] == 4:
+        if stats.shape[0] > 3:
             # --trace-mode stats: row 3 is the per-device executed
             # sweep-pass count (islands.make_polish_runner with_passes)
             # broadcast across its shard columns — the on-device
             # convergence signal. Record the slowest device's count and
-            # slice the row off before the (3, ...) protocol reads.
+            # slice the extras off before the (3, ...) protocol reads.
             obs_metrics.REGISTRY.gauge("engine.polish_passes").set(
                 int(stats[3].max()))
+            if stats.shape[0] >= 4 + islands.TRACE_N_MOMENTS:
+                # rows 4.. are bitcast float32 population moments
+                # (mean/var/min/max of reported values per device) —
+                # the polish/tail-polish endgame's stats-mode telemetry
+                mom = np.ascontiguousarray(
+                    stats[4:4 + islands.TRACE_N_MOMENTS]
+                ).view(np.float32)
+                reg = obs_metrics.REGISTRY
+                reg.gauge("engine.polish_best_mean").set(
+                    float(mom[0].mean()))
+                reg.gauge("engine.polish_best_min").set(
+                    float(mom[2].min()))
+                reg.gauge("engine.polish_best_max").set(
+                    float(mom[3].max()))
             stats = stats[:3]
         if warm:
             sps = (tp1 - tp0) / chunk
@@ -1221,7 +1266,8 @@ def _lahc_loop(out, cfg, pa, mesh, state, base_key, t_try, reserve,
     running its scv walk until the clock, Solution.cpp:499/619-768)."""
     init_r, run_r, fin_r = cached_lahc_runners(
         mesh, gacfg_post, cfg.post_lahc, cfg.post_lahc_k, sig,
-        n_islands, cfg.donate)
+        n_islands, cfg.donate,
+        with_moments=(cfg.trace_mode == "stats"))
     lkey = _lahc_key(mesh, gacfg_post, cfg.post_lahc, cfg.post_lahc_k,
                      fingerprint)
     lstate = init_r(pa, state)
@@ -1254,6 +1300,20 @@ def _lahc_loop(out, cfg, pa, mesh, state, base_key, t_try, reserve,
         dt = time.monotonic() - t0
         _phase(out, cfg.trace, "lahc", trial, dt, steps=n)
         tracer.record("lahc", t0, dt, cat="device", steps=n)
+        if stats.shape[0] > 3:
+            # --trace-mode stats: rows 3.. are bitcast float32 walker-
+            # ensemble moments (mean/var/min/max of best-so-far reported
+            # values per island — islands.make_lahc_runners
+            # with_moments). The endgame stops being a telemetry blind
+            # spot: the gauges move every chunk, and the (3, ...) rows
+            # the protocol reads are untouched.
+            mom = np.ascontiguousarray(
+                stats[3:3 + islands.TRACE_N_MOMENTS]).view(np.float32)
+            mreg = obs_metrics.REGISTRY
+            mreg.gauge("engine.lahc_best_mean").set(float(mom[0].mean()))
+            mreg.gauge("engine.lahc_best_min").set(float(mom[2].min()))
+            mreg.gauge("engine.lahc_best_max").set(float(mom[3].max()))
+            stats = stats[:3]
         if warm:
             sps = dt / n
             sec_per_step = (sps if sec_per_step is None
@@ -1477,6 +1537,15 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
         # reshape or advance the state); every later snapshot rides a
         # checkpoint fence for free.
         sup = _Supervisor(cfg)
+        # readiness gauges (the pull front's /readyz derives NOT-READY
+        # from these alone — obs/http.py readiness()): the ladder level
+        # and the remaining recovery budget are registry state from the
+        # first dispatch on
+        mreg.gauge("engine.degrade_level").set(sup.level)
+        mreg.gauge("engine.recovery_budget_configured").set(
+            cfg.max_recoveries)
+        mreg.gauge("engine.recovery_budget_remaining").set(
+            cfg.max_recoveries)
         if sup.enabled:
             if (host_loaded is not None and cur is gacfg
                     and not lahc_done):
@@ -1532,16 +1601,17 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
             nonlocal epochs_at_ckpt, last_fence, host_gap_s
             nonlocal overflow_warned
             (td0, n_ep, gens_run, dyn_gens, trace_dev, warm,
-             do_prof) = chunk                  # _Chunk fields
+             do_prof, flow) = chunk            # _Chunk fields
             tf0 = time.monotonic()
-            trace = _fetch(trace_dev)          # blocks on the dispatch
+            trace = _fetch(trace_dev, tracer=tracer,
+                           flow=flow or None)  # blocks on the dispatch
             if dyn_gens is not None and trace_mode == "full":
                 # compressed leaves carry their own validity (sentinel
                 # event rows); only the full trace needs the tail slice
                 trace = trace[:, :, :dyn_gens]
             td1 = time.monotonic()
             tracer.record("fetch", tf0, td1 - tf0, cat="engine",
-                          gens=gens_run)
+                          gens=gens_run, flow=flow)
             if do_prof:
                 jax.profiler.stop_trace()
                 profiled = True
@@ -1570,10 +1640,15 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
             _phase(out, cfg.trace, "dispatch", trial, dt,
                    epochs=n_ep, gens=gens_run)
             tracer.record("dispatch", t_start, dt, cat="device",
-                          epochs=n_ep, gens=gens_run)
+                          epochs=n_ep, gens=gens_run, flow=flow)
             mreg.counter("engine.dispatches").inc()
             mreg.counter("engine.gens").inc(gens_run)
-            mreg.histogram("engine.dispatch_seconds").observe(dt)
+            # the exemplar joins a latency-histogram spike on the
+            # scrape dashboard back to its dispatch ordinal (the
+            # spanEntry/phase records carry the same index implicitly
+            # via stream order)
+            mreg.histogram("engine.dispatch_seconds").observe(
+                dt, exemplar={"dispatch": str(n_dispatch)})
             if dt > 0:
                 mreg.gauge("engine.gens_per_sec").set(gens_run / dt)
             loop_s = td1 - t_loop
@@ -1657,7 +1732,7 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
                 mreg.gauge("engine.trace_best_max").set(
                     float(ev_moments[:, 3].max()))
             tracer.record("process", td1, time.monotonic() - td1,
-                          cat="engine", gens=gens_run)
+                          cat="engine", gens=gens_run, flow=flow)
             if (cfg.obs and cfg.metrics_every > 0
                     and n_dispatch % cfg.metrics_every == 0):
                 jsonl.metrics_entry(out, mreg.snapshot(),
@@ -1788,6 +1863,7 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
                             bs[i] = min(bs[i],
                                         jsonl.reported_best(h, s))
                     tr_fold = tr_in
+                ck_flow = tracer.new_flow()
                 if jax.process_count() <= 1 or jax.process_index() == 0:
                     job = (lambda hs=host_state, kh=key_host,
                            gd=gens_done, bs=bs, sd=seed:
@@ -1795,7 +1871,17 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
                                      fingerprint, bs, sd))
                     submit = getattr(out, "submit", None)
                     if submit is not None:
-                        submit(job)
+                        # the WRITER-thread half of the checkpoint: the
+                        # npz serialization runs as a queued job, and
+                        # its span (emitted from the worker thread —
+                        # jsonl.AsyncWriter.write's direct path) shares
+                        # the checkpoint's flow id, so the enqueue→write
+                        # handoff is one arrow in `tt trace`
+                        def _ckpt_job(job=job, f=ck_flow, gd=gens_done):
+                            with tracer.span("ckpt-write", cat="writer",
+                                             flow=f, gens=gd):
+                                job()
+                        submit(_ckpt_job)
                     else:
                         job()
                 epochs_at_ckpt = epochs_done
@@ -1818,7 +1904,7 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
                 _phase(out, cfg.trace, "checkpoint", trial,
                        time.monotonic() - t)
                 tracer.record("checkpoint", t, time.monotonic() - t,
-                              cat="engine", gens=gens_done)
+                              cat="engine", gens=gens_done, flow=ck_flow)
                 mreg.counter("engine.checkpoints").inc()
 
         # ---- supervised region (in-run fault recovery) ----------------
@@ -1976,6 +2062,10 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
                                and warm)
                     if do_prof:
                         jax.profiler.start_trace(cfg.trace_profile)
+                    # one flow id per chunk: its dispatch (this thread),
+                    # fetch-read (the watchdog thread) and process spans
+                    # render as one connected chain in `tt trace`
+                    flow_id = tracer.new_flow()
                     td0 = time.monotonic()
                     state, trace_dev, _gbest = runner(*args)
                     # start the trace's device->host transfer WITHOUT fencing:
@@ -1990,7 +2080,7 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
                     epochs_done += n_ep
                     n_dispatch += 1
                     chunk = _Chunk(td0, n_ep, gens_run, dyn_gens, trace_dev,
-                                   warm, do_prof)
+                                   warm, do_prof, flow_id)
                     if pipelined:
                         # retire the PREVIOUS chunk with this one already
                         # running: its telemetry cost hides behind device
@@ -2053,6 +2143,8 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
                     raise
                 now = time.monotonic()
                 sup.recoveries += 1
+                mreg.gauge("engine.recovery_budget_remaining").set(
+                    max(0, cfg.max_recoveries - sup.recoveries))
                 if sup.recoveries > cfg.max_recoveries:
                     # recovery budget exhausted: emit the abort record,
                     # leave a final durable checkpoint from the
@@ -2083,6 +2175,7 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
                     # repeated failures inside the window: step the
                     # degradation ladder (1 = serial, >= 2 = halved
                     # dispatch chunks) and record the step
+                    mreg.gauge("engine.degrade_level").set(sup.level)
                     jsonl.fault_entry(
                         out, site, "degrade", e, trial, sup.recoveries,
                         sup.level, now - t_try,

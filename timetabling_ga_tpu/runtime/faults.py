@@ -42,7 +42,10 @@ dispatch sites), `fetch` (every classified control-fence host read,
 inside the watchdog thread), `writer` (AsyncWriter worker, once per
 dequeued item), `ckpt` (checkpoint.save, after the durable rename),
 `init` (the engine's pre-snapshot init dispatch — the supervised-init
-retry's window).
+retry's window), `obs_listen` (the pull front's server thread at
+startup) and `scrape` (once per handled HTTP request, on the handler
+thread — a hang/die there must never stall dispatch, serve, or writer
+drain; tests/test_obs.py pins it).
 
 The plan is installed per engine.run call (`install`), which resets the
 per-site counters — invocation indices are deterministic within one
@@ -68,7 +71,12 @@ ACTIONS = ("unavailable", "hang", "die", "truncate", "error")
 # the supervised-init retry covers — ROADMAP PR-3 follow-up); it is a
 # separate site so injecting there does not shift the invocation
 # indices of the `dispatch` plans existing tests pin.
-SITES = ("dispatch", "fetch", "writer", "ckpt", "init")
+# `obs_listen` fires on the pull front's server thread at startup and
+# `scrape` once per handled HTTP request (obs/http.py) — both execute
+# OFF the dispatch/serve/writer paths by design, and the tests pin
+# that a hung or dead listener never stalls any of them.
+SITES = ("dispatch", "fetch", "writer", "ckpt", "init", "obs_listen",
+         "scrape")
 
 
 class FaultInjected(Exception):

@@ -166,10 +166,36 @@ class AsyncWriter:
                 q.all_tasks_done.wait(0.1)
 
     def write(self, s: str) -> None:
+        if threading.current_thread() is self._thread:
+            # called FROM the worker thread — a submitted job emitting a
+            # record (a flow span bracketing checkpoint serialization,
+            # obs/spans.py). Enqueueing here could deadlock: on a full
+            # queue the producer-side _put would wait for a drain only
+            # this very thread performs. The worker is the stream's sole
+            # writer and it is exactly here, so a direct write stays
+            # line-atomic and ordered (it lands right where the job sits
+            # in the queue order).
+            if not self._failed:
+                self._records += 1
+                try:
+                    self._stream.write(s)
+                    self._stream.flush()
+                except BaseException:
+                    # same latch as the worker's own write path: never
+                    # splice another record after a partial line
+                    self._failed = True
+                    raise
+            return
         self._check_open()
         self._raise_pending()
         self._records += 1
         self._put(s)
+
+    def alive(self) -> bool:
+        """Worker-thread liveness — the pull front's `/healthz` writer
+        probe (obs/http.py): a dead worker means records are piling into
+        a queue nothing drains."""
+        return self._thread.is_alive()
 
     def qsize(self) -> int:
         """Current queue occupancy — the obs metrics registry samples

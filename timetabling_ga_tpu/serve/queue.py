@@ -38,9 +38,15 @@ class JobState:
     DONE = "done"
     FAILED = "failed"
     CANCELLED = "cancelled"
+    SHED = "shed"         # dropped by backpressure (scheduler shed):
+    #                       the lowest-priority runnable work is
+    #                       released when a registry depth crosses its
+    #                       configured high-water mark — immediate,
+    #                       visible load shedding instead of unbounded
+    #                       latency for everyone
 
     ACTIVE = (PENDING, RUNNING, PARKED)
-    TERMINAL = (DONE, FAILED, CANCELLED)
+    TERMINAL = (DONE, FAILED, CANCELLED, SHED)
 
 
 class AdmissionError(RuntimeError):
@@ -72,6 +78,11 @@ class Job:
     finished_t: Optional[float] = None
     result: Optional[dict] = None
     error: Optional[str] = None
+    flow: int = 0                     # causal flow id (obs/spans.py
+    #                                   new_flow): every span of this
+    #                                   job's life shares it, so
+    #                                   `tt trace --job ID` renders one
+    #                                   connected end-to-end timeline
 
     def runnable(self) -> bool:
         return self.state in (JobState.PENDING, JobState.RUNNING,

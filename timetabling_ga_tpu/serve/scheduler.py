@@ -108,6 +108,11 @@ class Scheduler:
         # sampled when the registry is snapshotted
         self._metrics.gauge_fn("serve.queue_depth",
                                lambda: len(queue.active()))
+        # the admission bound as a gauge: /readyz (obs/http.py
+        # readiness) flips NOT-READY when queue_depth reaches it, so a
+        # fleet router stops sending work to a replica that would only
+        # reject it
+        self._metrics.gauge("serve.backlog").set(cfg.backlog)
         self.spec = bucket_mod.BucketSpec(
             event_floor=cfg.bucket_events, room_floor=cfg.bucket_rooms,
             feature_floor=cfg.bucket_features,
@@ -136,13 +141,72 @@ class Scheduler:
         job.pa_dev = job.padded.device_arrays()
 
     def admit(self, job: Job) -> None:
-        """Record the admission (after queue.submit succeeds)."""
-        with self.tracer.span("admit", cat="serve", job=job.id):
+        """Record the admission (after queue.submit succeeds). The job
+        gets its causal flow id here — every span of its life (admit →
+        pack → quantum → park → resume → finalize) carries it, so
+        `tt trace --job ID` renders one connected timeline across
+        lanes, parks, and co-tenants."""
+        job.flow = self.tracer.new_flow()
+        with self.tracer.span("admit", cat="serve", job=job.id,
+                              flow=job.flow):
             jsonl.job_entry(self.out, job.id, "admitted",
                             bucket=list(job.bucket),
                             generations=job.generations,
                             priority=job.priority)
         self._metrics.counter("serve.jobs_admitted").inc()
+
+    # -- backpressure ---------------------------------------------------
+
+    def _shed(self) -> None:
+        """Registry-driven load shedding at the control fence: while
+        `serve.queue_depth` or `writer.queue_depth` sits at/over its
+        configured high-water mark (ServeConfig shed_queue_hwm /
+        shed_writer_hwm; 0 disables), release the LOWEST-priority
+        runnable job (latest arrival among equals — the work the
+        ordering would serve last anyway). The scheduler reads its OWN
+        registry — the same numbers /metrics scrapes and /readyz
+        derives from — so what the dashboard calls overloaded and what
+        the scheduler sheds can never disagree. Every shed is a
+        jobEntry `shed` record + the serve.jobs_shed counter."""
+        q_hwm = self.cfg.shed_queue_hwm
+        w_hwm = self.cfg.shed_writer_hwm
+        if q_hwm <= 0 and w_hwm <= 0:
+            return
+
+        def depth(name):
+            v = self._metrics.gauge(name).value
+            return 0.0 if v != v else v        # nan (unbound) = no load
+
+        while True:
+            over = None
+            if q_hwm > 0 and depth("serve.queue_depth") >= q_hwm:
+                over = "queue_hwm"
+            elif w_hwm > 0 and depth("writer.queue_depth") >= w_hwm:
+                over = "writer_hwm"
+            if over is None:
+                return
+            victims = self.queue.ready()
+            if not victims:
+                return
+            job = victims[-1]          # lowest priority, most-served,
+            #                            latest arrival — ready()'s
+            #                            order reversed
+            job.state = JobState.SHED
+            job.finished_t = self._now()
+            job.error = f"shed ({over})"
+            job.snapshot = None
+            with self.tracer.span("shed", cat="serve", job=job.id,
+                                  flow=job.flow, reason=over):
+                jsonl.job_entry(self.out, job.id, "shed", reason=over,
+                                priority=job.priority,
+                                gens=job.gens_done)
+            self._metrics.counter("serve.jobs_shed").inc()
+            if over == "writer_hwm":
+                # shedding queued jobs cannot drain the WRITER queue
+                # (only the worker thread does); one shed per fence
+                # bounds the reaction while the backlog of records
+                # clears
+                return
 
     # -- one dispatch cycle --------------------------------------------
 
@@ -173,7 +237,11 @@ class Scheduler:
 
     def step(self) -> bool:
         """Run one fused dispatch for the next bucket group (round-
-        robin). Returns True while any runnable job remains."""
+        robin). Returns True while any runnable job remains. The top of
+        every step is the control fence: deadline reaping and
+        backpressure shedding (both registry-visible) happen before the
+        next pack."""
+        self._shed()
         self._reap()
         buckets = self._buckets_ready()
         if not buckets:
@@ -183,8 +251,14 @@ class Scheduler:
 
         lanes = self.cfg.lanes
         pop = self.cfg.pop_size
-        with self.tracer.span("pack", cat="serve", bucket=list(bkey)):
-            jobs = self.queue.ready(bkey)[:lanes]
+        jobs = self.queue.ready(bkey)[:lanes]
+        # every span of this dispatch cycle is tagged with the packed
+        # jobs' ids AND their flow ids: one span advances many causal
+        # chains, and `tt trace --job ID` follows exactly one of them
+        jids = [j.id for j in jobs]
+        flows = [j.flow for j in jobs]
+        with self.tracer.span("pack", cat="serve", bucket=list(bkey),
+                              job=jids, flow=flows):
             fresh = [j for j in jobs if j.snapshot is None]
             if fresh:
                 self._init_jobs(fresh)
@@ -206,19 +280,21 @@ class Scheduler:
                 gens[lane] = min(self.cfg.quantum, job.remaining())
 
         from timetabling_ga_tpu.runtime import engine
-        with self.tracer.span("resume", cat="serve", jobs=len(jobs)):
+        with self.tracer.span("resume", cat="serve", job=jids,
+                              flow=flows):
             # parked host snapshots -> one stacked device placement
             host0 = _stack_states([j.snapshot for j in jobs], pop,
                                   lanes, Ep)
             state = engine.reshard_state(host0, self.mesh)
-        with self.tracer.span("quantum", cat="device", jobs=len(jobs),
-                              gens=int(gens.sum())):
+        with self.tracer.span("quantum", cat="device", job=jids,
+                              flow=flows, gens=int(gens.sum())):
             runner, _ = engine.cached_lane_runner(
                 self.mesh, self.gacfg, self.cfg.quantum, lanes,
                 donate=True, trace_mode=self.cfg.trace_mode)
             state, trace = runner(pa_stack, seeds, chunks, state, gens)
             trace = np.asarray(trace)   # (lanes, quantum, 2) | packed
-        with self.tracer.span("park", cat="serve", jobs=len(jobs)):
+        with self.tracer.span("park", cat="serve", job=jids,
+                              flow=flows):
             host = engine.fetch_state(state)
             # the telemetry decode shared with the engine: full traces
             # list every executed generation, compressed leaves the
@@ -285,7 +361,9 @@ class Scheduler:
         Idle lanes replicate the first job's data and are discarded."""
         from timetabling_ga_tpu.runtime import engine
         lanes = self.cfg.lanes
-        with self.tracer.span("init", cat="device", jobs=len(jobs)):
+        with self.tracer.span("init", cat="device",
+                              job=[j.id for j in jobs],
+                              flow=[j.flow for j in jobs]):
             init = engine.cached_lane_init(self.mesh, self.cfg.pop_size,
                                            self.gacfg, n_lanes=lanes)
             pa_stack = self._jax.tree.map(
@@ -303,7 +381,15 @@ class Scheduler:
 
     def _finalize(self, job: Job, deadline_hit: bool = False) -> None:
         """Emit the job's endTry records from its snapshot (row 0 is
-        the lane's lex-best individual) and mark it DONE."""
+        the lane's lex-best individual) and mark it DONE. The span
+        closes the job's flow; the job_seconds observation carries the
+        job id as its exemplar, so a p99 spike on the scrape dashboard
+        joins straight back to this jobEntry lifecycle."""
+        with self.tracer.span("finalize", cat="serve", job=job.id,
+                              flow=job.flow):
+            self._finalize_records(job, deadline_hit)
+
+    def _finalize_records(self, job: Job, deadline_hit: bool) -> None:
         snap = job.snapshot
         hcv = int(snap.hcv[0])
         scv = int(snap.scv[0])
@@ -328,7 +414,8 @@ class Scheduler:
         job.state = JobState.DONE
         job.finished_t = self._now()
         self._metrics.counter("serve.jobs_done").inc()
-        self._metrics.histogram("serve.job_seconds").observe(total_time)
+        self._metrics.histogram("serve.job_seconds").observe(
+            total_time, exemplar={"job": job.id})
         job.result = {"best": job.best, "feasible": feasible,
                       "hcv": hcv, "scv": scv, "gens": job.gens_done,
                       "deadline_hit": deadline_hit,

@@ -65,6 +65,15 @@ class SolveService:
         if cfg.backend == "cpu":
             jax.config.update("jax_platforms", "cpu")
         self.cfg = cfg
+        # deterministic fault injection, mirroring engine.run: install
+        # the configured plan (or $TT_FAULTS) so the serve-relevant
+        # sites (writer, obs_listen, scrape) fire under `tt serve` too.
+        # Only when a spec is present — a service must not clobber a
+        # plan a test installed programmatically before constructing it.
+        from timetabling_ga_tpu.runtime import faults as faults_mod
+        spec = faults_mod.active_spec(cfg.faults)
+        if spec:
+            faults_mod.install(spec)
         self._close_out = False
         if out is None:
             if cfg.output:
@@ -85,6 +94,18 @@ class SolveService:
         self.scheduler = Scheduler(cfg, self.queue, self.writer,
                                    now=now, tracer=self.tracer)
         self._auto_id = 0
+        self.obs_server = None
+        if cfg.obs_listen:
+            # the pull front (obs/http.py): Prometheus scrapes /metrics
+            # (OpenMetrics + job exemplars) and probes /healthz //readyz
+            # straight off this process — no sidecar tailing the record
+            # stream. The listener writes NO records; the JSONL stream
+            # is identical with it on or off (tests + bench pin it).
+            from timetabling_ga_tpu.obs import http as obs_http
+            self.obs_server = obs_http.ObsServer(
+                cfg.obs_listen,
+                probes={"process": lambda: True,
+                        "writer": self.writer.alive}).start()
 
     # -- API -------------------------------------------------------------
 
@@ -148,6 +169,8 @@ class SolveService:
         jsonl.metrics_entry(self.writer, snap, ts=self.tracer.now())
 
     def close(self) -> None:
+        if self.obs_server is not None:
+            self.obs_server.close()
         try:
             self.writer.close()
         finally:

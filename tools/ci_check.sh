@@ -32,7 +32,9 @@ if [ "${1:-}" = "--fast" ]; then
     # their test modules are minutes not tens of minutes, and together
     # they span enough layers (bucketing neutrality, compile-once,
     # scheduler fairness, span/metrics record fencing, trace-mode
-    # stream equivalence) that a lint-only gate would miss real
+    # stream equivalence, the pull front's /metrics//healthz//readyz
+    # endpoints + scrape/obs_listen fault isolation, flow-event export
+    # and backpressure shedding) that a lint-only gate would miss real
     # breakage
     step "serve tests (tests/test_serve.py)"
     timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
