@@ -1,0 +1,50 @@
+"""TT603 fixture: cost/memory introspection on hot paths.
+
+Not imported or executed — parsed by tests/test_analysis.py.
+`cost_analysis()` / `memory_analysis()` exist only on a compiled
+executable (anywhere else they force a recompile) and
+`memory_stats()` is a device-allocator RPC: inside a trace target the
+call runs against a tracer at trace time, inside a dispatch loop it
+serializes the pipeline. The sanctioned home is the cost observatory
+(obs/cost.py): extract at compile time, poll from its own thread.
+"""
+import jax
+from jax import lax
+
+DEVICE = None      # stands in for jax.local_devices()[0]
+
+
+@jax.jit
+def traced_introspection(x, compiled):
+    analysis = compiled.cost_analysis()          # EXPECT TT603
+    return x + len(analysis)
+
+
+def scan_body_memory(carry, x):
+    stats = DEVICE.memory_stats()                # EXPECT TT603
+    return carry + x, stats
+
+
+def run_scan(xs):
+    return lax.scan(scan_body_memory, 0.0, xs)
+
+
+def dispatch_loop(runner, pa, state):
+    for _step in range(8):
+        state = runner(pa, state)
+        stats = DEVICE.memory_stats()            # EXPECT TT603
+    return state, stats
+
+
+def drain_loop(queue, compiled):
+    while queue:
+        queue.pop()
+        mem = compiled.memory_analysis()         # EXPECT TT603
+    return mem
+
+
+def compile_time_is_fine(fn, args):
+    # OK: one-off extraction right after an explicit compile — the
+    # observatory's own pattern (obs/cost.py), outside any loop
+    compiled = fn.lower(*args).compile()
+    return compiled.cost_analysis(), compiled.memory_analysis()

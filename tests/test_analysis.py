@@ -43,7 +43,8 @@ def fixture_config() -> AnalyzerConfig:
     cfg.root = REPO
     # the sync/collective rules only audit configured modules; opt the
     # fixtures in
-    cfg.dispatch_modules = list(cfg.dispatch_modules) + ["viol_sync.py"]
+    cfg.dispatch_modules = list(cfg.dispatch_modules) + ["viol_sync.py",
+                                                         "viol_cost.py"]
     cfg.sharded_modules = (list(cfg.sharded_modules)
                            + ["viol_collective.py"])
     return cfg
@@ -68,6 +69,8 @@ def analyze_fixture(fixture: str):
     "viol_obs_clock.py",   # TT601 wall clocks / spans in trace targets
     "viol_obs_http.py",    # TT602 blocking I/O / registry writes in
     #                        HTTP handler paths
+    "viol_cost.py",        # TT603 cost/memory introspection in trace
+    #                        targets and dispatch loops
 ])
 def test_rule_fires_at_expected_lines(fixture):
     """Each rule family fires exactly at the marked (rule, line) pairs —

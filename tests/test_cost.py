@@ -1,0 +1,542 @@
+"""Cost observatory tests (timetabling_ga_tpu/obs/cost.py, tt-obs v3).
+
+Five layers:
+
+  unit        signature keying, CostProgram compile accounting +
+              fallback, roofline helper, MemPoller gauges, the
+              near-HBM /readyz reason, ProfileCapture lifecycle, the
+              /profile endpoint + `tt profile` client, supervisor
+              ladder step-back-UP
+  engine A/B  warm second run pays ZERO compiles (the compile-hit
+              contract), record stream identical with the observatory
+              enabled vs disabled (TT_COST_OBS kill switch) and with
+              costEntry emission on vs off — THE acceptance criterion
+  serve A/B   bucket reuse => exactly one compile per lane program
+              (compile.count.{lane_runner,lane_init} pin it), same
+              stream-identity contract
+  faults      `mem_poll` and `profile` hang/die never stall dispatch,
+              serve, or writer drain
+  CLI         costEntry records render in `tt trace` / `tt stats`
+"""
+
+import io
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from timetabling_ga_tpu.obs import cost as obs_cost
+from timetabling_ga_tpu.obs import http as obs_http
+from timetabling_ga_tpu.obs import metrics as obs_metrics
+from timetabling_ga_tpu.obs.metrics import MetricsRegistry
+from timetabling_ga_tpu.runtime import faults, jsonl
+from timetabling_ga_tpu.runtime.config import RunConfig, ServeConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TIM = os.path.join(REPO, "fixtures", "comp01s.tim")
+
+
+def _wait(cond, timeout=5.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+# ------------------------------------------------------------------ unit
+
+
+def test_sig_distinguishes_shapes_dtypes_and_scalars():
+    import numpy as np
+    a = np.zeros((4, 3), np.int32)
+    b = np.zeros((4, 4), np.int32)
+    c = np.zeros((4, 3), np.float32)
+    assert obs_cost._sig((a, 1)) == obs_cost._sig((a, 2))
+    assert obs_cost._sig((a,)) != obs_cost._sig((b,))
+    assert obs_cost._sig((a,)) != obs_cost._sig((c,))
+    assert obs_cost._sig((a, 1)) != obs_cost._sig((a, 1.0))
+    # nested pytrees (NamedTuple-ish) key by leaves
+    assert obs_cost._sig(((a, a),)) != obs_cost._sig(((a, b),))
+    # REGISTERED dataclass pytrees key by their leaves too: two serve
+    # buckets' ProblemArrays must never collide onto one executable
+    # (the soak leg caught exactly that before the tree_flatten path)
+    from timetabling_ga_tpu.problem import random_instance
+    pa1 = random_instance(1, n_events=40, n_rooms=4, n_features=4,
+                          n_students=30).device_arrays()
+    pa2 = random_instance(1, n_events=100, n_rooms=8, n_features=4,
+                          n_students=60).device_arrays()
+    assert obs_cost._sig((pa1,)) != obs_cost._sig((pa2,))
+    tag = obs_cost.sig_tag(obs_cost._sig((a, 1)))
+    assert tag == obs_cost.sig_tag(obs_cost._sig((a, 2)))
+    assert len(tag) == 10
+
+
+def test_cost_program_accounting_and_cost_entry_emission():
+    import jax
+    import numpy as np
+    reg = MetricsRegistry()
+    obs = obs_cost.Observatory(registry=reg)
+    buf = io.StringIO()
+    obs.bind(buf, now=lambda: 1.5)
+    prog = obs_cost.CostProgram(jax.jit(lambda x: x * 2 + 1), "toy",
+                                observatory=obs)
+    x = np.arange(8, dtype=np.int32)
+    y1 = prog(x)
+    assert list(np.asarray(y1)[:3]) == [1, 3, 5]
+    assert reg.counter("compile.count").value == 1
+    assert reg.counter("compile.count.toy").value == 1
+    assert reg.counter("compile.cache_hits").value == 0
+    assert reg.histogram("compile.seconds").count == 1
+    prog(x)                                   # warm: a cache hit
+    assert reg.counter("compile.count").value == 1
+    assert reg.counter("compile.cache_hits").value == 1
+    prog(np.arange(16, dtype=np.int32))       # new shape: new compile
+    assert reg.counter("compile.count").value == 2
+    # the executable's cost analysis landed in last_cost + gauges
+    assert prog.last_cost is None or "flops" not in prog.last_cost \
+        or prog.last_cost["flops"] > 0
+    # bound emitter: one costEntry per compile, stamped with now()
+    recs = [json.loads(line) for line in buf.getvalue().splitlines()]
+    assert len(recs) == 2 and all("costEntry" in r for r in recs)
+    ce = recs[0]["costEntry"]
+    assert ce["program"] == "toy" and ce["ts"] == 1.5
+    assert "compileSeconds" in ce and "sig" in ce
+    # costEntry is a timing record: stream identity holds by strip
+    assert jsonl.strip_timing(recs) == []
+    # unbound: compiles keep counting, nothing more is emitted
+    obs.unbind()
+    prog(np.arange(32, dtype=np.int32))
+    assert reg.counter("compile.count").value == 3
+    assert len(buf.getvalue().splitlines()) == 2
+
+
+def test_cost_program_fallback_on_unloweable_fn():
+    reg = MetricsRegistry()
+    obs = obs_cost.Observatory(registry=reg)
+    prog = obs_cost.CostProgram(lambda x: x + 1, "plain",
+                                observatory=obs)
+    assert prog(41) == 42                 # no .lower: plain-call path
+    assert prog(41) == 42
+    assert reg.counter("compile.count").value == 1
+    assert prog.last_cost is None
+
+
+def test_roofline_and_hit_rate_helpers():
+    out = obs_cost.roofline(27.6e6, 0.865e6, 400_000)
+    assert out["arithmetic_intensity_flops_per_byte"] == pytest.approx(
+        31.9, rel=0.01)
+    assert out["bf16_peak_tflops"] == obs_cost.BF16_PEAK_TFLOPS
+    assert out["hbm_peak_gbps"] == obs_cost.HBM_PEAK_GBPS
+    assert out["achieved_tflops"] >= 0
+    assert "min_fused_fraction_pct" in out
+    reg = MetricsRegistry()
+    assert obs_cost.compile_hit_rate(reg) == 0.0
+    reg.counter("compile.count").inc(2)
+    reg.counter("compile.cache_hits").inc(6)
+    assert obs_cost.compile_hit_rate(reg) == pytest.approx(0.75)
+
+
+def test_mem_poller_gauges_and_near_hbm_readiness():
+    reg = MetricsRegistry()
+    stats = {"bytes_in_use": 50, "bytes_limit": 100,
+             "peak_bytes_in_use": 60}
+    poller = obs_cost.MemPoller(lambda: stats, interval_s=60,
+                                registry=reg)
+    assert poller.poll_once()
+    g = reg.snapshot()["gauges"]
+    assert g["device.mem_bytes_in_use"] == 50
+    assert g["device.mem_bytes_limit"] == 100
+    assert g["device.mem_frac_used"] == 0.5
+    assert g["device.mem_peak_bytes_in_use"] == 60
+    ok, detail = obs_http.readiness(reg)
+    assert ok and detail["mem_frac_used"] == 0.5
+    # cross the near-HBM threshold: /readyz degrades with the reason
+    stats["bytes_in_use"] = int(100 * obs_cost.NEAR_HBM_FRAC) + 1
+    assert poller.poll_once()
+    ok, detail = obs_http.readiness(reg)
+    assert not ok and "near_hbm_limit" in detail["reasons"]
+    # a None-stats backend (CPU) still counts polls, sets no gauges
+    reg2 = MetricsRegistry()
+    p2 = obs_cost.MemPoller(lambda: None, registry=reg2)
+    assert p2.poll_once()
+    assert reg2.counter("device.mem_polls").value == 1
+    assert "device.mem_frac_used" not in reg2.snapshot().get(
+        "gauges", {})
+
+
+def test_mem_poller_die_and_hang_never_stall(monkeypatch):
+    monkeypatch.setattr(faults, "HANG_S", 0.15)
+    reg = MetricsRegistry()
+    # die: the poller thread exits silently; close() returns at once
+    faults.install("mem_poll:1:die")
+    try:
+        p = obs_cost.MemPoller(lambda: {"bytes_in_use": 1},
+                               interval_s=0.01, registry=reg).start()
+        assert _wait(lambda: not p.alive())
+        t0 = time.monotonic()
+        p.close()
+        assert time.monotonic() - t0 < 1.0
+        # hang: the poller parks inside its own thread; the caller's
+        # close() is bounded and everything else runs on
+        faults.install("mem_poll:1:hang")
+        p2 = obs_cost.MemPoller(lambda: {"bytes_in_use": 1},
+                                interval_s=0.01, registry=reg).start()
+        time.sleep(0.05)            # poller is inside the hang now
+        t0 = time.monotonic()
+        p2.close()
+        assert time.monotonic() - t0 < 3.0
+    finally:
+        faults.install(None)
+
+
+def test_profile_capture_lifecycle():
+    calls = []
+    cap = obs_cost.ProfileCapture(lambda d: calls.append(("start", d)),
+                                  lambda: calls.append(("stop",)),
+                                  default_dir="outdir",
+                                  registry=MetricsRegistry())
+    try:
+        ack = cap.trigger(2)
+        assert ack == {"ok": True, "dispatches": 2, "dir": "outdir"}
+        assert _wait(lambda: ("start", "outdir") in calls)
+        busy = cap.trigger(1)
+        assert not busy["ok"] and "active" in busy["reason"]
+        cap.on_dispatch()
+        assert ("stop",) not in calls
+        cap.on_dispatch()
+        assert _wait(lambda: ("stop",) in calls)
+        assert _wait(lambda: not cap.active())
+        # a finished capture frees the slot for the next trigger
+        assert cap.trigger(1)["ok"]
+        assert _wait(lambda: calls.count(("start", "outdir")) == 2)
+        cap.on_dispatch()
+        assert _wait(lambda: calls.count(("stop",)) == 2)
+    finally:
+        cap.close()
+
+
+def test_profile_capture_hang_and_die_never_stall(monkeypatch):
+    monkeypatch.setattr(faults, "HANG_S", 30.0)
+    for action in ("hang", "die"):
+        calls = []
+        faults.install(f"profile:1:{action}")
+        try:
+            cap = obs_cost.ProfileCapture(
+                lambda d: calls.append("start"),
+                lambda: calls.append("stop"),
+                registry=MetricsRegistry())
+            assert cap.trigger(1)["ok"]
+            time.sleep(0.05)
+            # the capture worker is hung/dead; dispatch ticks must
+            # return instantly
+            t0 = time.monotonic()
+            for _ in range(100):
+                cap.on_dispatch()
+            assert time.monotonic() - t0 < 0.5
+            assert "start" not in calls
+            t0 = time.monotonic()
+            cap.close()
+            assert time.monotonic() - t0 < 3.0
+        finally:
+            faults.install(None)
+
+
+def test_profile_endpoint_and_cli_client(capsys):
+    calls = []
+    cap = obs_cost.ProfileCapture(lambda d: calls.append(d),
+                                  lambda: None,
+                                  registry=MetricsRegistry())
+    srv = obs_http.ObsServer("127.0.0.1:0", registry=MetricsRegistry(),
+                             profile=cap).start()
+    try:
+        assert obs_cost.main_profile([srv.url, "--for", "3"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out == {"ok": True, "dispatches": 3,
+                       "dir": cap.default_dir}
+        assert _wait(lambda: calls == [cap.default_dir])
+        # busy: 409 surfaces as exit 1 with the reason
+        assert obs_cost.main_profile([srv.url]) == 1
+        assert "active" in json.loads(capsys.readouterr().out)["reason"]
+    finally:
+        srv.close()
+        cap.close()
+    # no capture wired: 404
+    srv2 = obs_http.ObsServer("127.0.0.1:0",
+                              registry=MetricsRegistry()).start()
+    try:
+        assert obs_cost.main_profile([srv2.url]) == 1
+        assert "no profile capture" in json.loads(
+            capsys.readouterr().out)["reason"]
+    finally:
+        srv2.close()
+
+
+def test_supervisor_ladder_steps_back_up(monkeypatch):
+    from timetabling_ga_tpu.runtime import engine as eng
+    sup = eng._Supervisor.__new__(eng._Supervisor)
+    sup.level = 3
+    sup.failures = [100.0]
+    sup._relaxed_at = None
+    monkeypatch.setattr(eng._Supervisor, "WINDOW_S", 10.0)
+    assert not sup.maybe_relax(105.0)      # clean stretch too short
+    assert sup.maybe_relax(110.0) and sup.level == 2
+    assert not sup.maybe_relax(115.0)      # one level per clean window
+    assert sup.maybe_relax(120.0) and sup.level == 1
+    assert sup.maybe_relax(130.0) and sup.level == 0
+    assert not sup.maybe_relax(999.0)      # floor at 0
+
+
+# ----------------------------------------------------------- engine A/Bs
+
+
+def _engine_run(obs=False, faults_spec=None, **kw):
+    from timetabling_ga_tpu.runtime import engine as eng
+    buf = io.StringIO()
+    base = dict(input=TIM, seed=3, pop_size=8, islands=2,
+                generations=30, migration_period=10, max_steps=8,
+                time_limit=300, backend="cpu", auto_tune=False,
+                trace=True, obs=obs, metrics_every=1,
+                faults=faults_spec)
+    base.update(kw)
+    best = eng.run(RunConfig(**base), out=buf)
+    return best, [json.loads(x) for x in buf.getvalue().splitlines()]
+
+
+def _compile_counters():
+    c = obs_metrics.REGISTRY.snapshot().get("counters", {})
+    return {k: v for k, v in c.items() if k.startswith("compile.")}
+
+
+def _clear_program_caches():
+    from timetabling_ga_tpu.runtime import engine as eng
+    eng._RUNNER_CACHE.clear()
+    eng._INIT_CACHE.clear()
+
+
+@pytest.mark.skipif(not obs_cost.ENABLED, reason="TT_COST_OBS=0")
+def test_engine_stream_identity_and_compile_accounting(monkeypatch):
+    """THE acceptance criterion, engine half, plus the compile-hit
+    contract — structured to pay each XLA compile exactly once:
+
+      leg OFF   observatory disabled (TT_COST_OBS kill switch => plain
+                jit dispatch), cold caches
+      leg ON    observatory enabled + emitting (--obs), cold caches —
+                its costEntry records and cold compile.* deltas are
+                the accounting assertions, and its warm programs are
+                left in the caches for every later engine test
+      leg WARM  a second enabled run: ZERO new compiles, cache_hits
+                grow, roofline gauges move
+
+    All three emit identical protocol records modulo timing
+    (costEntry is a timing record). DISPATCH_CAP_S is pinned out of
+    range so timing noise cannot re-size dispatches between legs (the
+    test_obs A/B discipline)."""
+    from timetabling_ga_tpu.runtime import engine as eng
+    monkeypatch.setattr(eng, "DISPATCH_CAP_S", 1e9)
+    monkeypatch.setattr(obs_cost, "ENABLED", False)
+    _clear_program_caches()
+    b_off, l_off = _engine_run(obs=False)
+    assert not any("costEntry" in r for r in l_off)
+    monkeypatch.setattr(obs_cost, "ENABLED", True)
+    _clear_program_caches()               # leg ON compiles THROUGH the
+    #                                       observatory
+    before = _compile_counters()
+    b_on, l_on = _engine_run(obs=True)
+    after = _compile_counters()
+    assert b_on == b_off
+    assert jsonl.strip_timing(l_on) == jsonl.strip_timing(l_off)
+    assert any("costEntry" in r for r in l_on)
+    assert after.get("compile.count", 0) > before.get(
+        "compile.count", 0)
+    assert after.get("compile.count.runner", 0) - before.get(
+        "compile.count.runner", 0) == 1
+    # leg WARM: same records, zero compiles, hits + roofline move
+    b2, l2 = _engine_run()
+    final = _compile_counters()
+    assert b2 == b_on
+    assert jsonl.strip_timing(l2) == jsonl.strip_timing(l_off)
+    assert final.get("compile.count", 0) == after.get(
+        "compile.count", 0), (after, final)
+    assert final.get("compile.cache_hits", 0) > after.get(
+        "compile.cache_hits", 0)
+    g = obs_metrics.REGISTRY.snapshot()["gauges"]
+    assert g.get("cost.flops.runner", 0) > 0
+    assert g.get("cost.achieved_tflops", 0) > 0
+    assert g.get("cost.flop_utilization_pct", 0) > 0
+
+
+@pytest.mark.skipif(not obs_cost.ENABLED, reason="TT_COST_OBS=0")
+def test_engine_ladder_restore_path(monkeypatch):
+    """The recovery ladder's step-back-UP surfaces live: with a
+    deterministic one-failure escalate/relax policy (the real timing
+    logic is unit-tested above), a degraded run emits the faultEntry
+    `restore` record, clears the engine.degrade_level gauge, and still
+    matches the uninjected stream modulo timing+fault records."""
+    from timetabling_ga_tpu.runtime import engine as eng
+
+    class FastRelax(eng._Supervisor):
+        def escalate(self, now):
+            self.failures.append(now)
+            if self.level < 1:
+                self.level = 1          # serial on the first failure
+                return True
+            return False
+
+        def maybe_relax(self, now):
+            if self.level > 0 and self.recoveries >= 1:
+                self.level -= 1
+                self._relaxed_at = now
+                return True
+            return False
+
+    b0, l0 = _engine_run()
+    monkeypatch.setattr(eng, "_Supervisor", FastRelax)
+    b, l = _engine_run(faults_spec="dispatch:2:unavailable")
+    assert b == b0
+    assert jsonl.strip_timing(l) == jsonl.strip_timing(l0)
+    fe = [r["faultEntry"] for r in l if "faultEntry" in r]
+    actions = [f["action"] for f in fe]
+    assert "degrade" in actions            # the ladder stepped down...
+    restores = [f for f in fe if f["action"] == "restore"]
+    assert restores                        # ...and back up, audited
+    assert restores[-1]["site"] == "run"
+    assert int(obs_metrics.REGISTRY.gauge(
+        "engine.degrade_level").value) == 0
+
+
+@pytest.mark.skipif(not obs_cost.ENABLED, reason="TT_COST_OBS=0")
+def test_engine_profile_for_wiring(tmp_path, monkeypatch):
+    """--profile-for N: the engine builds the capture, triggers it at
+    launch, ticks it per retired dispatch, and the capture brackets
+    exactly N dispatches — with the profiler entry points stubbed (the
+    REAL jax.profiler.start_trace lazily imports tensorflow, ~a
+    minute of import on the capture worker; the engine's lambdas look
+    the attribute up at call time, so the stub is what runs). The
+    record stream is identical with the capture on or off."""
+    import jax
+    prof_dir = str(tmp_path / "prof")
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop",)))
+    before = obs_metrics.REGISTRY.counter("profile.captures").value
+    b0, l0 = _engine_run()
+    b, l = _engine_run(profile_for=2, profile_dir=prof_dir)
+    assert b == b0
+    assert jsonl.strip_timing(l) == jsonl.strip_timing(l0)
+    assert _wait(lambda: ("stop",) in calls)
+    assert calls == [("start", prof_dir), ("stop",)]
+    assert obs_metrics.REGISTRY.counter(
+        "profile.captures").value == before + 1
+
+
+# ------------------------------------------------------------ serve A/Bs
+
+
+def _serve_problems():
+    from timetabling_ga_tpu.problem import random_instance
+    # two DIFFERENT raw shapes landing in ONE bucket with the default
+    # floors/ratio — the bucket-reuse compile contract's minimal case
+    return [random_instance(4001, n_events=40, n_rooms=4,
+                            n_features=4, n_students=30,
+                            attend_prob=0.05),
+            random_instance(4002, n_events=50, n_rooms=4,
+                            n_features=4, n_students=25,
+                            attend_prob=0.05)]
+
+
+def _serve_run(problems, obs=False, **cfg_kw):
+    from timetabling_ga_tpu.serve.service import SolveService
+    buf = io.StringIO()
+    cfg = ServeConfig(backend="cpu", lanes=2, quantum=10, pop_size=8,
+                      generations=20, obs=obs, metrics_every=1,
+                      **cfg_kw)
+    svc = SolveService(cfg, out=buf)
+    for i, p in enumerate(problems):
+        svc.submit(p, job_id=f"j{i}", seed=i)
+    svc.drive()
+    svc.close()
+    return [json.loads(x) for x in buf.getvalue().splitlines()]
+
+
+@pytest.mark.skipif(not obs_cost.ENABLED, reason="TT_COST_OBS=0")
+def test_serve_bucket_compiles_and_stream_identity(monkeypatch):
+    """Serve half of the acceptance criterion. Compile accounting:
+    from cold caches, a 2-job different-raw-shape one-bucket stream
+    compiles each lane program EXACTLY once (bucket reuse =>
+    per-signature cache hit), making the compile-hit rate a real
+    number. Stream identity: the same stream with the observatory
+    disabled is identical modulo timing records. Leg order pays each
+    compile once and leaves WARM wrapped programs for the fault-
+    isolation test below."""
+    problems = _serve_problems()
+    monkeypatch.setattr(obs_cost, "ENABLED", False)
+    _clear_program_caches()
+    l_off = _serve_run(problems, obs=False)
+    assert not any("costEntry" in r for r in l_off)
+    monkeypatch.setattr(obs_cost, "ENABLED", True)
+    _clear_program_caches()
+    before = _compile_counters()
+    l_on = _serve_run(problems, obs=True)
+    after = _compile_counters()
+    assert jsonl.strip_timing(l_on) == jsonl.strip_timing(l_off)
+    assert any("costEntry" in r for r in l_on)
+    assert after.get("compile.count.lane_runner", 0) - before.get(
+        "compile.count.lane_runner", 0) == 1     # one per bucket
+    assert after.get("compile.count.lane_init", 0) - before.get(
+        "compile.count.lane_init", 0) == 1
+    # the co-tenant job's dispatches rode the same executables warm
+    assert after.get("compile.cache_hits", 0) > before.get(
+        "compile.cache_hits", 0)
+
+
+@pytest.mark.skipif(not obs_cost.ENABLED, reason="TT_COST_OBS=0")
+def test_serve_mem_poll_and_profile_faults_never_stall(monkeypatch):
+    """A hung or dying poller/capture never stalls dispatch, serve, or
+    writer drain: the stream completes, close() returns, and the
+    records match a fault-free run modulo timing+fault records."""
+    monkeypatch.setattr(faults, "HANG_S", 30.0)
+    problems = _serve_problems()[:1]
+    l0 = _serve_run(problems)
+    for spec in ("mem_poll:1:hang,profile:1:hang",
+                 "mem_poll:1:die,profile:1:die"):
+        t0 = time.monotonic()
+        l = _serve_run(problems, obs=True, mem_poll_every=0.01,
+                       profile_for=1, faults=spec)
+        # bounded: the hang variants park their own threads only (the
+        # two close() joins are bounded at 2 s each)
+        assert time.monotonic() - t0 < 25.0, spec
+        assert jsonl.strip_timing(l) == jsonl.strip_timing(l0), spec
+    assert faults.injected_total() >= 2
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def test_cost_entry_renders_in_trace_and_stats():
+    from timetabling_ga_tpu.obs.logstats import summarize
+    from timetabling_ga_tpu.obs.trace_export import export_chrome_trace
+    buf = io.StringIO()
+    jsonl.cost_entry(buf, "lane_runner", sig="abc123", ts=2.0,
+                     lowerSeconds=0.25, compileSeconds=0.75,
+                     flops=1e9, intensity=30.0)
+    jsonl.cost_entry(buf, "lane_runner", sig="def456", ts=5.0,
+                     lowerSeconds=0.1, compileSeconds=0.4)
+    recs = [json.loads(x) for x in buf.getvalue().splitlines()]
+    doc = export_chrome_trace(recs)
+    ev = [e for e in doc["traceEvents"] if e["cat"] == "compile"]
+    assert len(ev) == 2
+    assert ev[0]["name"] == "compile:lane_runner"
+    assert ev[0]["ph"] == "X" and ev[0]["tid"] == 998
+    # the slab ENDS at ts: start = (2.0 - 1.0) s in microseconds
+    assert ev[0]["ts"] == pytest.approx(1.0e6)
+    assert ev[0]["dur"] == pytest.approx(1.0e6)
+    text = summarize(recs)
+    assert "== compiles (2 costEntry records)" in text
+    assert "lane_runner: 2x, 1.50s lower+compile" in text
+    assert "AI 30.0" in text

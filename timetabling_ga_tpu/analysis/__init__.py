@@ -31,6 +31,11 @@ Rules (see README "Static analysis & sanitizers"):
          handler code paths — the pull front's handlers (obs/http.py)
          must only READ registry snapshots and only write their own
          response socket; a scrape is a pure observer
+  TT603  cost_analysis / memory_analysis / memory_stats calls inside
+         trace targets or dispatch loops — host-sync (and recompile)
+         hazards that belong in the obs paths only: the cost
+         observatory (obs/cost.py) extracts analyses at compile time
+         and polls memory_stats from its own thread
 
 Suppress one finding inline with `# tt-analyze: ignore[TT301]` (on the
 line, or on a comment line directly above). Configure via
@@ -66,8 +71,8 @@ class _Context:
 
 def _rule_modules():
     from timetabling_ga_tpu.analysis import (
-        rules_api, rules_donate, rules_http, rules_obs, rules_recompile,
-        rules_rng, rules_sync, rules_trace)
+        rules_api, rules_cost, rules_donate, rules_http, rules_obs,
+        rules_recompile, rules_rng, rules_sync, rules_trace)
     return {
         "TT101": rules_trace,
         "TT102": rules_trace,
@@ -82,6 +87,7 @@ def _rule_modules():
         "TT502": rules_api,
         "TT601": rules_obs,
         "TT602": rules_http,
+        "TT603": rules_cost,
     }
 
 

@@ -1,0 +1,135 @@
+"""TT603 — cost/memory introspection on hot paths.
+
+`compiled.cost_analysis()` / `compiled.memory_analysis()` and
+`device.memory_stats()` are host-synchronizing introspection calls:
+the analyses exist only on a compiled executable (obtaining one
+anywhere else forces a fresh lower+compile — seconds of XLA work), and
+`memory_stats()` is a runtime RPC into the device allocator (a full
+round trip on tunneled devices). Neither belongs anywhere near the
+dispatch stream:
+
+  - inside a TRACE TARGET (jit / vmap / shard_map / lax control flow)
+    the call executes at trace time against a tracer, fails outright
+    or bakes a stale answer into the program;
+  - inside a DISPATCH LOOP (the configured dispatch modules' host
+    loops, TT301's scope) it serializes the pipeline the loops exist
+    to keep full — exactly the per-dispatch stall class TT301 bans for
+    array readbacks.
+
+The sanctioned homes are the obs paths (obs/cost.py): the cost
+observatory extracts `cost_analysis`/`memory_analysis` ONCE at compile
+time — the only moment they are free — and polls `memory_stats` from
+its own daemon thread on the metricsEntry cadence. Everything else
+reads the resulting registry gauges.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from timetabling_ga_tpu.analysis.core import Finding
+from timetabling_ga_tpu.analysis.rules_trace import _collect_targets
+
+RULE = "TT603"
+
+_COST_METHODS = {"cost_analysis", "memory_analysis", "memory_stats"}
+
+# modules whose own bodies ARE the sanctioned obs paths
+_EXEMPT_SUFFIXES = ("obs/cost.py",)
+
+
+def _cost_calls(fn: ast.AST):
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _COST_METHODS):
+            yield node
+
+
+def _flag(findings, path, node, where: str) -> None:
+    findings.append(Finding(
+        RULE, path, node.lineno, node.col_offset,
+        f"`.{node.func.attr}()` {where} — cost/memory introspection is "
+        f"a host-sync (and, off an executable, a recompile) hazard; it "
+        f"belongs in the obs paths only: the cost observatory extracts "
+        f"analyses at compile time and polls memory_stats from its own "
+        f"thread (obs/cost.py, README \"Cost observatory\")"))
+
+
+class _LoopScanner:
+    """Flag the cost methods inside any For/While body of a host
+    function — the dispatch-loop half of the rule, scoped to the
+    configured dispatch modules like TT301."""
+
+    def __init__(self, path, findings):
+        self.path = path
+        self.findings = findings
+
+    def scan(self, fn: ast.AST) -> None:
+        self._stmts(getattr(fn, "body", []), in_loop=False)
+
+    def _check(self, node: ast.AST, in_loop: bool) -> None:
+        if not in_loop:
+            return
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _COST_METHODS):
+                _flag(self.findings, self.path, sub,
+                      "inside a dispatch loop")
+
+    def _stmts(self, stmts, in_loop: bool) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, (ast.For, ast.While)):
+                if isinstance(st, ast.While):
+                    self._check(st.test, in_loop)
+                else:
+                    self._check(st.iter, in_loop)
+                self._stmts(st.body, True)
+                self._stmts(st.orelse, True)
+                continue
+            for field in ("value", "test", "iter"):
+                v = getattr(st, field, None)
+                if isinstance(v, ast.expr):
+                    self._check(v, in_loop)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(st, field, None)
+                if isinstance(sub, list):
+                    self._stmts(sub, in_loop)
+            for h in getattr(st, "handlers", []) or []:
+                self._stmts(h.body, in_loop)
+
+
+def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
+    norm = path.replace("\\", "/")
+    if norm.endswith(_EXEMPT_SUFFIXES):
+        return []
+    findings: list[Finding] = []
+    # half 1: trace targets, module-wide (TT601's collection — anything
+    # lexically inside traced code is traced with it)
+    for fn in _collect_targets(tree):
+        for node in _cost_calls(fn):
+            _flag(findings, path, node, "inside a jit/vmap/shard_map "
+                                        "target")
+    # half 2: dispatch loops, in the configured dispatch modules only
+    if any(norm.endswith(suffix)
+           for suffix in ctx.config.dispatch_modules):
+        scanner = _LoopScanner(path, findings)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scanner.scan(node)
+    # a call both traced and looped would double-report at one line;
+    # the analyzer's set-dedupe collapses identical findings, and the
+    # two message variants differ, so dedupe here by (line, col)
+    seen: set = set()
+    out = []
+    for f in findings:
+        k = (f.line, f.col)
+        if k in seen:
+            continue
+        seen.add(k)
+        out.append(f)
+    return out

@@ -29,6 +29,13 @@ JSONL record stream, never a device.
     python -m timetabling_ga_tpu.cli stats run.jsonl
         summarize: best-so-far curves, recoveries, per-job latency
         (for serve logs: queued/packed/executing/parked breakdown)
+
+`profile` subcommand — the cost observatory's on-demand capture
+trigger (README "Cost observatory"; obs/cost.py): ask a live run or
+serve process (its `--obs-listen` front) to record a jax.profiler
+trace of its next N dispatches into its `--profile-dir`.
+
+    python -m timetabling_ga_tpu.cli profile 127.0.0.1:9100 --for 5
 """
 
 from __future__ import annotations
@@ -51,6 +58,12 @@ def main(argv=None) -> int:
     if argv and argv[0] == "stats":
         from timetabling_ga_tpu.obs.logstats import main_stats
         return main_stats(argv[1:])
+    if argv and argv[0] == "profile":
+        # deferred + jax-free like trace/stats: `tt profile` is a
+        # stdlib HTTP client asking a LIVE run's --obs-listen front to
+        # capture its next N dispatches (obs/cost.py ProfileCapture)
+        from timetabling_ga_tpu.obs.cost import main_profile
+        return main_profile(argv[1:])
     # runtime imports deferred past the subcommand dispatch (and the
     # package __init__ is PEP 562-lazy): `tt trace`/`tt stats` must
     # work without importing jax (the log may be on a machine with no

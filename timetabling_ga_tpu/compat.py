@@ -63,7 +63,8 @@ def shard_map(f, **kwargs):
 JAX_COMPAT_TABLE = {
     "jax": ["lax", "numpy",
             # attribute surface (TT502)
-            "jit", "vmap", "devices", "block_until_ready",
+            "jit", "vmap", "devices", "local_devices",
+            "block_until_ready",
             "make_array_from_callback", "process_count",
             "process_index", "clear_caches", "device_get",
             "config", "random", "tree", "tree_util", "sharding",

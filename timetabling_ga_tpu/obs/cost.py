@@ -1,0 +1,769 @@
+"""tt-obs cost observatory: compile accounting, live roofline telemetry,
+device-memory polling, and on-demand profiler capture.
+
+The device side of the stack was a black box: compile cost dominates the
+serve path (ROADMAP item 3's bucket-affine routing needs a compile-hit
+rate nobody measured) and kernel headroom (item 4) was visible only in a
+one-off bench leg. This module makes cost a live, per-program quantity:
+
+  COMPILE ACCOUNTING — `instrument(fn, program)` wraps a jitted program
+  in a `CostProgram`: an AOT-dispatching proxy that performs ONE
+  explicit `fn.lower(*args).compile()` per input signature (shapes +
+  dtypes — for serve's lane programs the signature IS the shape bucket),
+  timing lower and compile separately, then dispatches every later call
+  straight through the cached executable. Every engine `cached_*` and
+  serve `cached_lane_runner`/`cached_lane_init` program goes through it,
+  so the registry carries real `/metrics` families:
+
+      compile.count              compiles performed (+ per program:
+                                 compile.count.<program>)
+      compile.cache_hits         dispatches served by a warm executable
+      compile.seconds            lower+compile wall-time histogram,
+                                 exemplar = {program, sig} per bucket
+      compile.retries            transient compile-RPC retries (the
+                                 BENCH_r05 'response body closed' class)
+
+  ROOFLINE — at compile time the executable's `cost_analysis()` /
+  `memory_analysis()` land in per-program gauges (`cost.flops.<p>`,
+  `cost.bytes.<p>`, `cost.intensity.<p>`, `cost.temp_bytes.<p>`) and,
+  when an emitter is bound (`--obs`), in `costEntry` JSONL records. The
+  dispatch loops combine the stored FLOP count with their own measured
+  wall time into `cost.achieved_tflops` / `cost.flop_utilization_pct` /
+  `cost.logical_gbps` — bench's `kernel_cost` numbers, live.
+
+  MEMORY — `MemPoller` samples `device.memory_stats()` from its own
+  daemon thread on the metricsEntry cadence, feeding `device.mem_*`
+  gauges; /readyz (obs/http.py) degrades with reason `near_hbm_limit`
+  when `device.mem_frac_used` crosses NEAR_HBM_FRAC. Polling runs OFF
+  the dispatch path by construction — `memory_stats()` is a host sync
+  hazard there (tt-analyze TT603 bans it in trace targets and dispatch
+  loops; this module is the sanctioned home).
+
+  PROFILE — `ProfileCapture` drives `jax.profiler` start/stop from a
+  worker thread: `tt profile URL --for N` (or GET /profile?for=N on the
+  `--obs-listen` front, or `--profile-for N` at launch) triggers a
+  capture spanning the next N dispatches. The dispatch loop only flips
+  a counter (`on_dispatch`), so a hung or dying capture — fault site
+  `profile`, like the poller's `mem_poll` — can never stall dispatch,
+  serve, or writer drain (tests pin it).
+
+The standing invariant: the record stream is identical with the
+observatory on or off. `costEntry` is a TIMING record (jsonl.
+TIMING_RECORDS), counters/gauges write no records, and the AOT proxy
+compiles the same program jit would — engine and serve A/Bs pin stream
+identity with `TT_COST_OBS=0` (the kill switch that bypasses wrapping).
+
+Import-time stdlib-only, like the rest of obs/ (`tt trace`/`tt stats`
+must run without jax); the jax touches live behind function-local
+imports used only by the engine/serve processes.
+"""
+
+from __future__ import annotations
+
+import atexit
+import hashlib
+import os
+import sys
+import threading
+import time
+
+from timetabling_ga_tpu.obs import metrics as obs_metrics
+
+# kill switch: TT_COST_OBS=0 makes instrument() the identity, restoring
+# the plain jit dispatch path (the records-identical A/B's other leg)
+ENABLED = os.environ.get("TT_COST_OBS", "1") != "0"
+
+
+def _faults():
+    """The fault-injection module, imported lazily: `runtime.__init__`
+    pulls the engine (and so jax), and this module must stay
+    importable without either — `tt profile` is a stdlib HTTP client.
+    Poller/capture threads only exist inside engine/serve processes,
+    where the runtime package is long imported."""
+    from timetabling_ga_tpu.runtime import faults
+    return faults
+
+# chip peaks for the roofline placement (v5e public numbers — the same
+# constants bench.py's kernel_cost leg reported offline)
+HBM_PEAK_GBPS = 819.0       # HBM bandwidth
+BF16_PEAK_TFLOPS = 197.0    # MXU bf16
+
+# /readyz degrades with reason `near_hbm_limit` at this device.mem
+# fraction: past it the next placement is an OOM gamble, so a fleet
+# router should stop sending new work here
+NEAR_HBM_FRAC = float(os.environ.get("TT_MEM_READY_FRAC", "0.92"))
+
+# bounded transient-compile retries (the remote-compile RPC dies
+# mid-response on tunneled devices — BENCH_r05, retry.TRANSIENT_MARKERS)
+COMPILE_ATTEMPTS = 3
+
+
+def _sig(args) -> tuple:
+    """Input-signature key for the per-program executable cache: the
+    pytree structure plus shapes and dtypes of every array leaf and
+    the types of python scalars — the signature jax.jit keys its own
+    cache on, so one CostProgram compile corresponds to one jit
+    compile. For serve's lane programs the signature IS the shape
+    bucket (pad_problem maps every in-bucket instance to these
+    shapes).
+
+    The primary path flattens through jax's own pytree machinery
+    (lazy import — this module stays import-time stdlib-only), which
+    sees REGISTERED custom nodes like ProblemArrays; a dataclass
+    pytree is opaque to any hand-rolled walk, and missing its leaves
+    once collided two serve buckets onto one compiled executable. The
+    stdlib fallback (no jax importable) handles tuples/lists/dicts/
+    dataclasses for plain-python callables."""
+    try:
+        from jax import tree_util as _tu
+        leaves, treedef = _tu.tree_flatten(args)
+        out: list = [str(treedef)]
+        for x in leaves:
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                out.append((str(x.dtype), tuple(x.shape)))
+            else:
+                out.append(type(x).__name__)
+        return tuple(out)
+    except Exception:
+        pass
+    import dataclasses as _dc
+    out = []
+
+    def walk(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            out.append((str(x.dtype), tuple(x.shape)))
+        elif isinstance(x, (list, tuple)):
+            out.append(type(x).__name__)
+            for y in x:
+                walk(y)
+        elif isinstance(x, dict):
+            for k in sorted(x):
+                out.append(str(k))
+                walk(x[k])
+        elif _dc.is_dataclass(x) and not isinstance(x, type):
+            out.append(type(x).__name__)
+            for f in _dc.fields(x):
+                walk(getattr(x, f.name))
+        else:
+            out.append(type(x).__name__)
+
+    walk(args)
+    return tuple(out)
+
+
+def sig_tag(sig: tuple) -> str:
+    """Short deterministic label for a signature (the exemplar /
+    costEntry `sig` value a dashboard joins buckets on)."""
+    return hashlib.md5(repr(sig).encode()).hexdigest()[:10]
+
+
+def extract_cost(compiled) -> dict:
+    """Normalize an XLA executable's `cost_analysis()` /
+    `memory_analysis()` into one flat dict (missing pieces are simply
+    absent — CPU backends report fewer fields). Duck-typed so this
+    module never imports jax."""
+    out: dict = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        ca = ca or {}
+        if ca.get("flops", 0.0) > 0:
+            out["flops"] = float(ca["flops"])
+        if ca.get("bytes accessed", 0.0) > 0:
+            out["bytes_accessed"] = float(ca["bytes accessed"])
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for field, key in (("temp_size_in_bytes", "temp_bytes"),
+                           ("argument_size_in_bytes", "arg_bytes"),
+                           ("output_size_in_bytes", "out_bytes"),
+                           ("generated_code_size_in_bytes",
+                            "code_bytes")):
+            v = getattr(ma, field, None)
+            if v:
+                out[key] = float(v)
+    except Exception:
+        pass
+    fl, by = out.get("flops"), out.get("bytes_accessed")
+    if fl and by:
+        out["intensity"] = fl / by
+    return out
+
+
+def roofline(flops_per_eval: float, bytes_per_eval: float,
+             per_sec: float) -> dict:
+    """The roofline-placement dict bench.py's `kernel_cost` leg reports
+    (same keys as BENCH_r05's, so the archived JSON schema holds):
+    achieved TFLOPS vs the bf16 peak, logical GB/s vs the HBM peak, and
+    the fraction of logical bytes the HBM provably never served (XLA's
+    'bytes accessed' is per-HLO LOGICAL traffic, counted before fusion
+    keeps intermediates in VMEM — an upper bound on HBM bytes, so any
+    excess over the HBM peak is positive evidence of fusion)."""
+    out = {"flops_per_eval": round(flops_per_eval, 1),
+           "logical_bytes_per_eval": round(bytes_per_eval, 1),
+           "arithmetic_intensity_flops_per_byte":
+               (round(flops_per_eval / bytes_per_eval, 3)
+                if bytes_per_eval else None)}
+    if bytes_per_eval and per_sec:
+        logical_gbps = bytes_per_eval * per_sec / 1e9
+        tflops = flops_per_eval * per_sec / 1e12
+        out["achieved_tflops"] = round(tflops, 1)
+        out["bf16_peak_tflops"] = BF16_PEAK_TFLOPS
+        out["flop_utilization_vs_bf16_peak_pct"] = round(
+            100 * tflops / BF16_PEAK_TFLOPS, 1)
+        out["logical_gbps_at_measured_rate"] = round(logical_gbps, 1)
+        out["hbm_peak_gbps"] = HBM_PEAK_GBPS
+        out["min_fused_fraction_pct"] = round(
+            max(0.0, 100 * (1 - HBM_PEAK_GBPS / logical_gbps)), 1)
+    return out
+
+
+def set_live_roofline(cost: dict | None, dt: float,
+                      registry=None) -> None:
+    """Update the live achieved-vs-peak gauges from one dispatched
+    program's compile-time cost dict (`CostProgram.last_cost`) and its
+    measured wall time — THE formula, owned here next to the peaks so
+    the engine's `_process` and the serve scheduler's quantum cannot
+    drift on it: `cost.achieved_tflops`, `cost.flop_utilization_pct`,
+    `cost.logical_gbps`."""
+    if not cost or dt <= 0:
+        return
+    reg = obs_metrics.REGISTRY if registry is None else registry
+    fl = cost.get("flops")
+    if fl:
+        tf = fl / dt / 1e12
+        reg.gauge("cost.achieved_tflops").set(tf)
+        reg.gauge("cost.flop_utilization_pct").set(
+            100.0 * tf / BF16_PEAK_TFLOPS)
+    by = cost.get("bytes_accessed")
+    if by:
+        reg.gauge("cost.logical_gbps").set(by / dt / 1e9)
+
+
+class Observatory:
+    """Process-global costEntry emission target. The registry half of
+    the observatory is always on (counters and gauges, like the rest of
+    tt-obs); record emission binds per run: engine.run / SolveService
+    `bind(writer, now=tracer.now)` under `--obs` and unbind in their
+    finallys, so the global never holds a finished run's writer alive
+    and the JSONL stream is identical with the observatory on or off
+    (costEntry is a TIMING record either way)."""
+
+    def __init__(self, registry=None):
+        self.registry = (obs_metrics.REGISTRY if registry is None
+                         else registry)
+        self._lock = threading.Lock()
+        self._out = None
+        self._now = None
+        # recent compile entries (program, sig, cost dict) — a bounded
+        # introspection surface for tests and `last_cost` consumers
+        self.entries: list = []
+
+    def bind(self, out, now=None) -> None:
+        with self._lock:
+            self._out = out
+            self._now = now
+
+    def unbind(self) -> None:
+        self.bind(None)
+
+    def record_compile(self, program: str, sig: tuple, lower_s: float,
+                       compile_s: float, cost: dict,
+                       retries: int = 0) -> None:
+        reg = self.registry
+        reg.counter("compile.count").inc()
+        reg.counter(f"compile.count.{program}").inc()
+        tag = sig_tag(sig)
+        reg.histogram("compile.seconds").observe(
+            lower_s + compile_s, exemplar={"program": program,
+                                           "sig": tag})
+        if retries:
+            reg.counter("compile.retries").inc(retries)
+        fl = cost.get("flops")
+        if fl is not None:
+            reg.gauge(f"cost.flops.{program}").set(fl)
+        by = cost.get("bytes_accessed")
+        if by is not None:
+            reg.gauge(f"cost.bytes.{program}").set(by)
+        ai = cost.get("intensity")
+        if ai is not None:
+            reg.gauge(f"cost.intensity.{program}").set(ai)
+        tb = cost.get("temp_bytes")
+        if tb is not None:
+            reg.gauge(f"cost.temp_bytes.{program}").set(tb)
+        with self._lock:
+            self.entries.append({"program": program, "sig": tag,
+                                 "lower_s": lower_s,
+                                 "compile_s": compile_s, **cost})
+            del self.entries[:-256]
+            out, now = self._out, self._now
+        if out is not None:
+            try:
+                from timetabling_ga_tpu.runtime import jsonl
+                extra = {k: (round(v, 3) if isinstance(v, float) else v)
+                         for k, v in cost.items()}
+                if retries:
+                    extra["retries"] = retries
+                if now is not None:
+                    extra["ts"] = round(max(0.0, float(now())), 6)
+                jsonl.cost_entry(out, program, sig=tag,
+                                 lowerSeconds=round(lower_s, 4),
+                                 compileSeconds=round(compile_s, 4),
+                                 **extra)
+            except Exception:
+                pass   # telemetry must never fail a compile
+
+    def hit(self, program: str) -> None:
+        self.registry.counter("compile.cache_hits").inc()
+
+
+OBSERVATORY = Observatory()
+
+
+def compile_hit_rate(registry=None) -> float:
+    """Warm-dispatch fraction: cache_hits / (cache_hits + count). THE
+    serve-path number ROADMAP item 3's bucket-affine routing steers on;
+    bench's soak leg reports its per-leg delta."""
+    reg = OBSERVATORY.registry if registry is None else registry
+    hits = reg.counter("compile.cache_hits").value
+    total = hits + reg.counter("compile.count").value
+    return hits / total if total else 0.0
+
+
+class CostProgram:
+    """AOT-dispatching proxy around one jitted program.
+
+    Per input signature the FIRST call runs `fn.lower(args)` +
+    `.compile()` explicitly — each half timed, the executable's
+    cost/memory analyses extracted (this is the only moment they are
+    free: later they would cost a recompile, which is why TT603 bans
+    the introspection calls anywhere near the dispatch path) — then
+    dispatches through the compiled executable; later calls with the
+    same signature dispatch directly (a `compile.cache_hits` tick).
+    Transient compile failures (the tunnel's remote-compile RPC deaths)
+    retry bounded with `compile.retries` accounting; anything
+    unexpected about the AOT path itself falls back to the plain jit
+    call so the observatory can degrade but never break a run.
+
+    `last_cost` holds the cost dict of the executable the most recent
+    call used — the dispatch loops join it with their own measured wall
+    time into the achieved-vs-peak gauges. `last_compiled` says whether
+    that call PAID the compile: a compiling dispatch's wall time is
+    compile+execute, and dividing FLOPs by it would crater the
+    roofline gauges 10-100x on every cold dispatch — callers skip the
+    roofline update when it is True (compile.seconds carries that cost
+    under its own name)."""
+
+    __slots__ = ("_fn", "program", "_obs", "_compiled", "_lock",
+                 "last_cost", "last_compiled")
+
+    def __init__(self, fn, program: str, observatory=None):
+        self._fn = fn
+        self.program = program
+        self._obs = OBSERVATORY if observatory is None else observatory
+        self._compiled: dict = {}
+        self._lock = threading.Lock()
+        self.last_cost: dict | None = None
+        self.last_compiled = False
+
+    def _compile(self, sig: tuple, args):
+        from timetabling_ga_tpu.runtime import retry
+        retries = 0
+        while True:
+            try:
+                t0 = time.perf_counter()
+                lowered = self._fn.lower(*args)
+                t1 = time.perf_counter()
+                exe = lowered.compile()
+                t2 = time.perf_counter()
+                break
+            except Exception as e:
+                if (retry.is_transient(e)
+                        and retries + 1 < COMPILE_ATTEMPTS):
+                    retries += 1
+                    continue
+                # the AOT path failed non-transiently: degrade to the
+                # plain jit call (which may still succeed — e.g. an
+                # argument AOT is stricter about) and stop wrapping
+                # this signature; accounting still counts the compile
+                print(f"warning: cost observatory AOT compile failed "
+                      f"for {self.program} ({str(e)[:120]}); falling "
+                      f"back to plain dispatch", file=sys.stderr)
+                self._obs.record_compile(self.program, sig, 0.0, 0.0,
+                                         {}, retries=retries)
+                return {"exe": None, "cost": {}}
+        cost = extract_cost(exe)
+        self._obs.record_compile(self.program, sig, t1 - t0, t2 - t1,
+                                 cost, retries=retries)
+        return {"exe": exe, "cost": cost}
+
+    def __call__(self, *args):
+        sig = _sig(args)
+        compiled_now = False
+        entry = self._compiled.get(sig)
+        if entry is None:
+            with self._lock:
+                entry = self._compiled.get(sig)
+                if entry is None:
+                    entry = self._compile(sig, args)
+                    self._compiled[sig] = entry
+                    compiled_now = True
+        if not compiled_now:
+            self._obs.hit(self.program)
+        self.last_compiled = compiled_now
+        self.last_cost = entry["cost"] or None
+        exe = entry["exe"]
+        if exe is None:
+            return self._fn(*args)
+        try:
+            return exe(*args)
+        except TypeError as e:
+            # an aval mismatch means the signature keying missed a
+            # distinction the executable enforces — degrade THIS
+            # signature to the plain jit path (which re-specializes
+            # correctly) instead of failing the dispatch; a wrong
+            # RESULT is impossible either way, the executable refuses
+            # mismatched avals outright
+            print(f"warning: cost observatory signature miss for "
+                  f"{self.program} ({str(e)[:120]}); falling back to "
+                  f"plain dispatch", file=sys.stderr)
+            entry["exe"] = None
+            return self._fn(*args)
+
+
+def instrument(fn, program: str, observatory=None):
+    """Wrap `fn` (a jitted program) in compile accounting; the identity
+    when the observatory is disabled (TT_COST_OBS=0) so the plain jit
+    dispatch path remains one env var away."""
+    if not ENABLED or fn is None or isinstance(fn, CostProgram):
+        return fn
+    return CostProgram(fn, program, observatory=observatory)
+
+
+# ------------------------------------------------------------ mem poller
+
+
+def jax_memory_stats_fn():
+    """A stats source for MemPoller reading the local devices'
+    `memory_stats()` (summed over local devices; None where the backend
+    has no allocator stats — CPU). Built by the engine/serve processes
+    only; the jax import is function-local so this module stays
+    import-time stdlib-only."""
+    import jax
+    devices = jax.local_devices()
+
+    def read():
+        agg: dict = {}
+        for d in devices:
+            try:
+                ms = d.memory_stats()
+            except Exception:
+                ms = None
+            if not ms:
+                continue
+            for k in ("bytes_in_use", "bytes_limit",
+                      "peak_bytes_in_use"):
+                if k in ms:
+                    agg[k] = agg.get(k, 0) + int(ms[k])
+        return agg or None
+
+    return read
+
+
+class MemPoller:
+    """Off-dispatch-path device memory telemetry: a daemon thread
+    samples `stats_fn()` every `interval_s` seconds and feeds the
+    `device.mem_*` gauges (`bytes_in_use`, `bytes_limit`,
+    `peak_bytes_in_use`, `frac_used`) plus a `device.mem_polls`
+    counter. /readyz turns `device.mem_frac_used` >= NEAR_HBM_FRAC into
+    the `near_hbm_limit` degraded reason.
+
+    Fault site `mem_poll` fires once per sample on THIS thread: `hang`
+    parks the poller (gauges go stale, nothing else notices), `die`
+    ends it silently — dispatch, serve, and writer drain never wait on
+    it (tests pin that). Writes no records, so the JSONL stream is
+    identical with the poller on or off."""
+
+    def __init__(self, stats_fn, interval_s: float = 1.0, registry=None):
+        self._stats_fn = stats_fn
+        self._interval = max(0.05, float(interval_s))
+        self._reg = (obs_metrics.REGISTRY if registry is None
+                     else registry)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="tt-mem-poll", daemon=True)
+
+    def start(self) -> "MemPoller":
+        self._thread.start()
+        # stop the poller before interpreter teardown even on abrupt
+        # exits: a daemon thread inside the runtime's memory_stats RPC
+        # while the backend is being destroyed is a segfault, not an
+        # exception (close() is idempotent — normal owners still call
+        # it from their finallys)
+        atexit.register(self.close)
+        return self
+
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def poll_once(self) -> bool:
+        """One sample; False when the thread should exit (injected
+        death)."""
+        if sys.is_finalizing():
+            return False
+        try:
+            _faults().maybe_fail("mem_poll")
+            stats = self._stats_fn()
+        except SystemExit:
+            return False            # injected death: exit silently
+        except Exception:
+            self._reg.counter("device.mem_poll_errors").inc()
+            return True
+        self._reg.counter("device.mem_polls").inc()
+        if not stats:
+            return True
+        in_use = stats.get("bytes_in_use")
+        limit = stats.get("bytes_limit")
+        if in_use is not None:
+            self._reg.gauge("device.mem_bytes_in_use").set(in_use)
+        if limit:
+            self._reg.gauge("device.mem_bytes_limit").set(limit)
+            if in_use is not None:
+                self._reg.gauge("device.mem_frac_used").set(
+                    in_use / limit)
+        peak = stats.get("peak_bytes_in_use")
+        if peak is not None:
+            self._reg.gauge("device.mem_peak_bytes_in_use").set(peak)
+        return True
+
+    def _loop(self) -> None:
+        while True:
+            if not self.poll_once():
+                return
+            if self._stop.wait(self._interval):
+                return
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)   # a hung poller is abandoned
+        #                                  (daemon), never waited out
+        atexit.unregister(self.close)    # don't accumulate one atexit
+        #                                  entry per closed run
+
+
+# -------------------------------------------------------- profile capture
+
+
+class ProfileCapture:
+    """On-demand `jax.profiler` capture spanning N dispatches, driven
+    entirely OFF the dispatch path.
+
+    A worker thread owns the profiler start/stop calls (`start_fn(dir)`
+    / `stop_fn()` — the engine passes jax.profiler closures, keeping
+    this module jax-free); the dispatch loop only calls `on_dispatch()`
+    — a lock-guarded counter decrement — and the HTTP front only calls
+    `trigger(n)` — a state flip plus a worker wake. The first capture
+    in a process pays jax.profiler's lazy profiler-plugin import
+    (tensorflow — tens of seconds) ON THE WORKER, so a short run may
+    end before its capture starts; close() then guarantees the late
+    start is abandoned rather than leaving a stray session (the
+    `_closed` re-check below). On-demand profiling targets long-lived
+    runs and serve processes, where the one-time import is noise; for
+    one-dispatch captures of short runs `--trace-profile` (main
+    thread, synchronous) remains the tool. Fault site
+    `profile` fires on the worker around each start/stop: `hang` parks
+    the worker (the capture never materializes; dispatches continue),
+    `die` ends it — either way nothing on the solve path blocks (tests
+    pin it). One capture at a time: `trigger` while one is active
+    answers busy instead of queueing."""
+
+    def __init__(self, start_fn, stop_fn, default_dir: str | None = None,
+                 registry=None):
+        self._start_fn = start_fn
+        self._stop_fn = stop_fn
+        self.default_dir = default_dir or "tt-profile"
+        self._reg = (obs_metrics.REGISTRY if registry is None
+                     else registry)
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._cmd = None          # ("start", n, dir) | ("stop",) | close
+        self._busy = False        # trigger accepted, capture not closed
+        self._remaining = 0       # dispatches left in the live capture
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._worker, name="tt-profile", daemon=True)
+        self._thread.start()
+        # close (stopping any live capture) before interpreter
+        # teardown on abrupt exits — an active profiler session plus a
+        # half-destroyed backend is a crash at exit, not an error.
+        # Idempotent; normal owners still close() from their finallys.
+        atexit.register(self.close)
+
+    def trigger(self, n: int, out_dir: str | None = None) -> dict:
+        """Request a capture of the next `n` dispatches. Returns the
+        ack the /profile endpoint serializes."""
+        n = max(1, int(n))
+        with self._lock:
+            if self._closed:
+                return {"ok": False, "reason": "capture closed"}
+            if self._busy:
+                return {"ok": False, "reason": "capture already active"}
+            self._busy = True
+            self._cmd = ("start", n, out_dir or self.default_dir)
+        self._wake.set()
+        return {"ok": True, "dispatches": n,
+                "dir": out_dir or self.default_dir}
+
+    def on_dispatch(self) -> None:
+        """One dispatch retired (called by the engine/serve loops;
+        never blocks beyond the counter lock)."""
+        with self._lock:
+            if self._remaining <= 0:
+                return
+            self._remaining -= 1
+            if self._remaining > 0:
+                return
+            self._cmd = ("stop",)
+        self._wake.set()
+
+    def active(self) -> bool:
+        with self._lock:
+            return self._busy
+
+    def _worker(self) -> None:
+        while True:
+            self._wake.wait()
+            self._wake.clear()
+            with self._lock:
+                cmd, self._cmd = self._cmd, None
+                if self._closed and cmd is None:
+                    return
+            if cmd is None:
+                continue
+            if cmd[0] == "start":
+                try:
+                    _faults().maybe_fail("profile")
+                except SystemExit:
+                    return          # injected death: dispatches go on
+                with self._lock:
+                    if self._closed:
+                        # close() won the race while this worker was
+                        # parked (the `hang` fault): starting now
+                        # would leave a stray profiler session nobody
+                        # stops — poisoning every later capture in the
+                        # process
+                        self._busy = False
+                        return
+                try:
+                    self._start_fn(cmd[2])
+                except SystemExit:
+                    return
+                except Exception as e:
+                    print(f"warning: profiler capture failed to start: "
+                          f"{str(e)[:120]}", file=sys.stderr)
+                    with self._lock:
+                        self._busy = False
+                    continue
+                self._reg.counter("profile.captures").inc()
+                with self._lock:
+                    self._remaining = cmd[1]
+            elif cmd[0] == "stop":
+                try:
+                    _faults().maybe_fail("profile")
+                    self._stop_fn()
+                except SystemExit:
+                    return
+                except Exception as e:
+                    print(f"warning: profiler capture failed to stop: "
+                          f"{str(e)[:120]}", file=sys.stderr)
+                with self._lock:
+                    self._busy = False
+                    self._remaining = 0
+            # a close() that arrived WITH the command just processed
+            # (its wake was consumed above) must end the worker now —
+            # looping back to wait() would park the thread forever and
+            # make every such close() burn its full join timeout. And
+            # if close() raced the START just performed (it checked
+            # _remaining before this worker set it, so it queued no
+            # stop), the live session must be stopped HERE — returning
+            # with it open would leave a stray profiler session nobody
+            # ever stops (the docstring's abandonment guarantee).
+            with self._lock:
+                if not (self._closed and self._cmd is None):
+                    continue
+                live = self._remaining > 0
+                self._busy = False
+                self._remaining = 0
+            if live:
+                try:
+                    self._stop_fn()
+                except Exception:
+                    pass
+            return
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            if self._busy and self._remaining > 0:
+                self._remaining = 0
+                self._cmd = ("stop",)
+        self._wake.set()
+        self._thread.join(timeout=2.0)   # hung worker: abandoned daemon
+        atexit.unregister(self.close)
+
+
+# ------------------------------------------------------- tt profile (CLI)
+
+
+def main_profile(argv) -> int:
+    """`tt profile <url> [--for N]` — trigger an on-demand profiler
+    capture on a live run/serve process through its `--obs-listen`
+    front (GET /profile?for=N). Stdlib-only and device-free, like
+    `tt trace`/`tt stats`: it talks to the process, it is not one."""
+    url, n = None, 1
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("-h", "--help"):
+            print("usage: tt profile <http://host:port> [--for N]\n\n"
+                  "ask a live run (--obs-listen) to capture a "
+                  "jax.profiler trace of its next N dispatches into "
+                  "its --profile-dir; view with tensorboard/xprof")
+            return 0
+        if a == "--for":
+            if i + 1 >= len(argv):
+                raise SystemExit("flag --for needs a value")
+            n = int(argv[i + 1])
+            i += 2
+            continue
+        if url is None:
+            url = a
+            i += 1
+            continue
+        raise SystemExit(f"unknown argument: {a}")
+    if url is None:
+        raise SystemExit("usage: tt profile <http://host:port> "
+                         "[--for N]")
+    if "://" not in url:
+        url = "http://" + url
+    import json as _json
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+                f"{url.rstrip('/')}/profile?for={int(n)}",
+                timeout=10) as resp:
+            body = _json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            body = _json.loads(e.read().decode())
+        except Exception:
+            body = {"ok": False, "reason": str(e)}
+    except Exception as e:
+        raise SystemExit(f"tt profile: {e}") from None
+    print(_json.dumps(body))
+    return 0 if body.get("ok") else 1

@@ -16,8 +16,15 @@ scrapes and k8s-style probes need no sidecar at all:
              owner registers; 503 when any probe fails)
   /readyz    readiness derived from REGISTRY state alone: queue depth
              vs the admission bound, the fault supervisor's degradation
-             ladder level, and the remaining recovery budget — 503
-             flips exactly when the stack is shedding or degraded
+             ladder level (which steps back UP after a clean stretch,
+             so the reason clears live), the remaining recovery budget,
+             and the memory poller's near-HBM fraction — 503 flips
+             exactly when the stack is shedding, degraded, or about to
+             OOM
+  /profile   on-demand profiler trigger (?for=N): flips the cost
+             observatory's ProfileCapture state and wakes ITS worker
+             thread — no blocking I/O, no registry touch (TT602-pure);
+             `tt profile URL --for N` is the stdlib client
 
 Design rules (enforced by tt-analyze TT602):
 
@@ -48,6 +55,7 @@ import http.server
 import json
 import threading
 
+from timetabling_ga_tpu.obs import cost as obs_cost
 from timetabling_ga_tpu.obs import metrics as obs_metrics
 from timetabling_ga_tpu.runtime import faults
 
@@ -79,12 +87,19 @@ def readiness(registry) -> tuple[bool, dict]:
       - `serve.queue_depth` >= `serve.backlog` (admission would reject
         — new work should be routed to another replica);
       - `engine.degrade_level` >= 2 (the fault supervisor's ladder is
-        past 'serial': the process is shrinking dispatches to survive);
+        past 'serial': the process is shrinking dispatches to survive;
+        the ladder also steps back UP after a clean stretch —
+        engine._Supervisor.maybe_relax — so this reason CLEARS live,
+        it is not a one-way trip);
       - `engine.recovery_budget_remaining` <= 0 while recovery was
-        configured (the next transient failure aborts the run).
+        configured (the next transient failure aborts the run);
+      - `device.mem_frac_used` >= obs/cost.py NEAR_HBM_FRAC (the cost
+        observatory's memory poller says the next placement is an OOM
+        gamble — route new work elsewhere until the pressure clears).
 
     Absent gauges (an engine run has no serve queue; a serve process
-    may never have set the ladder) are simply not conditions."""
+    may never have set the ladder; no memory poller on CPU) are simply
+    not conditions."""
     gauges = registry.snapshot().get("gauges", {})
     reasons = []
     depth = gauges.get("serve.queue_depth")
@@ -99,10 +114,14 @@ def readiness(registry) -> tuple[bool, dict]:
     if budget is not None and budget <= 0 and gauges.get(
             "engine.recovery_budget_configured", 0) > 0:
         reasons.append("recovery_exhausted")
+    mem_frac = gauges.get("device.mem_frac_used")
+    if mem_frac is not None and mem_frac >= obs_cost.NEAR_HBM_FRAC:
+        reasons.append("near_hbm_limit")
     return not reasons, {"ready": not reasons, "reasons": reasons,
                          "queue_depth": depth, "backlog": bound,
                          "degrade_level": level,
-                         "recovery_budget_remaining": budget}
+                         "recovery_budget_remaining": budget,
+                         "mem_frac_used": mem_frac}
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):
@@ -129,10 +148,34 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             # have.
             self.close_connection = True
             return
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/metrics":
             body = self.server.registry.to_openmetrics().encode()
             self._reply(200, body, OPENMETRICS_CT)
+        elif path == "/profile":
+            # the cost observatory's on-demand capture trigger
+            # (obs/cost.py ProfileCapture; `tt profile` is the client).
+            # TT602-pure by design: trigger() flips state and wakes the
+            # capture WORKER thread — this handler does no blocking I/O
+            # and touches no registry instrument; the jax.profiler
+            # calls happen on the worker, never here.
+            capture = getattr(self.server, "profile", None)
+            if capture is None:
+                self._reply_json(404, {"ok": False,
+                                       "reason": "no profile capture "
+                                                 "wired (--profile-dir"
+                                                 "/--profile-for)"})
+                return
+            params = dict(
+                p.split("=", 1) for p in query.split("&") if "=" in p)
+            try:
+                n = int(params.get("for", 1))
+            except ValueError:
+                self._reply_json(400, {"ok": False,
+                                       "reason": "for must be an int"})
+                return
+            ack = capture.trigger(n)
+            self._reply_json(200 if ack.get("ok") else 409, ack)
         elif path == "/healthz":
             probes = {}
             for name, fn in self.server.probes.items():
@@ -183,16 +226,19 @@ class ObsServer:
     `start()`, stop on `close()`.
 
     `probes` maps name -> zero-arg callable for /healthz (the owner
-    registers e.g. its AsyncWriter's worker liveness). The registry
-    defaults to THE process REGISTRY — the same numbers every other
-    consumer sees."""
+    registers e.g. its AsyncWriter's worker liveness). `profile` is an
+    optional obs/cost.py ProfileCapture the /profile endpoint triggers
+    (absent: 404). The registry defaults to THE process REGISTRY — the
+    same numbers every other consumer sees."""
 
-    def __init__(self, listen: str, registry=None, probes=None):
+    def __init__(self, listen: str, registry=None, probes=None,
+                 profile=None):
         host, port = parse_listen(listen)
         self._srv = _Server((host, port), _Handler)
         self._srv.registry = (obs_metrics.REGISTRY if registry is None
                               else registry)
         self._srv.probes = dict(probes or {})
+        self._srv.profile = profile
         self._thread = threading.Thread(
             target=self._serve, name="tt-obs-listen", daemon=True)
         self._state_lock = threading.Lock()

@@ -99,6 +99,7 @@ def summarize(records) -> str:
     faults: list = []
     jobs: dict = {}         # job id -> lifecycle events
     spans: list = []        # spanEntry bodies (per-job breakdown)
+    compiles: list = []     # costEntry bodies (compile accounting)
     counts: dict = {}
     last_metrics = None
     for rec in records:
@@ -120,6 +121,8 @@ def summarize(records) -> str:
         elif kind == "spanEntry":
             if body.get("job") is not None:
                 spans.append(body)
+        elif kind == "costEntry":
+            compiles.append(body)
         elif kind == "metricsEntry":
             last_metrics = body
 
@@ -210,6 +213,29 @@ def summarize(records) -> str:
             lines.append(f"  {comp}: p50 {_pctl(vals, 0.5):.2f}s "
                          f"p99 {_pctl(vals, 0.99):.2f}s "
                          f"max {vals[-1]:.2f}s")
+
+    if compiles:
+        # cost observatory (obs/cost.py): per-program compile count,
+        # total lower+compile seconds, and the latest roofline numbers
+        lines.append(f"== compiles ({len(compiles)} costEntry records)")
+        by_prog: dict = {}
+        for c in compiles:
+            by_prog.setdefault(c.get("program", "?"), []).append(c)
+        for prog, cs in sorted(by_prog.items()):
+            total = sum(float(c.get("lowerSeconds", 0.0))
+                        + float(c.get("compileSeconds", 0.0))
+                        for c in cs)
+            # latest entry CARRYING roofline numbers (a backend may
+            # omit flops on some compiles)
+            last = next((c for c in reversed(cs)
+                         if c.get("flops") is not None), cs[-1])
+            tail = ""
+            if last.get("flops") is not None:
+                tail = f" flops {last['flops']:.3g}"
+                if last.get("intensity") is not None:
+                    tail += f" AI {last['intensity']:.1f}"
+            lines.append(f"  {prog}: {len(cs)}x, {total:.2f}s "
+                         f"lower+compile{tail}")
 
     if last_metrics is not None:
         lines.append("== last metrics snapshot")

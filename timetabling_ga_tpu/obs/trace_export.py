@@ -28,6 +28,12 @@ timeline. Mapping:
   metricsEntry -> counter events (ph "C") for every numeric counter/
                   gauge, at the snapshot's `ts` — Perfetto renders
                   them as tracks (gens/sec, queue depth over time)
+  costEntry    -> complete event on the "compiles" lane (tid 998): a
+                  slab of lowerSeconds+compileSeconds ENDING at the
+                  record's `ts` (the observatory stamps emission right
+                  after the compile returns), named
+                  compile:<program> — XLA compile cost sits on the
+                  same screen as the dispatches it delayed
 
 `--job ID` filters to ONE job's causal trace: the spans tagged
 `job=ID` (scalar, or carrying ID in a packed dispatch's job list),
@@ -140,6 +146,19 @@ def export_chrome_trace(records, job: str | None = None) -> dict:
             spans.append(rec["spanEntry"])
         elif job is None and "metricsEntry" in rec:
             events.extend(_counter_events(rec["metricsEntry"]))
+        elif job is None and "costEntry" in rec:
+            c = rec["costEntry"]
+            ts = c.get("ts")
+            if ts is not None:
+                dur = max(0.0, float(c.get("lowerSeconds", 0.0))
+                          + float(c.get("compileSeconds", 0.0)))
+                args = {k: v for k, v in c.items()
+                        if k not in ("ts", "program")}
+                events.append(
+                    {"name": f"compile:{c.get('program', '?')}",
+                     "cat": "compile", "ph": "X", "pid": 0, "tid": 998,
+                     "ts": round(max(0.0, float(ts) - dur) * 1e6, 3),
+                     "dur": round(dur * 1e6, 3), "args": args})
         elif job is None and "phase" in rec:
             p = rec["phase"]
             dur = max(0.0, float(p.get("seconds", 0.0)))

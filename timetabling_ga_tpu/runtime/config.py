@@ -207,6 +207,22 @@ class RunConfig:
     #                           one mid-run dispatch into this directory
     #                           (SURVEY section 5 tracing; view with
     #                           tensorboard / xprof)
+    # ---- cost observatory (tt-obs v3; obs/cost.py, README "Cost
+    # observatory"). Compile accounting and roofline gauges are always
+    # on (like every other registry metric); these flags drive the two
+    # observatory THREADS:
+    profile_dir: Optional[str] = None  # jax.profiler output directory
+    #                           for on-demand captures (`tt profile` /
+    #                           GET /profile on --obs-listen /
+    #                           --profile-for); default "tt-profile"
+    profile_for: int = 0      # > 0: capture the run's first N
+    #                           dispatches at launch (the on-demand
+    #                           trigger without a listener round trip)
+    mem_poll_every: float = 1.0  # seconds between device memory_stats()
+    #                           samples on the poller thread (feeds
+    #                           device.mem_* gauges + the /readyz
+    #                           near_hbm_limit reason; runs only under
+    #                           --obs/--obs-listen; 0 disables)
     precompile: bool = True   # CLI compiles every dispatchable program
     #                           before the timed run (ADVICE round 4:
     #                           --no-precompile skips the probe
@@ -403,6 +419,9 @@ _FLAG_MAP = {
     "--epochs-per-dispatch": ("epochs_per_dispatch", int),
     "--kick-stall": ("kick_stall", int),
     "--trace-profile": ("trace_profile", str),
+    "--profile-dir": ("profile_dir", str),
+    "--profile-for": ("profile_for", int),
+    "--mem-poll-every": ("mem_poll_every", float),
     "--trace-mode": ("trace_mode", str),
     "--metrics-every": ("metrics_every", int),
     "--obs-listen": ("obs_listen", str),
@@ -523,6 +542,12 @@ def parse_args(argv) -> RunConfig:
         raise SystemExit("--metrics-every must be >= 0 dispatches "
                          "(0 = only the end-of-try snapshot)")
     _validate_obs_listen(cfg.obs_listen)
+    if cfg.profile_for < 0:
+        raise SystemExit("--profile-for must be >= 0 dispatches "
+                         "(0 = no launch-time capture)")
+    if cfg.mem_poll_every < 0:
+        raise SystemExit("--mem-poll-every must be >= 0 seconds "
+                         "(0 disables the device memory poller)")
     if cfg.coordinator is not None and (cfg.num_processes is None
                                         or cfg.process_id is None):
         raise SystemExit("--coordinator requires --num-processes and "
@@ -607,8 +632,17 @@ class ServeConfig:
     metrics_every: int = 10       # dispatches between metricsEntry
     #                               snapshots under --obs
     obs_listen: Optional[str] = None  # HOST:PORT pull front (/metrics
-    #                               with exemplars, /healthz, /readyz) —
-    #                               same semantics as RunConfig's
+    #                               with exemplars, /healthz, /readyz,
+    #                               /profile) — same semantics as
+    #                               RunConfig's
+    # ---- cost observatory (obs/cost.py; same semantics as
+    # RunConfig's): the device memory poller and the on-demand
+    # profiler capture
+    profile_dir: Optional[str] = None
+    profile_for: int = 0          # capture the service's first N
+    #                               dispatches at launch
+    mem_poll_every: float = 1.0   # device memory_stats() cadence
+    #                               (under --obs/--obs-listen; 0 = off)
     # ---- admission/backpressure (the scheduler reads its own metrics
     # registry at every control fence and sheds the lowest-priority
     # runnable work while a depth is at/over its high-water mark;
@@ -646,6 +680,9 @@ _SERVE_FLAG_MAP = {
     "--trace-mode": ("trace_mode", str),
     "--metrics-every": ("metrics_every", int),
     "--obs-listen": ("obs_listen", str),
+    "--profile-dir": ("profile_dir", str),
+    "--profile-for": ("profile_for", int),
+    "--mem-poll-every": ("mem_poll_every", float),
     "--shed-queue-hwm": ("shed_queue_hwm", int),
     "--shed-writer-hwm": ("shed_writer_hwm", int),
     "--faults": ("faults", str),
@@ -676,6 +713,10 @@ def parse_serve_args(argv) -> ServeConfig:
     if cfg.metrics_every < 0:
         raise SystemExit("--metrics-every must be >= 0 dispatches")
     _validate_obs_listen(cfg.obs_listen)
+    if cfg.profile_for < 0:
+        raise SystemExit("--profile-for must be >= 0 dispatches")
+    if cfg.mem_poll_every < 0:
+        raise SystemExit("--mem-poll-every must be >= 0 seconds")
     if cfg.shed_queue_hwm < 0 or cfg.shed_writer_hwm < 0:
         raise SystemExit("--shed-queue-hwm / --shed-writer-hwm must be "
                          ">= 0 (0 disables that shed trigger)")

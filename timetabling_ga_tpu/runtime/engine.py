@@ -106,6 +106,7 @@ import time
 import jax
 import numpy as np
 
+from timetabling_ga_tpu.obs import cost as obs_cost
 from timetabling_ga_tpu.obs import metrics as obs_metrics
 from timetabling_ga_tpu.obs.spans import NULL_TRACER, SpanTracer
 from timetabling_ga_tpu.ops import ga
@@ -128,6 +129,12 @@ FEASIBLE_LIMIT = 1_000_000
 # made every timed run recompile inside its own wall-clock budget even
 # after a warm-up run with identical shapes. Keyed on the mesh's device
 # identity plus every static that changes the traced program.
+# Every program cached here is wrapped by the cost observatory
+# (obs/cost.py instrument): an AOT-dispatching proxy that times each
+# lower+compile, extracts the executable's cost/memory analyses into
+# the compile.* / cost.* metric families (and costEntry records under
+# --obs), and counts warm dispatches — the compile-hit rate the serve
+# path steers on. TT_COST_OBS=0 bypasses the wrapping (plain jit).
 _RUNNER_CACHE: dict = {}
 _INIT_CACHE: dict = {}
 
@@ -187,10 +194,11 @@ def cached_runner(mesh, gacfg: ga.GAConfig, n_epochs: int, gens: int,
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
-    r = islands.make_island_runner(mesh, gacfg, n_epochs=n_epochs,
+    r = obs_cost.instrument(
+        islands.make_island_runner(mesh, gacfg, n_epochs=n_epochs,
                                    gens_per_epoch=gens,
                                    n_islands=n_islands, donate=donate,
-                                   trace_mode=trace_mode)
+                                   trace_mode=trace_mode), "runner")
     _RUNNER_CACHE[k] = r
     return r, False
 
@@ -206,10 +214,12 @@ def cached_dynamic_runner(mesh, gacfg: ga.GAConfig, max_gens: int, sig,
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
-    r = islands.make_island_runner_dynamic(mesh, gacfg, max_gens,
+    r = obs_cost.instrument(
+        islands.make_island_runner_dynamic(mesh, gacfg, max_gens,
                                            n_islands=n_islands,
                                            donate=donate,
-                                           trace_mode=trace_mode)
+                                           trace_mode=trace_mode),
+        "dyn_runner")
     _RUNNER_CACHE[k] = r
     return r, False
 
@@ -219,8 +229,10 @@ def cached_init(mesh, pop_size: int, gacfg: ga.GAConfig,
     k = (_mesh_key(mesh), pop_size, gacfg, n_islands)
     f = _INIT_CACHE.get(k)
     if f is None:
-        f = jax.jit(lambda pa, key: islands.init_island_population(
-            pa, key, mesh, pop_size, gacfg, n_islands=n_islands))
+        f = obs_cost.instrument(
+            jax.jit(lambda pa, key: islands.init_island_population(
+                pa, key, mesh, pop_size, gacfg, n_islands=n_islands)),
+            "init")
         _INIT_CACHE[k] = f
     return f
 
@@ -241,8 +253,15 @@ def cached_lane_runner(mesh, gacfg: ga.GAConfig, max_gens: int,
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
-    r = islands.make_lane_runner(mesh, gacfg, max_gens, n_lanes,
-                                 donate=donate, trace_mode=trace_mode)
+    # the observatory's per-signature accounting makes serve's compile
+    # story measurable: the lane program's input SIGNATURE is the shape
+    # bucket (pad_problem), so compile.count.lane_runner counts bucket
+    # compiles and compile.cache_hits counts bucket-warm dispatches —
+    # the compile-hit rate bucket-affine routing steers on
+    r = obs_cost.instrument(
+        islands.make_lane_runner(mesh, gacfg, max_gens, n_lanes,
+                                 donate=donate, trace_mode=trace_mode),
+        "lane_runner")
     _RUNNER_CACHE[k] = r
     return r, False
 
@@ -254,7 +273,9 @@ def cached_lane_init(mesh, pop_size: int, gacfg: ga.GAConfig,
     k = ("lane-init", _mesh_key(mesh), pop_size, gacfg, n_lanes)
     f = _INIT_CACHE.get(k)
     if f is None:
-        f = islands.make_lane_init(mesh, pop_size, gacfg, n_lanes)
+        f = obs_cost.instrument(
+            islands.make_lane_init(mesh, pop_size, gacfg, n_lanes),
+            "lane_init")
         _INIT_CACHE[k] = f
     return f
 
@@ -341,8 +362,9 @@ def cached_kick_runner(mesh, gacfg: ga.GAConfig, sig, n_islands: int,
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
-    r = islands.make_kick_runner(mesh, gacfg, n_islands=n_islands,
-                                 donate=donate)
+    r = obs_cost.instrument(
+        islands.make_kick_runner(mesh, gacfg, n_islands=n_islands,
+                                 donate=donate), "kick")
     _RUNNER_CACHE[k] = r
     return r, False
 
@@ -374,9 +396,12 @@ def cached_lahc_runners(mesh, gacfg: ga.GAConfig, hist_len: int,
          with_moments)
     r = _RUNNER_CACHE.get(k)
     if r is None:
-        r = islands.make_lahc_runners(mesh, gacfg, hist_len, k_cands,
-                                      n_islands, donate=donate,
-                                      with_moments=with_moments)
+        init_r, run_r, fin_r = islands.make_lahc_runners(
+            mesh, gacfg, hist_len, k_cands, n_islands, donate=donate,
+            with_moments=with_moments)
+        r = (obs_cost.instrument(init_r, "lahc_init"),
+             obs_cost.instrument(run_r, "lahc_run"),
+             obs_cost.instrument(fin_r, "lahc_fin"))
         _RUNNER_CACHE[k] = r
     return r
 
@@ -388,7 +413,9 @@ def cached_shrink_runner(mesh, pop_in: int, pop_out: int,
     k = ("shrink", _mesh_key(mesh), pop_in, pop_out, n_islands)
     r = _RUNNER_CACHE.get(k)
     if r is None:
-        r = islands.make_shrink_runner(mesh, pop_in, pop_out, n_islands)
+        r = obs_cost.instrument(
+            islands.make_shrink_runner(mesh, pop_in, pop_out,
+                                       n_islands), "shrink")
         _RUNNER_CACHE[k] = r
     return r
 
@@ -405,9 +432,10 @@ def cached_polish_runner(mesh, gacfg: ga.GAConfig, sig,
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
-    r = islands.make_polish_runner(mesh, gacfg, n_islands=n_islands,
+    r = obs_cost.instrument(
+        islands.make_polish_runner(mesh, gacfg, n_islands=n_islands,
                                    donate=donate,
-                                   with_passes=with_passes)
+                                   with_passes=with_passes), "polish")
     _RUNNER_CACHE[k] = r
     return r, False
 
@@ -474,9 +502,12 @@ def build_post_config(cfg: RunConfig, gacfg: ga.GAConfig):
 # (see _run_tries): `trace` is the chunk's DEVICE-side telemetry array,
 # fenced only when the chunk is retired by _process; `flow` is the
 # chunk's causal flow id (obs/spans.py new_flow) connecting its
-# dispatch / fetch / fetch-read / process spans across threads
+# dispatch / fetch / fetch-read / process spans across threads;
+# `cost` is the dispatched program's compile-time cost dict
+# (obs/cost.py CostProgram.last_cost — flops/bytes), joined with the
+# chunk's measured wall time into the live roofline gauges at retire
 _Chunk = collections.namedtuple(
-    "_Chunk", "td0 n_ep gens_run dyn_gens trace warm do_prof flow")
+    "_Chunk", "td0 n_ep gens_run dyn_gens trace warm do_prof flow cost")
 
 def run_counters() -> dict:
     """Back-compat view of the process robustness counters, now held by
@@ -560,6 +591,7 @@ class _Supervisor:
         self.recoveries = 0
         self.level = 0
         self.failures: list = []     # monotonic fail times (ladder window)
+        self._relaxed_at: float | None = None   # last step-back-UP time
 
     def snapshot(self, **kw) -> None:
         if self.enabled:
@@ -590,6 +622,30 @@ class _Supervisor:
             self.level = new_level
             return True
         return False
+
+    def maybe_relax(self, now: float) -> bool:
+        """Step the ladder back UP (one level per clean WINDOW_S):
+        before this the ladder only ever worsened within a run, so one
+        early sick window left the whole rest of a long run serialized
+        and chunk-halved — and /readyz stuck on `degraded` — even
+        after the device recovered (carried ROADMAP item). A stretch
+        of WINDOW_S with no failure since the last failure OR the last
+        relax earns one level back; the engine re-enables pipelining
+        when level 0 is reached and the degrade_level gauge follows
+        live, so the /readyz reason clears. Returns True when the
+        level changed (the caller emits the faultEntry `restore`
+        record)."""
+        if self.level <= 0:
+            return False
+        anchor = self.failures[-1] if self.failures else None
+        if self._relaxed_at is not None:
+            anchor = (self._relaxed_at if anchor is None
+                      else max(anchor, self._relaxed_at))
+        if anchor is not None and now - anchor < self.WINDOW_S:
+            return False
+        self.level -= 1
+        self._relaxed_at = now
+        return True
 
 
 _DISTRIBUTED_DONE = False
@@ -1072,6 +1128,8 @@ def run(cfg: RunConfig, out=None) -> int:
 
     writer = None
     obs_srv = None
+    mem_poller = None
+    prof_cap = None
     try:
         # all record emission (and checkpoint serialization, via
         # submit()) rides the background writer thread so the dispatch
@@ -1090,6 +1148,29 @@ def run(cfg: RunConfig, out=None) -> int:
         obs_metrics.REGISTRY.gauge_fn("writer.queue_depth", writer.qsize)
         obs_metrics.REGISTRY.gauge_fn(
             "writer.records", lambda: writer.records_written)
+        # cost observatory (obs/cost.py): compile accounting runs
+        # always; costEntry record emission binds to THIS run's writer
+        # only under --obs (the stream is identical either way —
+        # costEntry is a timing record, and obs-off binds nothing)
+        obs_cost.OBSERVATORY.bind(writer if cfg.obs else None,
+                                  now=tracer.now)
+        if (cfg.obs or cfg.obs_listen) and cfg.mem_poll_every > 0:
+            # device memory telemetry OFF the dispatch path: its own
+            # daemon thread samples memory_stats() (a host-sync hazard
+            # anywhere near dispatch — tt-analyze TT603) into the
+            # device.mem_* gauges /readyz reads
+            mem_poller = obs_cost.MemPoller(
+                obs_cost.jax_memory_stats_fn(),
+                cfg.mem_poll_every).start()
+        if cfg.profile_for > 0 or cfg.obs_listen:
+            # on-demand profiler capture, driven from its own worker
+            # thread; the dispatch loop only ticks a counter
+            prof_cap = obs_cost.ProfileCapture(
+                lambda d: jax.profiler.start_trace(d),
+                jax.profiler.stop_trace,
+                default_dir=cfg.profile_dir)
+            if cfg.profile_for > 0:
+                prof_cap.trigger(cfg.profile_for)
         if cfg.obs_listen:
             # the pull front (obs/http.py): /metrics OpenMetrics with
             # exemplars, /healthz probing THIS run's writer thread,
@@ -1101,9 +1182,10 @@ def run(cfg: RunConfig, out=None) -> int:
             obs_srv = obs_http.ObsServer(
                 cfg.obs_listen,
                 probes={"process": lambda: True,
-                        "writer": writer.alive}).start()
+                        "writer": writer.alive},
+                profile=prof_cap).start()
         try:
-            ret = _run_tries(cfg, writer, tracer)
+            ret = _run_tries(cfg, writer, tracer, profiler=prof_cap)
         except BaseException:
             writer.close(raise_error=False)
             raise
@@ -1112,6 +1194,13 @@ def run(cfg: RunConfig, out=None) -> int:
     finally:
         if obs_srv is not None:
             obs_srv.close()
+        if prof_cap is not None:
+            prof_cap.close()
+        if mem_poller is not None:
+            mem_poller.close()
+        # unbind the observatory's costEntry emitter: the global must
+        # not hold this run's writer (same rule as the pull gauges)
+        obs_cost.OBSERVATORY.unbind()
         # unbind the writer pull gauges: the registry is process-global,
         # so a bound closure would keep THIS run's writer (and its
         # output stream) alive for the process lifetime. Freeze at the
@@ -1334,7 +1423,8 @@ def _lahc_loop(out, cfg, pa, mesh, state, base_key, t_try, reserve,
     return state
 
 
-def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
+def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
+               profiler=None) -> int:
     t0 = time.monotonic()
     mreg = obs_metrics.REGISTRY
     trace_mode = cfg.trace_mode
@@ -1578,6 +1668,10 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
         pipelined = bool(cfg.pipeline and gacfg_post is None
                          and jax.process_count() == 1
                          and cfg.trace_profile is None)
+        # what the ladder restores to when it steps back to level 0
+        # (maybe_relax): the run's CONFIGURED pipelining, not whatever
+        # a degraded stretch left behind
+        pipelined_cfg = pipelined
         pending = None     # the one in-flight chunk (pipelined mode)
         n_dispatch = 0
         last_fence = None  # wall time of the previous chunk's fence
@@ -1601,7 +1695,7 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
             nonlocal epochs_at_ckpt, last_fence, host_gap_s
             nonlocal overflow_warned
             (td0, n_ep, gens_run, dyn_gens, trace_dev, warm,
-             do_prof, flow) = chunk            # _Chunk fields
+             do_prof, flow, chunk_cost) = chunk   # _Chunk fields
             tf0 = time.monotonic()
             trace = _fetch(trace_dev, tracer=tracer,
                            flow=flow or None)  # blocks on the dispatch
@@ -1651,6 +1745,12 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
                 dt, exemplar={"dispatch": str(n_dispatch)})
             if dt > 0:
                 mreg.gauge("engine.gens_per_sec").set(gens_run / dt)
+            # live roofline: the program's compile-time FLOP/byte
+            # counts (obs/cost.py — free at compile, a recompile
+            # hazard anywhere else: TT603) over the chunk's own
+            # measured wall time — bench's kernel_cost placement,
+            # per dispatch, while the run is still going
+            obs_cost.set_live_roofline(chunk_cost, dt)
             loop_s = td1 - t_loop
             if loop_s > 0:
                 mreg.gauge("engine.device_busy_frac").set(
@@ -1733,6 +1833,11 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
                     float(ev_moments[:, 3].max()))
             tracer.record("process", td1, time.monotonic() - td1,
                           cat="engine", gens=gens_run, flow=flow)
+            if profiler is not None:
+                # tick the on-demand capture (a lock-guarded counter —
+                # the jax.profiler start/stop happen on ITS worker, so
+                # a hung capture can never stall this loop)
+                profiler.on_dispatch()
             if (cfg.obs and cfg.metrics_every > 0
                     and n_dispatch % cfg.metrics_every == 0):
                 jsonl.metrics_entry(out, mreg.snapshot(),
@@ -1918,6 +2023,25 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
         while True:
             try:
                 while not lahc_done and gens_done < cfg.generations:
+                    if (sup.enabled and sup.level > 0
+                            and sup.maybe_relax(time.monotonic())):
+                        # recovery ladder step-back-UP after a clean
+                        # WINDOW_S stretch (carried ROADMAP item): the
+                        # gauge moves first so /readyz's `degraded`
+                        # reason clears LIVE, the faultEntry `restore`
+                        # record makes the step auditable offline, and
+                        # level 0 re-enables the configured pipelining
+                        mreg.gauge("engine.degrade_level").set(
+                            sup.level)
+                        jsonl.fault_entry(
+                            out, "run", "restore", "clean stretch",
+                            trial, sup.recoveries, sup.level,
+                            time.monotonic() - t_try,
+                            mode=("pipelined" if sup.level == 0 else
+                                  "serial" if sup.level == 1 else
+                                  f"chunk-1/{2 ** (sup.level - 1)}"))
+                        if sup.level < 1:
+                            pipelined = pipelined_cfg
                     if pending is not None and sec_per_gen is None:
                         # no cost estimate for the in-flight chunk (e.g.
                         # --no-precompile before the first warm measurement):
@@ -2079,8 +2203,16 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER) -> int:
                     gens_done += gens_run
                     epochs_done += n_ep
                     n_dispatch += 1
+                    # a compiling dispatch's wall time is compile +
+                    # execute: feeding it to the roofline gauges would
+                    # crater them on every cold dispatch, so the chunk
+                    # carries no cost then (compile.seconds owns that
+                    # time under its own name)
                     chunk = _Chunk(td0, n_ep, gens_run, dyn_gens, trace_dev,
-                                   warm, do_prof, flow_id)
+                                   warm, do_prof, flow_id,
+                                   None if getattr(runner, "last_compiled",
+                                                   False)
+                                   else getattr(runner, "last_cost", None))
                     if pipelined:
                         # retire the PREVIOUS chunk with this one already
                         # running: its telemetry cost hides behind device

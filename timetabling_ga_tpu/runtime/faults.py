@@ -43,9 +43,12 @@ inside the watchdog thread), `writer` (AsyncWriter worker, once per
 dequeued item), `ckpt` (checkpoint.save, after the durable rename),
 `init` (the engine's pre-snapshot init dispatch — the supervised-init
 retry's window), `obs_listen` (the pull front's server thread at
-startup) and `scrape` (once per handled HTTP request, on the handler
+startup), `scrape` (once per handled HTTP request, on the handler
 thread — a hang/die there must never stall dispatch, serve, or writer
-drain; tests/test_obs.py pins it).
+drain; tests/test_obs.py pins it), `mem_poll` (once per device-memory
+sample on the cost observatory's poller thread) and `profile` (on the
+profiler-capture worker around each start/stop — same isolation
+contract as the listener sites; tests/test_cost.py pins it).
 
 The plan is installed per engine.run call (`install`), which resets the
 per-site counters — invocation indices are deterministic within one
@@ -75,8 +78,14 @@ ACTIONS = ("unavailable", "hang", "die", "truncate", "error")
 # `scrape` once per handled HTTP request (obs/http.py) — both execute
 # OFF the dispatch/serve/writer paths by design, and the tests pin
 # that a hung or dead listener never stalls any of them.
+# `mem_poll` fires once per device-memory sample on the MemPoller's
+# own daemon thread and `profile` on the ProfileCapture worker around
+# each profiler start/stop (obs/cost.py) — the cost observatory's two
+# threads, with the same isolation contract: a hang parks only that
+# thread, a die ends it, and dispatch/serve/writer drain never wait on
+# either (tests/test_cost.py pins it).
 SITES = ("dispatch", "fetch", "writer", "ckpt", "init", "obs_listen",
-         "scrape")
+         "scrape", "mem_poll", "profile")
 
 
 class FaultInjected(Exception):

@@ -376,6 +376,27 @@ def metrics_entry(stream: IO, snapshot: dict, ts=None) -> None:
     _write(stream, {"metricsEntry": rec})
 
 
+def cost_entry(stream: IO, program: str, **extra) -> None:
+    """Observability EXTENSION record (tt-obs cost observatory,
+    obs/cost.py; emitted only when a run's observatory has a bound
+    emitter — i.e. under --obs): one per-program compile event —
+
+      {"costEntry":{"program":"lane_runner","sig":"9f31c2ab44",
+                    "lowerSeconds":0.12,"compileSeconds":2.31,
+                    "flops":1.1e9,"bytes_accessed":3.4e7,
+                    "intensity":32.4,"temp_bytes":1048576,"ts":5.2}}
+
+    `sig` is the short input-signature tag (for serve programs: the
+    shape bucket); `ts` is tracer-epoch seconds when available. Pure
+    cost/timing telemetry: strip_timing drops the whole record, so the
+    stream identity contract (observatory on vs off) holds by
+    construction."""
+    rec = {"program": str(program)}
+    for k, v in extra.items():
+        rec[k] = v
+    _write(stream, {"costEntry": rec})
+
+
 def phase_record(stream: IO, name: str, trial: int, seconds: float,
                  **extra) -> None:
     """Observability EXTENSION record (not in the reference protocol;
@@ -401,10 +422,11 @@ TIMING_FIELDS = {"logEntry": ("time",), "solution": ("totalTime",),
 
 # record types that are timing through and through — the determinism
 # A/Bs drop them entirely rather than field-stripping them. phase and
-# the obs records (spanEntry/metricsEntry) are wall-clock measurements;
-# faultEntry is excluded by the fault-recovery contract (a recovered
-# run matches an uninjected one MODULO fault records).
-TIMING_RECORDS = ("phase", "faultEntry", "spanEntry", "metricsEntry")
+# the obs records (spanEntry/metricsEntry/costEntry) are wall-clock
+# measurements; faultEntry is excluded by the fault-recovery contract
+# (a recovered run matches an uninjected one MODULO fault records).
+TIMING_RECORDS = ("phase", "faultEntry", "spanEntry", "metricsEntry",
+                  "costEntry")
 
 
 def strip_timing(records: List[dict]) -> List[dict]:

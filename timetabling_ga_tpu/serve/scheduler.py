@@ -94,7 +94,7 @@ class Scheduler:
     """Drives a JobQueue through the engine's lane programs."""
 
     def __init__(self, cfg: ServeConfig, queue: JobQueue, out,
-                 now=None, tracer=NULL_TRACER):
+                 now=None, tracer=NULL_TRACER, profiler=None):
         import jax
         self.cfg = cfg
         self.queue = queue
@@ -104,6 +104,9 @@ class Scheduler:
         self._dispatches = 0
         self._overflow_warned = False
         self._metrics = obs_metrics.REGISTRY
+        # on-demand profiler capture (obs/cost.py ProfileCapture, wired
+        # by the service): the step loop only ticks its counter
+        self._profiler = profiler
         # queue occupancy is only meaningful at read time: a pull gauge
         # sampled when the registry is snapshotted
         self._metrics.gauge_fn("serve.queue_depth",
@@ -291,8 +294,20 @@ class Scheduler:
             runner, _ = engine.cached_lane_runner(
                 self.mesh, self.gacfg, self.cfg.quantum, lanes,
                 donate=True, trace_mode=self.cfg.trace_mode)
+            tq0 = self._now()
             state, trace = runner(pa_stack, seeds, chunks, state, gens)
             trace = np.asarray(trace)   # (lanes, quantum, 2) | packed
+            # live roofline for the serve path, same gauges and same
+            # formula as the engine's (obs/cost.py owns it): the lane
+            # program's compile-time counts over this quantum's wall.
+            # Skipped when THIS call paid the bucket's compile — that
+            # wall time is compile+execute and would crater the gauges
+            # (compile.seconds carries it under its own name)
+            if not getattr(runner, "last_compiled", False):
+                from timetabling_ga_tpu.obs import cost as obs_cost
+                obs_cost.set_live_roofline(
+                    getattr(runner, "last_cost", None),
+                    self._now() - tq0)
         with self.tracer.span("park", cat="serve", job=jids,
                               flow=flows):
             host = engine.fetch_state(state)
@@ -339,6 +354,8 @@ class Scheduler:
         self._dispatches += 1
         self._metrics.counter("serve.dispatches").inc()
         self._metrics.counter("serve.gens").inc(int(gens.sum()))
+        if self._profiler is not None:
+            self._profiler.on_dispatch()
         if (self.cfg.obs and self.cfg.metrics_every > 0
                 and self._dispatches % self.cfg.metrics_every == 0):
             jsonl.metrics_entry(self.out, self._metrics.snapshot(),

@@ -90,9 +90,30 @@ class SolveService:
                                       self.writer.qsize)
         obs_metrics.REGISTRY.gauge_fn(
             "writer.records", lambda: self.writer.records_written)
+        # cost observatory (obs/cost.py), mirroring engine.run's
+        # wiring: costEntry emission binds to this service's writer
+        # under --obs; the memory poller and the on-demand profiler
+        # capture run on their own daemon threads, OFF the serve path
+        from timetabling_ga_tpu.obs import cost as obs_cost
+        obs_cost.OBSERVATORY.bind(self.writer if cfg.obs else None,
+                                  now=self.tracer.now)
+        self.mem_poller = None
+        if (cfg.obs or cfg.obs_listen) and cfg.mem_poll_every > 0:
+            self.mem_poller = obs_cost.MemPoller(
+                obs_cost.jax_memory_stats_fn(),
+                cfg.mem_poll_every).start()
+        self.profile_capture = None
+        if cfg.profile_for > 0 or cfg.obs_listen:
+            self.profile_capture = obs_cost.ProfileCapture(
+                lambda d: jax.profiler.start_trace(d),
+                jax.profiler.stop_trace,
+                default_dir=cfg.profile_dir)
+            if cfg.profile_for > 0:
+                self.profile_capture.trigger(cfg.profile_for)
         self.queue = JobQueue(cfg.backlog, now=now)
         self.scheduler = Scheduler(cfg, self.queue, self.writer,
-                                   now=now, tracer=self.tracer)
+                                   now=now, tracer=self.tracer,
+                                   profiler=self.profile_capture)
         self._auto_id = 0
         self.obs_server = None
         if cfg.obs_listen:
@@ -101,11 +122,33 @@ class SolveService:
             # straight off this process — no sidecar tailing the record
             # stream. The listener writes NO records; the JSONL stream
             # is identical with it on or off (tests + bench pin it).
-            from timetabling_ga_tpu.obs import http as obs_http
-            self.obs_server = obs_http.ObsServer(
-                cfg.obs_listen,
-                probes={"process": lambda: True,
-                        "writer": self.writer.alive}).start()
+            try:
+                from timetabling_ga_tpu.obs import http as obs_http
+                self.obs_server = obs_http.ObsServer(
+                    cfg.obs_listen,
+                    probes={"process": lambda: True,
+                            "writer": self.writer.alive},
+                    profile=self.profile_capture).start()
+            except BaseException:
+                # a failed construction (e.g. the port is taken) never
+                # reaches close(): the observatory threads started
+                # above — and the global emitter bound to THIS writer —
+                # must not outlive the service that never existed. The
+                # pull gauges bound above (and by the Scheduler) get
+                # the same freeze treatment close() gives them: the
+                # process-global registry must not keep closures over
+                # the dead writer and queue alive.
+                if self.profile_capture is not None:
+                    self.profile_capture.close()
+                if self.mem_poller is not None:
+                    self.mem_poller.close()
+                obs_cost.OBSERVATORY.unbind()
+                self.writer.close(raise_error=False)
+                obs_metrics.REGISTRY.freeze(
+                    "writer.records", self.writer.records_written)
+                obs_metrics.REGISTRY.freeze("writer.queue_depth", 0.0)
+                obs_metrics.REGISTRY.freeze("serve.queue_depth", 0.0)
+                raise
 
     # -- API -------------------------------------------------------------
 
@@ -171,13 +214,20 @@ class SolveService:
     def close(self) -> None:
         if self.obs_server is not None:
             self.obs_server.close()
+        if self.profile_capture is not None:
+            self.profile_capture.close()
+        if self.mem_poller is not None:
+            self.mem_poller.close()
         try:
             self.writer.close()
         finally:
             # same unbind as engine.run's finally — and like there it
             # must run even when close() re-raises a latched writer
             # error: drop the process-global registry's closures over
-            # this service's writer and queue
+            # this service's writer and queue (and the observatory's
+            # costEntry emitter, which holds the same writer)
+            from timetabling_ga_tpu.obs import cost as obs_cost
+            obs_cost.OBSERVATORY.unbind()
             obs_metrics.REGISTRY.freeze(
                 "writer.records", self.writer.records_written)
             obs_metrics.REGISTRY.freeze("writer.queue_depth", 0.0)
