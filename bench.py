@@ -1070,6 +1070,104 @@ def measure_obs(problem, pop: int = 256, gens: int = 600) -> dict:
     return out
 
 
+def measure_quality(problem, pop: int = 256, gens: int = 600) -> dict:
+    """extra.quality leg (ISSUE 9): the search-quality observatory's
+    overhead and its telemetry, same-session A/B.
+
+    Two legs of the SAME run (same seed, same shapes): quality off vs
+    quality on with --obs (operator counters in every generation, the
+    migration-gain reduction on every exchange, end-of-dispatch
+    diversity moments + Hamming sample, qualityEntry records).
+    `records_identical_modulo_timing` asserts the observatory never
+    changes what the run does; the reported hit rates / diversity are
+    the numbers ROADMAP item 5's strategy races explain wins with."""
+    import dataclasses
+    import io
+    import json as _json
+    import tempfile
+
+    from timetabling_ga_tpu.problem import dump_tim
+    from timetabling_ga_tpu.runtime import engine, jsonl
+    from timetabling_ga_tpu.runtime.config import RunConfig
+
+    with tempfile.NamedTemporaryFile("w", suffix=".tim",
+                                     delete=False) as f:
+        f.write(dump_tim(problem))
+        tim = f.name
+    # pin the dispatch schedule: DISPATCH_CAP_S sizes dynamic chunks
+    # from MEASURED sec/gen, and the off leg's measurements feed the on
+    # leg's sizing (shared _SPG_CACHE) — on a loaded host the two legs
+    # can then take different chunkings, hence different fold_in
+    # schedules, and the records-identical assertion fails for timing
+    # reasons, not observatory ones (observed on the CPU validation
+    # box). An effectively-infinite cap makes both legs run the same
+    # generation-budget-sized static dispatches + one dynamic tail.
+    cap, engine.DISPATCH_CAP_S = engine.DISPATCH_CAP_S, 1e9
+    try:
+        base = RunConfig(input=tim, seed=1234, pop_size=pop, islands=1,
+                         generations=gens, migration_period=50,
+                         epochs_per_dispatch=4, ls_mode="sweep",
+                         ls_sweeps=1, init_sweeps=0,
+                         time_limit=100000.0, auto_tune=False,
+                         trace=True, metrics_every=1)
+        engine.precompile(base)
+        engine.precompile(dataclasses.replace(base, quality=True))
+
+        def leg(quality):
+            # obs=True on BOTH legs: the A/B must isolate the QUALITY
+            # block's cost, not re-measure the span/metrics machinery
+            # measure_obs already prices (strip_timing drops the obs
+            # records, so the identity assertion is unaffected)
+            cfg = dataclasses.replace(base, quality=quality, obs=True)
+            buf = io.StringIO()
+            best = engine.run(cfg, out=buf)
+            lines = [_json.loads(x) for x in buf.getvalue().splitlines()]
+            loop = [x["phase"] for x in lines if "phase" in x
+                    and x["phase"]["name"] == "gen-loop"][0]
+            return {"best": best, "loop_s": loop["seconds"],
+                    "dispatches": loop["dispatches"],
+                    "quality": [x["qualityEntry"] for x in lines
+                                if "qualityEntry" in x],
+                    "recs": jsonl.strip_timing(lines)}
+
+        off = leg(False)
+        on = leg(True)
+    finally:
+        engine.DISPATCH_CAP_S = cap
+        os.unlink(tim)
+    from timetabling_ga_tpu.obs.quality import entry_win_rate
+    qe = on["quality"][-1] if on["quality"] else {}
+
+    def rate(w, a):
+        # shared summer (obs/quality.py owns the key names): per-
+        # dispatch deltas summed across the run; None = never attempted
+        return entry_win_rate(on["quality"], w, a)
+
+    out = {
+        "pop": pop, "gens": gens, "dispatches": off["dispatches"],
+        "loop_s_quality_off": round(off["loop_s"], 3),
+        "loop_s_quality_on": round(on["loop_s"], 3),
+        "quality_overhead_ms_per_dispatch": round(
+            (on["loop_s"] - off["loop_s"]) / max(1, on["dispatches"])
+            * 1e3, 3),
+        "quality_entries": len(on["quality"]),
+        "final_hamming": qe.get("quality.diversity.hamming"),
+        "crossover_win_rate": rate("quality.ops.crossover_wins",
+                                   "quality.ops.crossover_attempts"),
+        "mutation_win_rate": rate("quality.ops.mutation_wins",
+                                  "quality.ops.mutation_attempts"),
+        "records_identical_modulo_timing": off["recs"] == on["recs"],
+    }
+    print(f"# quality A/B (pop {pop}, {off['dispatches']} dispatches): "
+          f"loop {off['loop_s']:.3f}s off vs {on['loop_s']:.3f}s on "
+          f"({out['quality_overhead_ms_per_dispatch']} ms/dispatch, "
+          f"{out['quality_entries']} entries); final hamming "
+          f"{out['final_hamming']}, xo win {out['crossover_win_rate']}, "
+          f"mut win {out['mutation_win_rate']}; records identical="
+          f"{out['records_identical_modulo_timing']}", file=sys.stderr)
+    return out
+
+
 def main() -> None:
     problem = _instance()
     # retry the headline through device sick windows (shared policy,
@@ -1100,6 +1198,7 @@ def main() -> None:
              lambda: measure_kernel_cost(problem, tpu)),
             ("pipeline", lambda: measure_pipeline(problem)),
             ("obs", lambda: measure_obs(problem)),
+            ("quality", lambda: measure_quality(problem)),
             ("serve", measure_serve),
             ("soak", measure_soak),
             ("scrape", measure_scrape),

@@ -1,0 +1,52 @@
+"""TT604 fixture: quality accounting off device.
+
+Not imported or executed — parsed by tests/test_analysis.py. The
+search-quality observatory ships diversity/operator/migration numbers
+as packed int32 rows on the telemetry leaf the dispatch loop already
+fetches; recomputing them on host per dispatch re-introduces the
+O(pop x E) host bill (and hidden sync) the on-device reduction removed,
+and a quality-reduction helper that adds a collective (or a
+collective-bearing random op — TT302's shuffle-sort hazard) turns
+telemetry into a deadlock surface.
+"""
+import jax
+from jax import lax
+from jax.lax import psum
+
+
+def drive_loop(runner, pa, state, batch_penalty):
+    for _step in range(8):
+        state, trace = runner(pa, state)
+        pen = batch_penalty(pa, state.slots, state.rooms)  # EXPECT TT604
+    return state, pen
+
+
+def poll_until_drained(queue, pa, state, event_heat):
+    while queue:
+        queue.pop()
+        heat = event_heat(pa, state.slots, state.rooms)    # EXPECT TT604
+    return heat
+
+
+def _quality_gain_rows(best, perm):
+    # a quality reduction must ride the EXISTING migration exchange,
+    # never add its own collective
+    return lax.ppermute(best, "island", perm)          # EXPECT TT604
+
+
+def quality_mean_rows(rep):
+    # bare imported form of the same hazard — flagged identically
+    return psum(rep, "island")                         # EXPECT TT604
+
+
+def hamming_sample_rows(key, slots):
+    # the coprime-stride sample exists precisely to avoid this shuffle
+    # (TT302 flags the same call: it is the same hazard class)
+    order = jax.random.permutation(key, 8)  # EXPECT TT604 # EXPECT TT302
+    return slots[order]
+
+
+def fine_outside_loops(pa, state, batch_penalty):
+    # OK: a one-off evaluation outside any dispatch loop (tests,
+    # endTry verification) is not per-generation recompute
+    return batch_penalty(pa, state.slots, state.rooms)

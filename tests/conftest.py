@@ -59,6 +59,36 @@ def random_assignment(rng, problem, n):
     return slots, rooms
 
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TIM_FIXTURE = os.path.join(_REPO, "fixtures", "comp01s.tim")
+
+
+@pytest.fixture(scope="session")
+def engine_stream_baseline():
+    """SESSION-shared reference engine stream: comp01s, seed 3, pop 8,
+    islands 2, 30 generations at migration period 10, full trace, obs
+    off, pipelined — the exact baseline the obs/cost/quality stream-
+    identity A/Bs diff against. Before this fixture each module (and
+    several individual tests) re-ran the identical deterministic
+    baseline, recompiling its programs from scratch every time
+    (the between-module jax.clear_caches wipes executables); at 2-core-
+    box speeds those duplicate runs were a measurable slice of the
+    tier-1 budget overrun (ISSUE 9 satellite). The run is a pure
+    function of (fixture, seed, config), so sharing the recorded
+    stream across modules changes no assertion."""
+    import io
+    import json
+    from timetabling_ga_tpu.runtime import engine as eng
+    from timetabling_ga_tpu.runtime.config import RunConfig
+    buf = io.StringIO()
+    cfg = RunConfig(input=TIM_FIXTURE, seed=3, pop_size=8, islands=2,
+                    generations=30, migration_period=10, max_steps=8,
+                    time_limit=300, backend="cpu", auto_tune=False,
+                    trace=True)
+    best = eng.run(cfg, out=buf)
+    return best, [json.loads(x) for x in buf.getvalue().splitlines()]
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Free compiled XLA executables after each test module.

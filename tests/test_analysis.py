@@ -44,9 +44,10 @@ def fixture_config() -> AnalyzerConfig:
     # the sync/collective rules only audit configured modules; opt the
     # fixtures in
     cfg.dispatch_modules = list(cfg.dispatch_modules) + ["viol_sync.py",
-                                                         "viol_cost.py"]
+                                                         "viol_cost.py",
+                                                         "viol_quality.py"]
     cfg.sharded_modules = (list(cfg.sharded_modules)
-                           + ["viol_collective.py"])
+                           + ["viol_collective.py", "viol_quality.py"])
     return cfg
 
 
@@ -71,6 +72,9 @@ def analyze_fixture(fixture: str):
     #                        HTTP handler paths
     "viol_cost.py",        # TT603 cost/memory introspection in trace
     #                        targets and dispatch loops
+    "viol_quality.py",     # TT604 host-side quality recompute in
+    #                        dispatch loops + collectives in quality
+    #                        reduction paths
 ])
 def test_rule_fires_at_expected_lines(fixture):
     """Each rule family fires exactly at the marked (rule, line) pairs —

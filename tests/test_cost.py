@@ -370,7 +370,8 @@ def test_engine_stream_identity_and_compile_accounting(monkeypatch):
 
 
 @pytest.mark.skipif(not obs_cost.ENABLED, reason="TT_COST_OBS=0")
-def test_engine_ladder_restore_path(monkeypatch):
+def test_engine_ladder_restore_path(monkeypatch,
+                                    engine_stream_baseline):
     """The recovery ladder's step-back-UP surfaces live: with a
     deterministic one-failure escalate/relax policy (the real timing
     logic is unit-tested above), a degraded run emits the faultEntry
@@ -393,7 +394,7 @@ def test_engine_ladder_restore_path(monkeypatch):
                 return True
             return False
 
-    b0, l0 = _engine_run()
+    b0, l0 = engine_stream_baseline    # session-shared baseline run
     monkeypatch.setattr(eng, "_Supervisor", FastRelax)
     b, l = _engine_run(faults_spec="dispatch:2:unavailable")
     assert b == b0
@@ -409,7 +410,8 @@ def test_engine_ladder_restore_path(monkeypatch):
 
 
 @pytest.mark.skipif(not obs_cost.ENABLED, reason="TT_COST_OBS=0")
-def test_engine_profile_for_wiring(tmp_path, monkeypatch):
+def test_engine_profile_for_wiring(tmp_path, monkeypatch,
+                                   engine_stream_baseline):
     """--profile-for N: the engine builds the capture, triggers it at
     launch, ticks it per retired dispatch, and the capture brackets
     exactly N dispatches — with the profiler entry points stubbed (the
@@ -425,7 +427,7 @@ def test_engine_profile_for_wiring(tmp_path, monkeypatch):
     monkeypatch.setattr(jax.profiler, "stop_trace",
                         lambda: calls.append(("stop",)))
     before = obs_metrics.REGISTRY.counter("profile.captures").value
-    b0, l0 = _engine_run()
+    b0, l0 = engine_stream_baseline    # session-shared baseline run
     b, l = _engine_run(profile_for=2, profile_dir=prof_dir)
     assert b == b0
     assert jsonl.strip_timing(l) == jsonl.strip_timing(l0)

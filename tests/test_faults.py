@@ -363,6 +363,11 @@ def test_init_site_kill_retries_with_identical_jsonl(tim_file):
     assert jsonl.strip_timing(lines) == jsonl.strip_timing(clean)
 
 
+@pytest.mark.slow
+# re-tiered (ISSUE 9 tier-1 budget): the init-site retry determinism
+# contract stays tier-1-pinned by test_init_site_kill_retries_with_
+# identical_jsonl; this variant only moves the injection point into the
+# polish window
 def test_init_retry_covers_init_polish_window(tim_file):
     """The retry wraps the whole pre-snapshot window: a dispatch kill
     INSIDE the init polish (dispatch site invocation 1, with

@@ -218,6 +218,10 @@ def test_kick_runner_tiny_population_noop(mesh):
     assert np.array_equal(np.asarray(out.slots), np.asarray(state.slots))
 
 
+@pytest.mark.slow
+# re-tiered (ISSUE 9 tier-1 budget): local-island layout + migration
+# ring stay tier-1-covered by test_local_islands_runner_trace_order and
+# test_migration_topology
 def test_local_islands_init_and_migration(mesh):
     """Local islands (n_islands > device count — the multiple-MPI-ranks-
     per-node analogue): 16 islands on the 8-device mesh (L=2). Init gives

@@ -1,0 +1,485 @@
+"""Search-quality observatory tests (ISSUE 9; README "Search-quality
+observatory").
+
+The contract under test, layer by layer:
+
+  - ON-DEVICE REDUCTIONS are pure telemetry: sweep/generation runners
+    walk bit-identical trajectories with the quality flags on or off,
+    and every population-derived reduction (diversity moments, the
+    coprime-stride Hamming sample, migration gain) decodes to EXACTLY
+    what a host recompute over the fetched population yields —
+    bit-equal float32, not approximately.
+  - STREAM IDENTITY: engine and serve JSONL record streams are
+    bit-identical with the quality observatory on vs off (modulo
+    qualityEntry/timing records), full and deltas trace modes alike —
+    the tentpole acceptance criterion.
+  - STALLS: the deterministic stall fixture fires the detector
+    (faultEntry site=quality action=stall, engine.stalled gauge, the
+    /readyz `stalled` reason) and, with --auto-kick-on-stall, the kick
+    (faultEntry action=kick + engine.kicks).
+  - CLI: `tt quality` summarizes a qualityEntry stream; `tt trace`
+    renders the entries as counter tracks; `tt stats` appends the
+    quality section.
+"""
+
+import functools
+import io
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from timetabling_ga_tpu.obs import metrics as obs_metrics
+from timetabling_ga_tpu.obs import quality as obs_quality
+from timetabling_ga_tpu.runtime import jsonl
+from timetabling_ga_tpu.runtime.config import RunConfig, ServeConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TIM = os.path.join(REPO, "fixtures", "comp01s.tim")
+
+
+# ------------------------------------------------------------ unit layer
+
+
+def test_decode_rows_and_aggregate_layout():
+    rows = np.zeros((2, obs_quality.QUALITY_WIDTH), np.int32)
+    rows[0, :obs_quality.N_OPS] = [10, 3, 8, 2, 5, 4, 1]
+    rows[1, obs_quality.OFF_MIG] = 7
+    div = np.arange(obs_quality.N_DIV, dtype=np.float32) + 0.5
+    rows[0, obs_quality.OFF_DIV:] = div.view(np.int32)
+    rows[1, obs_quality.OFF_DIV:] = (div * 2).view(np.int32)
+    d = obs_quality.decode_rows(rows)
+    assert d["crossover_attempts"].tolist() == [10, 0]
+    assert d["move3_accepts"].tolist() == [1, 0]
+    assert d["migration_gain"].tolist() == [0, 7]
+    assert d["penalty_mean"][0] == np.float32(0.5)
+    agg = obs_quality.aggregate(d)
+    assert agg["counters"]["quality.ops.crossover_attempts"] == 10
+    assert agg["counters"]["quality.migration.gain"] == 7
+    assert agg["gauges"]["quality.diversity.hamming_min"] == min(
+        d["hamming"])
+    # lane payload is flat and json-serializable
+    payload = obs_quality.lane_payload(d, 0)
+    json.dumps(payload)
+    assert payload["crossover_wins"] == 3
+
+
+def test_decode_rows_rejects_bad_shape():
+    with pytest.raises(ValueError):
+        obs_quality.decode_rows(np.zeros((2, 3), np.int32))
+
+
+def test_stall_detector_window_and_collapse_threshold():
+    det = obs_quality.StallDetector(window=2, hamming_floor=0.1)
+    assert det.update(100, 0.05) is False      # first best: improvement
+    assert det.update(100, 0.05) is False      # streak 1 < window
+    assert det.update(100, 0.05) is True       # streak 2, collapsed
+    assert det.update(100, 0.5) is False       # diverse plateau: no stall
+    assert det.update(50, 0.05) is False       # new best resets streak
+    assert det.update(50, 0.05) is False
+    assert det.update(50, 0.05) is True
+    det.reset()
+    assert det.streak == 0 and det.stalled is False
+    # window 0 disables entirely
+    off = obs_quality.StallDetector(window=0, hamming_floor=1.0)
+    assert all(not off.update(1, 0.0) for _ in range(5))
+
+
+def test_readyz_stalled_reason():
+    from timetabling_ga_tpu.obs import http as obs_http
+    from timetabling_ga_tpu.obs.metrics import MetricsRegistry
+    reg = MetricsRegistry()
+    ok, detail = obs_http.readiness(reg)
+    assert ok
+    reg.gauge("engine.stalled").set(1.0)
+    ok, detail = obs_http.readiness(reg)
+    assert not ok and "stalled" in detail["reasons"]
+    reg.gauge("engine.stalled").set(0.0)
+    ok, _ = obs_http.readiness(reg)
+    assert ok
+
+
+def test_hamming_stride_is_coprime():
+    from timetabling_ga_tpu.parallel import islands
+    import math
+    assert islands._hamming_stride(1) == 0
+    for pop in (2, 3, 4, 8, 10, 16, 30, 32):
+        s = islands._hamming_stride(pop)
+        assert 1 <= s <= pop // 2 or pop == 2
+        assert math.gcd(s, pop) == 1
+
+
+# ------------------------------------------- on-device reduction purity
+
+
+def test_sweep_return_ops_is_trajectory_pure(small_problem):
+    import jax
+    from timetabling_ga_tpu.ops.sweep import jit_sweep_local_search
+    pa = small_problem.device_arrays()
+    rng = np.random.default_rng(3)
+    slots = rng.integers(0, small_problem.n_slots,
+                         size=(6, small_problem.n_events)).astype(np.int32)
+    from timetabling_ga_tpu.ops.rooms import batch_assign_rooms
+    rooms = batch_assign_rooms(pa, slots)
+    key = jax.random.key(9)
+    s0, r0 = jit_sweep_local_search(pa, key, slots, rooms, 2,
+                                    swap_block=4, converge=True, p3=0.2)
+    s1, r1, ops = jit_sweep_local_search(pa, key, slots, rooms, 2,
+                                         swap_block=4, converge=True,
+                                         p3=0.2, return_ops=True)
+    assert np.array_equal(np.asarray(s0), np.asarray(s1))
+    assert np.array_equal(np.asarray(r0), np.asarray(r1))
+    ops = np.asarray(ops)
+    assert ops.shape == (3,) and (ops >= 0).all()
+    assert ops.sum() > 0                       # random starts: something
+    #                                            must have been accepted
+    # p3=0 never produces a Move3 accept
+    _, _, ops0 = jit_sweep_local_search(pa, key, slots, rooms, 1,
+                                        swap_block=4, return_ops=True)
+    assert int(np.asarray(ops0)[2]) == 0
+
+
+def test_generation_with_quality_is_trajectory_pure(small_problem):
+    import jax
+    from timetabling_ga_tpu.ops import ga
+    pa = small_problem.device_arrays()
+    cfg = ga.GAConfig(pop_size=8)
+    state = ga.init_population(pa, jax.random.key(1), 8, cfg)
+    key = jax.random.key(2)
+    plain = jax.jit(lambda s: ga.generation(pa, key, s, cfg))(state)
+    with_q, q = jax.jit(
+        lambda s: ga.generation(pa, key, s, cfg,
+                                with_quality=True))(state)
+    for a, b in zip(plain, with_q):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    q = np.asarray(q)
+    assert q.shape == (obs_quality.N_OPS,)
+    xo_a, xo_w, mu_a, mu_w = q[:4]
+    assert 0 <= xo_a <= cfg.pop_size and 0 <= mu_a <= cfg.pop_size
+    assert 0 <= xo_w <= xo_a and 0 <= mu_w <= mu_a
+    assert (q[4:] == 0).all()                  # no sweep LS configured
+
+
+def _host_div(mask, slots, pen, scv, pop):
+    """Mirror of islands._div_stats in numpy float32 — the
+    host-recompute reference the packed rows must bit-match."""
+    from timetabling_ga_tpu.parallel import islands
+
+    def mom(x):
+        x = x.astype(np.float32)
+        mn = np.float32(x.min())
+        c = x - mn
+        n = np.float32(len(c))
+        mean_c = np.float32(c.sum() / n)
+        var = np.float32(max(
+            np.float32((c * c).sum() / n) - mean_c * mean_c,
+            np.float32(0.0)))
+        return [np.float32(mn + mean_c), var, mn, np.float32(x.max())]
+
+    k = min(pop, obs_quality.HAMMING_PAIRS)
+    s = islands._hamming_stride(pop)
+    a, b = slots[:k], np.roll(slots, -s, axis=0)[:k]
+    live = np.float32(max(mask.sum(), 1.0))
+    diff = (a != b).astype(np.float32) * mask[None, :]
+    ham = np.float32(diff.sum() / np.float32(k * live))
+    return mom(pen) + mom(scv) + [ham]
+
+
+def test_quality_rows_match_host_recomputation():
+    """THE equivalence pin: run a quality dispatch, fetch the final
+    population, recompute every population-derived reduction on host,
+    and assert the decoded packed rows are bit-equal float32."""
+    import jax
+    from timetabling_ga_tpu.ops import ga
+    from timetabling_ga_tpu.parallel import islands
+    from timetabling_ga_tpu.problem import load_tim_file
+    pa = load_tim_file(TIM).device_arrays()
+    mesh = islands.make_mesh(2)
+    pop = 8
+    cfg = ga.GAConfig(pop_size=pop)
+    state = islands.init_island_population(pa, jax.random.key(7), mesh,
+                                           pop)
+    run = islands.make_island_runner(mesh, cfg, n_epochs=2,
+                                     gens_per_epoch=5, n_islands=2,
+                                     trace_mode="deltas", quality=True)
+    st, trace, _ = run(pa, jax.random.key(5), state)
+    trace = np.asarray(trace)
+    assert trace.shape == (2, islands.trace_leaf_width(10, "deltas",
+                                                       quality=True))
+    ev_leaf, qrows = islands.split_quality(trace, True)
+    # the event half still decodes as a plain deltas leaf
+    events, counts, _ = islands.trace_events(ev_leaf, "deltas")
+    assert len(events) == 2 and counts is not None
+    dec = obs_quality.decode_rows(qrows)
+    host = jax.device_get(st)
+    mask = np.asarray(pa.event_mask, np.float32)
+    for i in range(2):
+        rows = slice(i * pop, (i + 1) * pop)
+        want = _host_div(mask, np.asarray(host.slots[rows]),
+                         np.asarray(host.penalty[rows]),
+                         np.asarray(host.scv[rows]), pop)
+        got = [dec[n][i] for n in
+               ("penalty_mean", "penalty_var", "penalty_min",
+                "penalty_max", "scv_mean", "scv_var", "scv_min",
+                "scv_max", "hamming")]
+        assert got == want, (i, got, want)
+        # operator counters: bounded by what the dispatch bred
+        total_children = 10 * pop              # gens x pop per island
+        assert 0 <= dec["crossover_attempts"][i] <= total_children
+        assert dec["crossover_wins"][i] <= dec["crossover_attempts"][i]
+        assert dec["mutation_wins"][i] <= dec["mutation_attempts"][i]
+        assert dec["migration_gain"][i] >= 0
+
+
+def test_quality_full_upgrade_is_uncapped(monkeypatch):
+    """A --quality run in `full` trace mode must NEVER drop improvement
+    events: the upgraded deltas packing is uncapped (K = the dispatch's
+    generation count), so the quality-on stream matches the quality-off
+    full stream even when a dispatch improves more than
+    TRACE_DELTAS_CAP times. User-chosen deltas keeps its cap."""
+    import jax.numpy as jnp
+    from timetabling_ga_tpu.parallel import islands
+    monkeypatch.setattr(islands, "TRACE_DELTAS_CAP", 3)
+    # strictly decreasing -> 8 improvements, cap 3
+    tr = np.stack([np.arange(9, 1, -1), np.zeros(8)],
+                  axis=1)[None].astype(np.int32)
+    capped = np.asarray(islands._compress_trace(jnp.asarray(tr), None,
+                                                "deltas"))
+    ev, counts, _ = islands.trace_events(capped, "deltas")
+    assert len(ev[0]) == 3 and counts[0] == 8      # capped: drops
+    uncapped = np.asarray(islands._compress_trace(jnp.asarray(tr), None,
+                                                  "deltas", cap=8))
+    ev, counts, _ = islands.trace_events(uncapped, "deltas")
+    assert len(ev[0]) == 8 == counts[0]            # uncapped: everything
+    # width accounting follows: full+quality is uncapped, deltas capped
+    q = obs_quality.QUALITY_WIDTH
+    assert islands.trace_leaf_width(8, "full", quality=True) \
+        == 3 * 8 + 1 + q
+    assert islands.trace_leaf_width(8, "deltas", quality=True) \
+        == 3 * 3 + 1 + q
+
+
+def test_migration_gain_matches_host_recomputation(tiny_problem):
+    """Crafted two-island exchange with a hand-computable outcome:
+    island 0 (bests 100,110,...) receives island 1's best 5 forward and
+    its second 6 backward -> new best 5, gain 95; island 1 (bests
+    5,6,7,8) receives 100/110 into its worst rows -> best unchanged,
+    gain 0."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from timetabling_ga_tpu.compat import shard_map
+    from timetabling_ga_tpu.ops import ga
+    from timetabling_ga_tpu.parallel import islands
+    from timetabling_ga_tpu.runtime import engine
+    E = tiny_problem.n_events
+    mesh = islands.make_mesh(2)
+    scv = np.array([100, 110, 120, 130, 5, 6, 7, 8], np.int32)
+    state = ga.PopState(
+        slots=np.tile(np.arange(E, dtype=np.int32), (8, 1)),
+        rooms=np.zeros((8, E), np.int32),
+        penalty=scv.copy(), hcv=np.zeros((8,), np.int32),
+        scv=scv.copy())
+    dev_state = engine.reshard_state(state, mesh)
+    specs = ga.PopState(slots=P(islands.AXIS), rooms=P(islands.AXIS),
+                        penalty=P(islands.AXIS), hcv=P(islands.AXIS),
+                        scv=P(islands.AXIS))
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(specs,),
+                       out_specs=(specs, P(islands.AXIS)),
+                       check_vma=False)
+    def mig(st):
+        return islands._migrate(st, 2, 1, return_gain=True)
+
+    out, gain = jax.jit(mig)(dev_state)
+    assert np.asarray(gain).tolist() == [95, 0]
+    out = jax.device_get(out)
+    assert np.asarray(out.scv[:4]).tolist() == [5, 6, 100, 110]
+    assert np.asarray(out.scv[4:]).tolist() == [5, 6, 100, 110]
+
+
+# ------------------------------------------------------- engine A/B
+
+
+def _engine_run(trace_mode="full", obs=False, **kw):
+    from timetabling_ga_tpu.runtime import engine as eng
+    buf = io.StringIO()
+    base = dict(input=TIM, seed=3, pop_size=8, islands=2,
+                generations=30, migration_period=10, max_steps=8,
+                time_limit=300, backend="cpu", auto_tune=False,
+                trace=True, obs=obs, trace_mode=trace_mode,
+                metrics_every=1)
+    base.update(kw)
+    best = eng.run(RunConfig(**base), out=buf)
+    return best, [json.loads(x) for x in buf.getvalue().splitlines()]
+
+
+def test_engine_stream_identical_with_quality(engine_stream_baseline):
+    """Acceptance: engine record streams are bit-identical with the
+    quality observatory on vs off (modulo qualityEntry/timing records),
+    for both the full and deltas trace modes, with qualityEntry records
+    and live quality.* metric families riding along."""
+    b0, l0 = engine_stream_baseline
+    for mode in ("full", "deltas"):
+        b, l = _engine_run(trace_mode=mode, quality=True, obs=True)
+        assert b == b0, mode
+        assert jsonl.strip_timing(l) == jsonl.strip_timing(l0), mode
+        qes = [r["qualityEntry"] for r in l if "qualityEntry" in r]
+        assert len(qes) >= 3                   # one per retired dispatch
+        assert all("quality.diversity.hamming" in q for q in qes)
+        snaps = [r["metricsEntry"] for r in l if "metricsEntry" in r]
+        assert "quality.diversity.hamming" in snaps[-1]["gauges"]
+        assert ("quality.ops.crossover_attempts"
+                in snaps[-1]["counters"])
+    # /metrics exposition carries the families (live scrape view)
+    text = obs_metrics.REGISTRY.to_openmetrics()
+    assert "tt_quality_diversity_hamming" in text
+    assert "tt_quality_ops_crossover_attempts_total" in text
+
+
+def test_engine_quality_off_emits_no_quality_records(
+        engine_stream_baseline):
+    _, l0 = engine_stream_baseline
+    assert not any("qualityEntry" in r for r in l0)
+
+
+# -------------------------------------------------------- serve A/B
+
+
+def _serve_run(quality=False, obs=False):
+    from timetabling_ga_tpu.serve.service import serve_stream
+    cfg = ServeConfig(backend="cpu", lanes=2, quantum=10, pop_size=8,
+                      generations=20, obs=obs, quality=quality,
+                      metrics_every=1)
+    reqs = [{"submit": {"id": "a", "instance": TIM, "seed": 1}},
+            {"submit": {"id": "b", "instance": TIM, "seed": 2}}]
+    inp = io.StringIO("\n".join(json.dumps(r) for r in reqs) + "\n")
+    out = io.StringIO()
+    svc = serve_stream(cfg, inp, out)
+    return svc, [json.loads(x) for x in out.getvalue().splitlines()]
+
+
+def test_serve_stream_identical_with_quality():
+    _, l0 = _serve_run()
+    _, l1 = _serve_run(quality=True, obs=True)
+    assert jsonl.strip_timing(l1) == jsonl.strip_timing(l0)
+    qes = [r["qualityEntry"] for r in l1 if "qualityEntry" in r]
+    assert qes and {q["job"] for q in qes} == {"a", "b"}
+    # per-lane payloads are flat (lane_payload) and job-tagged
+    assert all("hamming" in q and "crossover_attempts" in q
+               for q in qes)
+    # quality off emits nothing
+    assert not any("qualityEntry" in r for r in l0)
+
+
+# ------------------------------------------------- stall fixture + kick
+
+
+@pytest.fixture(scope="module")
+def small_tim(tmp_path_factory):
+    from timetabling_ga_tpu.problem import dump_tim, random_instance
+    prob = random_instance(1, n_events=30, n_rooms=4, n_features=3,
+                           n_students=20, attend_prob=0.15)
+    path = tmp_path_factory.mktemp("quality") / "small.tim"
+    path.write_text(dump_tim(prob))
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def stall_run(small_tim):
+    """One auto-kick stall run shared by the acceptance test and the
+    CLI summarizer test (identical config, deterministic stream)."""
+    kicks_before = obs_metrics.REGISTRY.counter("engine.kicks").value
+    b, l = _engine_run(input=small_tim, seed=5, generations=80,
+                       quality=True, obs=True, stall_window=2,
+                       stall_hamming=1.0, auto_kick_on_stall=True)
+    return b, l, kicks_before
+
+
+def test_stall_fixture_fires_detector_and_auto_kick(stall_run):
+    """The deterministic stall fixture (acceptance): a small instance
+    whose population converges well inside the budget plateaus for
+    stall_window dispatches; the detector fires (faultEntry
+    site=quality action=stall + engine.stalled) and --auto-kick-on-
+    stall dispatches the kick (faultEntry action=kick + engine.kicks),
+    all visible on the stream and the registry."""
+    b, l, kicks_before = stall_run
+    fes = [r["faultEntry"] for r in l if "faultEntry" in r]
+    stalls = [f for f in fes if (f["site"], f["action"]) == ("quality",
+                                                             "stall")]
+    kicks = [f for f in fes if (f["site"], f["action"]) == ("quality",
+                                                            "kick")]
+    assert stalls, fes
+    assert stalls[0]["streak"] >= 2 and "hamming" in stalls[0]
+    assert kicks and kicks[0]["moves"] >= 3
+    assert (obs_metrics.REGISTRY.counter("engine.kicks").value
+            - kicks_before) >= 1
+    # the stall is visible in the qualityEntry stream too (the entries
+    # bracket the stall; the gauge itself resets when the kick fires)
+    assert any("qualityEntry" in r for r in l)
+
+
+def test_stall_detector_without_autokick_keeps_stream(small_tim):
+    """Detection alone is pure telemetry: same config minus the kick
+    flag emits the stall faultEntry but the protocol stream matches the
+    quality-off run exactly (strip_timing drops fault records)."""
+    b0, l0 = _engine_run(input=small_tim, seed=5, generations=80)
+    b1, l1 = _engine_run(input=small_tim, seed=5, generations=80,
+                         quality=True, obs=True, stall_window=2,
+                         stall_hamming=1.0)
+    assert b1 == b0
+    assert jsonl.strip_timing(l1) == jsonl.strip_timing(l0)
+    assert any(r.get("faultEntry", {}).get("action") == "stall"
+               for r in l1)
+    assert not any(r.get("faultEntry", {}).get("action") == "kick"
+                   for r in l1)
+
+
+# ----------------------------------------------------------- CLI layer
+
+
+def test_tt_quality_cli_summarizes(stall_run, tmp_path, capsys):
+    _, lines, _ = stall_run
+    log = tmp_path / "q.jsonl"
+    log.write_text("\n".join(json.dumps(r) for r in lines) + "\n")
+    from timetabling_ga_tpu.obs.quality import main_quality
+    assert main_quality([str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "hamming" in out
+    assert "crossover" in out
+    assert "migration gain" in out
+    assert "stall" in out and "kick" in out
+
+
+def test_trace_export_renders_quality_counter_tracks():
+    from timetabling_ga_tpu.obs.trace_export import export_chrome_trace
+    recs = [{"qualityEntry": {"quality.diversity.hamming": 0.4,
+                              "quality.ops.move1_accepts": 3,
+                              "ts": 1.5, "dispatch": 0}},
+            {"qualityEntry": {"hamming": 0.2, "job": "j1", "ts": 2.0,
+                              "gens": 10}}]
+    doc = export_chrome_trace(recs)
+    counters = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    names = {e["name"] for e in counters}
+    assert "quality.diversity.hamming" in names
+    assert "quality.ops.move1_accepts" in names
+    assert "hamming[j1]" in names              # job-tagged serve track
+    # --job mode drops process-global counter tracks, like metricsEntry
+    assert export_chrome_trace(recs, job="j1")["traceEvents"] == []
+
+
+def test_tt_stats_includes_quality_section():
+    from timetabling_ga_tpu.obs.logstats import summarize
+    recs = [{"qualityEntry": {"quality.diversity.hamming": 0.4,
+                              "quality.ops.crossover_wins": 2,
+                              "quality.ops.crossover_attempts": 10,
+                              "ts": 1.0}},
+            {"faultEntry": {"site": "quality", "action": "stall",
+                            "time": 3.0, "streak": 2, "hamming": 0.01,
+                            "error": "x", "trial": 0, "recovery": 0,
+                            "level": 0}}]
+    text = summarize(recs)
+    assert "quality entries: 1" in text
+    assert "crossover: 2/10 wins" in text
+    assert "stall @ 3.0s" in text

@@ -270,6 +270,11 @@ def test_engine_trace_profile(tim_file, tmp_path):
     assert found, "no profiler artifacts written"
 
 
+@pytest.mark.slow
+# re-tiered (ISSUE 9 tier-1 budget): the checkpoint/resume round trip
+# stays tier-1-covered by test_obs's checkpointed deltas run (loadable
+# checkpoint) and test_faults' snapshot-rehydrate paths; the full
+# two-run resume equivalence runs in the slow tier
 def test_engine_resume(tim_file, tmp_path):
     ck = str(tmp_path / "resume.npz")
     cfg = RunConfig(input=tim_file, seed=5, pop_size=8, islands=2,
@@ -747,6 +752,10 @@ def test_pipeline_depth2_matches_serial(tim_file, tmp_path):
         assert int(z["generation"]) == 30
 
 
+@pytest.mark.slow
+# re-tiered (ISSUE 9 tier-1 budget): pipeline-vs-serial record identity
+# stays tier-1-pinned by test_pipeline_depth2_matches_serial; this one
+# only checks the auto-disable predicate across config combinations
 def test_pipeline_auto_disables_on_control_paths(tim_file):
     """A post config makes the phase switch a between-dispatch CONTROL
     read, so the engine must fall back to the serial loop even with

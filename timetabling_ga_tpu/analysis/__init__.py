@@ -36,6 +36,12 @@ Rules (see README "Static analysis & sanitizers"):
          hazards that belong in the obs paths only: the cost
          observatory (obs/cost.py) extracts analyses at compile time
          and polls memory_stats from its own thread
+  TT604  quality accounting off device — population-evaluation calls
+         (batch_penalty/evaluate/event_heat) inside dispatch-loop
+         bodies, and collectives or collective-bearing random ops
+         introduced in quality-reduction helpers (TT302-adjacent);
+         the search-quality observatory ships packed on-device rows
+         instead (obs/quality.py, parallel/islands.py)
 
 Suppress one finding inline with `# tt-analyze: ignore[TT301]` (on the
 line, or on a comment line directly above). Configure via
@@ -72,7 +78,8 @@ class _Context:
 def _rule_modules():
     from timetabling_ga_tpu.analysis import (
         rules_api, rules_cost, rules_donate, rules_http, rules_obs,
-        rules_recompile, rules_rng, rules_sync, rules_trace)
+        rules_quality, rules_recompile, rules_rng, rules_sync,
+        rules_trace)
     return {
         "TT101": rules_trace,
         "TT102": rules_trace,
@@ -88,6 +95,7 @@ def _rule_modules():
         "TT601": rules_obs,
         "TT602": rules_http,
         "TT603": rules_cost,
+        "TT604": rules_quality,
     }
 
 

@@ -17,7 +17,7 @@ import re
 
 ALL_RULES = ("TT101", "TT102", "TT201", "TT202", "TT203", "TT301",
              "TT302", "TT401", "TT402", "TT501", "TT502", "TT601",
-             "TT602", "TT603")
+             "TT602", "TT603", "TT604")
 
 
 @dataclasses.dataclass
@@ -54,6 +54,14 @@ class AnalyzerConfig:
     # (checkpointing, serialization)
     rng_exempt_callees: list[str] = dataclasses.field(
         default_factory=lambda: ["save", "key_data", "log_entry"])
+    # population-evaluation callees TT604 flags inside dispatch-loop
+    # bodies (host-side per-generation quality recompute)
+    quality_recompute_callees: list[str] = dataclasses.field(
+        default_factory=lambda: ["batch_penalty", "evaluate",
+                                 "event_heat"])
+    # function-name pattern marking quality-reduction helpers (TT604
+    # bans collectives and collective-bearing random ops inside them)
+    quality_path_pattern: str = r"quality|hamming|div_stats|div_rows"
 
     root: str = "."
 

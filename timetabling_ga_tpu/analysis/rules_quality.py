@@ -1,0 +1,159 @@
+"""TT604 — quality accounting must stay on device.
+
+The search-quality observatory (obs/quality.py, README "Search-quality
+observatory") ships diversity/operator/migration telemetry as packed
+int32 columns on the telemetry leaf the dispatch loop ALREADY fetches:
+one leaf, no extra device round trips, no host math beyond a numpy
+decode. Two ways to silently lose that property:
+
+  - HOST RECOMPUTE: calling a population-evaluation routine
+    (`batch_penalty`, `evaluate`, `event_heat`, ...) inside a dispatch
+    loop's body to derive quality numbers from the fetched population —
+    a per-dispatch O(pop x E) host bill (and, on device arrays, a
+    hidden sync) that the on-device reduction exists to avoid. Scoped
+    to the configured dispatch modules' For/While bodies, like TT301 /
+    TT603's loop halves.
+
+  - NEW COLLECTIVES: a quality-reduction helper (any function whose
+    name matches the configured quality-path pattern, in the
+    shard_map-executed modules) introducing a collective (`ppermute`,
+    `psum`, `pmin`, ...) or a collective-bearing random op
+    (`permutation` / `shuffle` / `choice` — TT302's shuffle-sort
+    hazard). Telemetry must ride existing exchanges: the migration-gain
+    reduction reads the sorted blocks the ring ALREADY holds, and the
+    Hamming sample uses a deterministic coprime stride precisely so no
+    shuffle (and no replicated-sort all-reduce) ever enters the
+    telemetry path.
+
+The sanctioned shape: reductions in parallel/islands.py pack the block
+on device; runtime/engine.py and serve/scheduler.py only slice and
+numpy-decode the fetched rows (obs_quality.decode_rows).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from timetabling_ga_tpu.analysis.core import Finding
+
+RULE = "TT604"
+
+# collectives + TT302-adjacent collective-bearing random ops: none may
+# be INTRODUCED by a quality-reduction helper
+_COLLECTIVES = {"ppermute", "psum", "pmin", "pmax", "all_gather",
+                "all_to_all", "pbroadcast", "pshuffle"}
+_RANDOM_OPS = {"permutation", "shuffle", "choice"}
+
+
+def _call_name(node: ast.Call) -> str | None:
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+class _LoopScanner:
+    """Flag quality-recompute callees inside any For/While body of a
+    host function — structurally the TT603 loop half, with the callee
+    set configured as `quality-recompute-callees`."""
+
+    def __init__(self, path, callees, findings):
+        self.path = path
+        self.callees = set(callees)
+        self.findings = findings
+
+    def scan(self, fn: ast.AST) -> None:
+        self._stmts(getattr(fn, "body", []), in_loop=False)
+
+    def _check(self, node: ast.AST, in_loop: bool) -> None:
+        if not in_loop:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and _call_name(
+                    sub) in self.callees:
+                self.findings.append(Finding(
+                    RULE, self.path, sub.lineno, sub.col_offset,
+                    f"`{_call_name(sub)}(...)` inside a dispatch loop — "
+                    f"host-side per-generation quality recompute; the "
+                    f"on-device quality block already carries these "
+                    f"numbers on the fetched leaf (obs/quality.py, "
+                    f"README \"Search-quality observatory\")"))
+
+    def _stmts(self, stmts, in_loop: bool) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, (ast.For, ast.While)):
+                if isinstance(st, ast.While):
+                    self._check(st.test, in_loop)
+                else:
+                    self._check(st.iter, in_loop)
+                self._stmts(st.body, True)
+                self._stmts(st.orelse, True)
+                continue
+            for field in ("value", "test", "iter"):
+                v = getattr(st, field, None)
+                if isinstance(v, ast.expr):
+                    self._check(v, in_loop)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(st, field, None)
+                if isinstance(sub, list):
+                    self._stmts(sub, in_loop)
+            for h in getattr(st, "handlers", []) or []:
+                self._stmts(h.body, in_loop)
+
+
+def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
+    norm = path.replace("\\", "/")
+    findings: list[Finding] = []
+    cfg = ctx.config
+    # half 1: dispatch-loop host recompute, configured modules only
+    if any(norm.endswith(suffix) for suffix in cfg.dispatch_modules):
+        scanner = _LoopScanner(path, cfg.quality_recompute_callees,
+                               findings)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scanner.scan(node)
+    # half 2: collectives / collective-bearing random ops introduced in
+    # quality-reduction helpers of the shard_map-executed modules
+    if any(frag in norm for frag in cfg.sharded_modules):
+        qpat = re.compile(cfg.quality_path_pattern)
+        banned = _COLLECTIVES | _RANDOM_OPS
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not qpat.search(node.name):
+                continue
+            for sub in ast.walk(node):
+                # both call forms: `lax.ppermute(...)` AND a bare
+                # `ppermute(...)` after `from jax.lax import ppermute`
+                # — same hazard, same flag (_call_name covers both)
+                name = (_call_name(sub) if isinstance(sub, ast.Call)
+                        else None)
+                if name in banned:
+                    kind = ("collective" if name in _COLLECTIVES
+                            else "collective-bearing random op")
+                    findings.append(Finding(
+                        RULE, path, sub.lineno, sub.col_offset,
+                        f"`{name}` is a {kind} inside quality-"
+                        f"reduction helper `{node.name}` — quality "
+                        f"telemetry must ride existing exchanges and "
+                        f"deterministic strides, never add collectives "
+                        f"(TT302-adjacent; parallel/islands.py "
+                        f"_div_stats / _migrate return_gain are the "
+                        f"sanctioned patterns)"))
+    # a nested quality helper inside a scanned loop could double-report
+    # one line; dedupe by (line, col) like TT603
+    seen: set = set()
+    out = []
+    for f in findings:
+        k = (f.line, f.col)
+        if k in seen:
+            continue
+        seen.add(k)
+        out.append(f)
+    return out

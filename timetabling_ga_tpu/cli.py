@@ -29,6 +29,9 @@ JSONL record stream, never a device.
     python -m timetabling_ga_tpu.cli stats run.jsonl
         summarize: best-so-far curves, recoveries, per-job latency
         (for serve logs: queued/packed/executing/parked breakdown)
+    python -m timetabling_ga_tpu.cli quality run.jsonl
+        summarize the search-quality telemetry (--quality runs):
+        diversity trend, operator hit rates, migration gain, stalls
 
 `profile` subcommand — the cost observatory's on-demand capture
 trigger (README "Cost observatory"; obs/cost.py): ask a live run or
@@ -58,6 +61,12 @@ def main(argv=None) -> int:
     if argv and argv[0] == "stats":
         from timetabling_ga_tpu.obs.logstats import main_stats
         return main_stats(argv[1:])
+    if argv and argv[0] == "quality":
+        # deferred + jax-free like trace/stats: summarize a record
+        # stream's qualityEntry search telemetry (obs/quality.py,
+        # README "Search-quality observatory")
+        from timetabling_ga_tpu.obs.quality import main_quality
+        return main_quality(argv[1:])
     if argv and argv[0] == "profile":
         # deferred + jax-free like trace/stats: `tt profile` is a
         # stdlib HTTP client asking a LIVE run's --obs-listen front to

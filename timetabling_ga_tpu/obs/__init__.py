@@ -13,6 +13,10 @@ Three layers:
                 live by `tt serve`'s `stats` command, and exported as
                 Prometheus text exposition
   obs.logstats  `tt stats` — offline summarizer for any record stream
+  obs.quality   the search-quality observatory's host side — packed-
+                leaf layout constants, numpy decode into the quality.*
+                namespace, the stall detector, and `tt quality`
+                (README "Search-quality observatory")
 
 The device-side half of the story — `--trace-mode full|deltas|stats`,
 which shrinks the per-generation telemetry leaf the engine fetches —

@@ -95,7 +95,12 @@ def readiness(registry) -> tuple[bool, dict]:
         configured (the next transient failure aborts the run);
       - `device.mem_frac_used` >= obs/cost.py NEAR_HBM_FRAC (the cost
         observatory's memory poller says the next placement is an OOM
-        gamble — route new work elsewhere until the pressure clears).
+        gamble — route new work elsewhere until the pressure clears);
+      - `engine.stalled` >= 1 (the search-quality observatory's stall
+        detector: the run has plateaued with a collapsed population —
+        obs/quality.py StallDetector; the gauge clears when a new best
+        lands or the auto-kick fires, so the reason is live, not a
+        one-way trip).
 
     Absent gauges (an engine run has no serve queue; a serve process
     may never have set the ladder; no memory poller on CPU) are simply
@@ -117,11 +122,15 @@ def readiness(registry) -> tuple[bool, dict]:
     mem_frac = gauges.get("device.mem_frac_used")
     if mem_frac is not None and mem_frac >= obs_cost.NEAR_HBM_FRAC:
         reasons.append("near_hbm_limit")
+    stalled = gauges.get("engine.stalled")
+    if stalled is not None and stalled >= 1:
+        reasons.append("stalled")
     return not reasons, {"ready": not reasons, "reasons": reasons,
                          "queue_depth": depth, "backlog": bound,
                          "degrade_level": level,
                          "recovery_budget_remaining": budget,
-                         "mem_frac_used": mem_frac}
+                         "mem_frac_used": mem_frac,
+                         "stalled": stalled}
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):

@@ -100,6 +100,7 @@ def summarize(records) -> str:
     jobs: dict = {}         # job id -> lifecycle events
     spans: list = []        # spanEntry bodies (per-job breakdown)
     compiles: list = []     # costEntry bodies (compile accounting)
+    quality_recs: list = []  # whole records (obs/quality.py summarize)
     counts: dict = {}
     last_metrics = None
     for rec in records:
@@ -123,6 +124,8 @@ def summarize(records) -> str:
                 spans.append(body)
         elif kind == "costEntry":
             compiles.append(body)
+        elif kind == "qualityEntry":
+            quality_recs.append(rec)
         elif kind == "metricsEntry":
             last_metrics = body
 
@@ -236,6 +239,15 @@ def summarize(records) -> str:
                     tail += f" AI {last['intensity']:.1f}"
             lines.append(f"  {prog}: {len(cs)}x, {total:.2f}s "
                          f"lower+compile{tail}")
+
+    if quality_recs:
+        # search-quality observatory (obs/quality.py owns the report):
+        # diversity trend, operator hit rates, migration gain, and the
+        # stall/kick event log (faultEntry site `quality`)
+        from timetabling_ga_tpu.obs import quality as obs_quality
+        lines.append(obs_quality.summarize(
+            quality_recs + [{"faultEntry": f} for f in faults
+                            if f.get("site") == "quality"]))
 
     if last_metrics is not None:
         lines.append("== last metrics snapshot")

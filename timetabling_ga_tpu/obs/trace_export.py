@@ -28,6 +28,11 @@ timeline. Mapping:
   metricsEntry -> counter events (ph "C") for every numeric counter/
                   gauge, at the snapshot's `ts` — Perfetto renders
                   them as tracks (gens/sec, queue depth over time)
+  qualityEntry -> counter events (ph "C") for every numeric quality
+                  field (diversity Hamming/variance, operator win
+                  counts, migration gain) at the entry's `ts` — the
+                  search-quality observatory's per-dispatch telemetry
+                  as live tracks next to the dispatch spans
   costEntry    -> complete event on the "compiles" lane (tid 998): a
                   slab of lowerSeconds+compileSeconds ENDING at the
                   record's `ts` (the observatory stamps emission right
@@ -74,6 +79,27 @@ def _counter_events(rec: dict) -> list[dict]:
                 out.append({"name": name, "ph": "C", "pid": 0, "tid": 0,
                             "ts": round(float(ts) * 1e6, 3),
                             "args": {"value": v}})
+    return out
+
+
+def _quality_counter_events(rec: dict) -> list[dict]:
+    """qualityEntry -> one Perfetto counter sample per numeric quality
+    field. Serve entries are job-tagged (one entry per lane per
+    dispatch); their track names get a `[job]` suffix so co-tenants'
+    tracks stay apart."""
+    ts = rec.get("ts")
+    if ts is None:
+        return []
+    job = rec.get("job")
+    out = []
+    for name, v in rec.items():
+        if name in ("ts", "job", "dispatch", "gens"):
+            continue
+        if isinstance(v, (int, float)) and v == v:
+            track = f"{name}[{job}]" if job is not None else name
+            out.append({"name": track, "ph": "C", "pid": 0, "tid": 0,
+                        "ts": round(float(ts) * 1e6, 3),
+                        "args": {"value": v}})
     return out
 
 
@@ -146,6 +172,8 @@ def export_chrome_trace(records, job: str | None = None) -> dict:
             spans.append(rec["spanEntry"])
         elif job is None and "metricsEntry" in rec:
             events.extend(_counter_events(rec["metricsEntry"]))
+        elif job is None and "qualityEntry" in rec:
+            events.extend(_quality_counter_events(rec["qualityEntry"]))
         elif job is None and "costEntry" in rec:
             c = rec["costEntry"]
             ts = c.get("ts")

@@ -166,8 +166,12 @@ def _make_child(pa, key, state: PopState, cfg: GAConfig, mo_stats=None):
     """Breed one child: 2x tournament -> crossover(p) -> mutation(p).
 
     (ga.cpp:543-571 minus the wasteful throwaway Solution allocs at
-    543-548.) Returns (slots, rooms) of the child; evaluation happens
-    batched in `generation`.
+    543-548.) Returns (slots, rooms, did_crossover, did_mutate,
+    parent_a) of the child; evaluation happens batched in `generation`.
+    The two operator flags and the base-parent index feed the quality
+    observatory's efficacy counters (README "Search-quality
+    observatory") — they are values the breeding already drew, so
+    shipping them costs nothing and perturbs no RNG stream.
 
     `mo_stats` is None (scalar-penalty tournament, ga.cpp:129-145) or a
     (ranks, crowding) pair: then parents are drawn by the NSGA-II
@@ -207,12 +211,25 @@ def _make_child(pa, key, state: PopState, cfg: GAConfig, mo_stats=None):
     do_m = jax.random.bernoulli(k_m, cfg.p_mutation)
     slots = jnp.where(do_m, m_slots, slots)
     rooms_arr = jnp.where(do_m, m_rooms, rooms_arr)
-    return slots, rooms_arr
+    return slots, rooms_arr, do_x, do_m, ia
 
 
-def generation(pa, key, state: PopState, cfg: GAConfig) -> PopState:
+def generation(pa, key, state: PopState, cfg: GAConfig,
+               with_quality: bool = False):
     """One generation: breed P children in a single vmapped batch, then
-    mu+lambda truncation over parents+children."""
+    mu+lambda truncation over parents+children.
+
+    `with_quality=True` (the tt-obs quality observatory) additionally
+    returns a (quality.N_OPS,) int32 vector of operator-efficacy
+    counters for this generation: crossover attempts/wins, mutation
+    attempts/wins (a WIN is a child whose evaluated penalty strictly
+    beats its base parent's — credited to every operator that touched
+    the child, the honest attribution available without re-evaluating
+    each operator's output separately), then the sweep LS's accepted
+    Move1/Move2/Move3 counts (sweep_local_search return_ops; zeros for
+    the random-candidate LS). Derived entirely from values the breeding
+    already computes: no extra RNG draws, no extra fitness evaluations
+    — the trajectory is bit-identical with the flag on or off."""
     keys = jax.random.split(key, cfg.pop_size)
     mo_stats = None
     if cfg.multi_objective:
@@ -222,18 +239,23 @@ def generation(pa, key, state: PopState, cfg: GAConfig) -> PopState:
         ranks = nsga.nondominated_ranks(state.hcv, state.scv)
         crowd = nsga.crowding_distance(state.hcv, state.scv, ranks)
         mo_stats = (ranks, crowd)
-    ch_slots, ch_rooms = jax.vmap(
+    ch_slots, ch_rooms, did_x, did_m, parent_a = jax.vmap(
         lambda k: _make_child(pa, k, state, cfg, mo_stats))(keys)
 
+    sweep_ops = jnp.zeros((3,), jnp.int32)
     if cfg.ls_mode == "sweep" and cfg.ls_sweeps > 0:
         # systematic Move1+Move2 sweep (Solution.cpp:508-561 analogue)
         from timetabling_ga_tpu.ops.sweep import sweep_local_search
         k_ls = jax.random.fold_in(key, 0x15)
-        ch_slots, ch_rooms = sweep_local_search(
+        out = sweep_local_search(
             pa, k_ls, ch_slots, ch_rooms,
             n_sweeps=cfg.ls_sweeps, swap_block=cfg.ls_swap_block,
             converge=cfg.ls_converge, block_events=cfg.ls_block_events,
-            sideways=cfg.ls_sideways, hot_k=cfg.ls_hot_k, p3=cfg.p3)
+            sideways=cfg.ls_sideways, hot_k=cfg.ls_hot_k, p3=cfg.p3,
+            return_ops=with_quality)
+        ch_slots, ch_rooms = out[0], out[1]
+        if with_quality:
+            sweep_ops = out[2]
     elif cfg.ls_steps > 0:
         if cfg.ls_delta:
             from timetabling_ga_tpu.ops.delta import (
@@ -262,9 +284,18 @@ def generation(pa, key, state: PopState, cfg: GAConfig) -> PopState:
         order = keep[fitness.lex_order(all_pen[keep], all_scv[keep])]
     else:
         order = fitness.lex_order(all_pen, all_scv)[:cfg.pop_size]
-    return PopState(slots=all_slots[order], rooms=all_rooms[order],
-                    penalty=all_pen[order], hcv=all_hcv[order],
-                    scv=all_scv[order])
+    new_state = PopState(slots=all_slots[order], rooms=all_rooms[order],
+                         penalty=all_pen[order], hcv=all_hcv[order],
+                         scv=all_scv[order])
+    if not with_quality:
+        return new_state
+    improved = c_pen < state.penalty[parent_a]
+    q = jnp.stack([
+        jnp.sum(did_x.astype(jnp.int32)),
+        jnp.sum((did_x & improved).astype(jnp.int32)),
+        jnp.sum(did_m.astype(jnp.int32)),
+        jnp.sum((did_m & improved).astype(jnp.int32))])
+    return new_state, jnp.concatenate([q, sweep_ops])
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n_generations"))

@@ -225,7 +225,8 @@ def event_heat(pa, slots, rooms_arr, att, occ, hcv):
 
 def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
                block_events: int = 1, sideways: float = 0.0,
-               hot_k: int = 0, p3: float = 0.0):
+               hot_k: int = 0, p3: float = 0.0,
+               return_ops: bool = False):
     """One sweep pass (shuffled per individual).
 
     `block_events` = events examined per scan step. With 1 (default)
@@ -261,7 +262,15 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
     population is at a local optimum of the examined neighborhood, the
     same fixed-point condition that ends the reference's localSearch (a
     full improving-free pass over all events, Solution.cpp:497-618
-    counter semantics)."""
+    counter semantics).
+
+    `return_ops=True` (the tt-obs quality observatory) additionally
+    returns a (3,) int32 vector of ACCEPTED moves by type — Move1 /
+    Move2 / Move3, classified by which candidate block the accepted
+    index fell in — summed over the pass's steps and individuals. The
+    counts are derived from values the step already computes (no new
+    RNG draws, no extra candidate evaluations), so the trajectory is
+    bit-identical with the flag on or off; tests pin it."""
     cap_rank = capacity_rank(pa)
     P, E = state.slots.shape
     T = pa.n_slots
@@ -532,11 +541,30 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
             pen=jnp.where(better, best_pen, st.pen),
             hcv=jnp.where(better, new_hcv[ar, best], st.hcv),
             scv=jnp.where(better, new_scv[ar, best], st.scv))
+        if return_ops:
+            # accepted-move counts by candidate block (the concat order
+            # above is Move1 | Move2 | Move3, with static block sizes):
+            # every ACCEPT counts, sideways drift included — acceptance
+            # is what the efficacy question is about
+            n1 = B * T
+            n2 = B * swap_block if swap_block > 0 else 0
+            is1 = best < n1
+            is2 = (best >= n1) & (best < n1 + n2)
+            is3 = best >= n1 + n2
+            ops = jnp.stack([
+                jnp.sum((better & is1).astype(jnp.int32)),
+                jnp.sum((better & is2).astype(jnp.int32)),
+                jnp.sum((better & is3).astype(jnp.int32))])
+        else:
+            ops = jnp.zeros((3,), jnp.int32)
         # `improved` counts only STRICT improvements: sideways accepts
         # must not keep the convergence loop alive forever
-        return st, strict.any()
+        return st, (strict.any(), ops)
 
-    state, accepted = lax.scan(step, state, jnp.arange(n_steps))
+    state, (accepted, ops_steps) = lax.scan(step, state,
+                                            jnp.arange(n_steps))
+    if return_ops:
+        return state, accepted.any(), jnp.sum(ops_steps, axis=0)
     return state, accepted.any()
 
 
@@ -544,7 +572,8 @@ def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
                        swap_block: int = 8, converge: bool = False,
                        block_events: int = 1, sideways: float = 0.0,
                        hot_k: int = 0, p3: float = 0.0,
-                       return_passes: bool = False):
+                       return_passes: bool = False,
+                       return_ops: bool = False):
     """Run up to `n_sweeps` sweep passes over a (P, E) population.
 
     Candidate budget per pass per individual: K * (T + swap_block
@@ -568,6 +597,11 @@ def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
     convergence signal the host otherwise cannot see without fetching
     per-individual state. The count is already the loop carry, so
     shipping it costs nothing and perturbs no trajectory.
+
+    return_ops=True (tt-obs quality observatory) appends a (3,) int32
+    vector of accepted Move1/Move2/Move3 counts summed over every
+    executed pass (sweep_pass return_ops — no new RNG, trajectory
+    untouched). Return order: slots, rooms[, passes][, ops].
     """
     state = init_state(pa, slots, rooms_arr)
 
@@ -575,43 +609,62 @@ def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
     # converge=True run and a fixed-pass run with the same key follow
     # IDENTICAL trajectories for their shared prefix of passes — the
     # converged result is then provably <= any fixed-budget result.
+    ops = jnp.zeros((3,), jnp.int32)
     if converge:
         def cond(carry):
-            _, i, improved = carry
+            _, i, improved, _ops = carry
             return (i < n_sweeps) & improved
 
         def body(carry):
-            st, i, _ = carry
-            st, improved = sweep_pass(pa, jax.random.fold_in(key, i), st,
-                                      swap_block, block_events, sideways,
-                                      hot_k, p3)
-            return st, i + 1, improved
+            st, i, _, op = carry
+            if return_ops:
+                st, improved, o = sweep_pass(
+                    pa, jax.random.fold_in(key, i), st, swap_block,
+                    block_events, sideways, hot_k, p3, return_ops=True)
+                op = op + o
+            else:
+                st, improved = sweep_pass(
+                    pa, jax.random.fold_in(key, i), st, swap_block,
+                    block_events, sideways, hot_k, p3)
+            return st, i + 1, improved, op
 
-        state, passes, _ = lax.while_loop(
-            cond, body, (state, jnp.int32(0), jnp.bool_(True)))
+        state, passes, _, ops = lax.while_loop(
+            cond, body, (state, jnp.int32(0), jnp.bool_(True), ops))
     else:
-        def one(st, i):
-            st, _ = sweep_pass(pa, jax.random.fold_in(key, i), st,
-                               swap_block, block_events, sideways,
-                               hot_k, p3)
-            return st, None
+        def one(carry, i):
+            st, op = carry
+            if return_ops:
+                st, _, o = sweep_pass(pa, jax.random.fold_in(key, i), st,
+                                      swap_block, block_events, sideways,
+                                      hot_k, p3, return_ops=True)
+                op = op + o
+            else:
+                st, _ = sweep_pass(pa, jax.random.fold_in(key, i), st,
+                                   swap_block, block_events, sideways,
+                                   hot_k, p3)
+            return (st, op), None
 
-        state, _ = lax.scan(one, state, jnp.arange(n_sweeps))
+        (state, ops), _ = lax.scan(one, (state, ops),
+                                   jnp.arange(n_sweeps))
         passes = jnp.int32(n_sweeps)
+    outs = [state.slots, state.rooms]
     if return_passes:
-        return state.slots, state.rooms, passes
-    return state.slots, state.rooms
+        outs.append(passes)
+    if return_ops:
+        outs.append(ops)
+    return tuple(outs)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("n_sweeps", "swap_block", "converge",
                                     "block_events", "sideways", "hot_k",
-                                    "p3", "return_passes"))
+                                    "p3", "return_passes", "return_ops"))
 def jit_sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
                            swap_block: int = 8, converge: bool = False,
                            block_events: int = 1, sideways: float = 0.0,
                            hot_k: int = 0, p3: float = 0.0,
-                           return_passes: bool = False):
+                           return_passes: bool = False,
+                           return_ops: bool = False):
     return sweep_local_search(pa, key, slots, rooms_arr, n_sweeps,
                               swap_block, converge, block_events, sideways,
-                              hot_k, p3, return_passes)
+                              hot_k, p3, return_passes, return_ops)

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import math
 import os
 
 import jax
@@ -43,6 +44,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from timetabling_ga_tpu.compat import shard_map
 
+# stdlib-only layout constants + host decode of the quality block the
+# runners append to the telemetry leaf (README "Search-quality
+# observatory"); the device-side packing lives HERE, with the leaf
+from timetabling_ga_tpu.obs import quality as obs_quality
 from timetabling_ga_tpu.ops import fitness, ga
 
 
@@ -171,10 +176,19 @@ def init_island_population(pa, key, mesh: Mesh, pop_size: int,
     return _init(pa, key)
 
 
-def _migrate(state: ga.PopState, n_islands: int, L: int = 1
-             ) -> ga.PopState:
+def _migrate(state: ga.PopState, n_islands: int, L: int = 1,
+             return_gain: bool = False):
     """Bidirectional ring migration of 1 migrant each way over ALL
     n_islands islands (device-resident local islands included).
+
+    `return_gain=True` (the quality observatory) additionally returns a
+    (L,) int32 vector of each local island's REPORTED-best improvement
+    across this exchange (`_reported_i32` before minus after, clamped
+    at 0 — replacement of the two worst rows can only leave the best
+    equal or better): the live answer to "is migration earning its
+    ppermute". Derived from the sorted blocks the exchange already
+    holds — no new collectives (tt-analyze TT604 lints that), no RNG,
+    trajectory untouched.
 
     Best solution to the next island, second-best to the previous
     (ga.cpp:522-535); immigrants overwrite the two worst rows
@@ -198,12 +212,15 @@ def _migrate(state: ga.PopState, n_islands: int, L: int = 1
     (tt_cpu --islands) applies the same P >= 3 guard."""
     pop = state.penalty.shape[0] // L
     if pop < 3:
+        if return_gain:
+            return state, jnp.zeros((L,), jnp.int32)
         return state
     n_dev = max(1, n_islands // L)
     fwd = [(i, (i + 1) % n_dev) for i in range(n_dev)]
     bwd = [(i, (i - 1) % n_dev) for i in range(n_dev)]
 
     blk = _blocks(state, L, pop)
+    rep_before = _reported_i32(blk.hcv[:, 0], blk.scv[:, 0])  # (L,)
     best = jax.tree.map(lambda x: x[:, 0], blk)    # (L, ...) emigrants
     second = jax.tree.map(lambda x: x[:, 1], blk)
 
@@ -228,12 +245,16 @@ def _migrate(state: ga.PopState, n_islands: int, L: int = 1
         lambda x: jnp.take_along_axis(
             x, order.reshape(order.shape + (1,) * (x.ndim - 2)), axis=1),
         blk)
+    if return_gain:
+        rep_after = _reported_i32(blk.hcv[:, 0], blk.scv[:, 0])
+        return _flat(blk), jnp.maximum(rep_before - rep_after, 0)
     return _flat(blk)
 
 
 def make_island_runner(mesh: Mesh, cfg: ga.GAConfig, n_epochs: int,
                        gens_per_epoch: int, n_islands: int = None,
-                       donate: bool = False, trace_mode: str = "full"):
+                       donate: bool = False, trace_mode: str = "full",
+                       quality: bool = False):
     """Build the jitted multi-island evolution step.
 
     Returns `run(pa, key, state) -> (state, best_trace, global_best)`:
@@ -255,6 +276,17 @@ def make_island_runner(mesh: Mesh, cfg: ga.GAConfig, n_epochs: int,
     including all migrations. `n_islands` may exceed the device count
     (local_islands: vmapped per-device islands, like multiple MPI ranks
     per node).
+
+    quality=True (the search-quality observatory, README "Search-quality
+    observatory") appends obs_quality.QUALITY_WIDTH bounded int32
+    columns per island to the COMPRESSED telemetry leaf (a `full`
+    trace upgrades to `deltas` packing — effective_trace_mode; the
+    emitted record stream is unchanged, the established trace-mode
+    contract): operator efficacy counters from every generation
+    (ga.generation with_quality), migration gain from every ring
+    exchange (_migrate return_gain), and end-of-dispatch diversity
+    moments + the Hamming sample (_div_stats). All reductions are
+    on-device and collective-free; the fetch stays ONE leaf.
     """
     if n_islands is None:
         n_islands = mesh.devices.size
@@ -272,27 +304,52 @@ def make_island_runner(mesh: Mesh, cfg: ga.GAConfig, n_epochs: int,
         check_vma=False)
     def _run(pa, key, state):
         my_key = jax.random.fold_in(key, lax.axis_index(AXIS))
+        q0 = jnp.zeros((L, obs_quality.N_OPS), jnp.int32)
+        mg0 = jnp.zeros((L,), jnp.int32)
 
-        def epoch(st, k):
+        def epoch(carry, k):
+            st, q, mg = carry
+
             def gen_step(s, kk):
                 sb = _blocks(s, L, pop)
                 kks = jax.random.split(kk, L)
-                sb = jax.vmap(
-                    lambda b, kb: ga.generation(pa, kb, b, cfg))(sb, kks)
+                if quality:
+                    sb, qg = jax.vmap(
+                        lambda b, kb: ga.generation(
+                            pa, kb, b, cfg, with_quality=True))(sb, kks)
+                else:
+                    sb = jax.vmap(
+                        lambda b, kb: ga.generation(pa, kb, b,
+                                                    cfg))(sb, kks)
+                    qg = q0
                 # each island is penalty-sorted, so row 0 is its best
                 tr = jnp.stack([sb.hcv[:, 0], sb.scv[:, 0]], axis=-1)
-                return _flat(sb), tr              # tr: (L, 2)
+                return _flat(sb), (tr, qg)        # tr: (L, 2)
             gen_keys = jax.random.split(k, gens_per_epoch)
-            st, tr = lax.scan(gen_step, st, gen_keys)   # (gens, L, 2)
-            st = _migrate(st, n_islands, L)
-            return st, tr
+            st, (tr, qgs) = lax.scan(gen_step, st, gen_keys)
+            if quality:
+                q = q + jnp.sum(qgs, axis=0)
+                st, g = _migrate(st, n_islands, L, return_gain=True)
+                mg = mg + g
+            else:
+                st = _migrate(st, n_islands, L)
+            return (st, q, mg), tr                # tr: (gens, L, 2)
 
         epoch_keys = jax.random.split(my_key, n_epochs)
-        state, trace = lax.scan(epoch, state, epoch_keys)
+        (state, qops, mig), trace = lax.scan(epoch, (state, q0, mg0),
+                                             epoch_keys)
         # (n_epochs, gens, L, 2) -> (L, n_epochs, gens, 2): concat over
         # devices then yields island-major (n_islands, n_epochs, gens, 2)
         trace = jnp.transpose(trace, (2, 0, 1, 3))
-        if trace_mode != "full":
+        if quality:
+            trace = _compress_trace(
+                trace.reshape(L, n_epochs * gens_per_epoch, 2), None,
+                effective_trace_mode(trace_mode, True),
+                cap=(n_epochs * gens_per_epoch
+                     if trace_mode == "full" else None))
+            trace = _append_quality(
+                trace, qops, mig, _div_rows(pa, _blocks(state, L, pop)))
+        elif trace_mode != "full":
             trace = _compress_trace(
                 trace.reshape(L, n_epochs * gens_per_epoch, 2), None,
                 trace_mode)
@@ -374,14 +431,131 @@ def _moment_rows(rep, axis=None, where=None):
                                     jnp.int32)
 
 
-def trace_leaf_width(n_gens: int, trace_mode: str) -> int:
+def _reported_i32(hcv, scv):
+    """jsonl.reported_best on device, int32: scv when feasible, else
+    hcv*1e6+scv — the quality observatory's migration-gain domain.
+    (Overflows past hcv ~2147, far beyond any real instance's hcv.)"""
+    return jnp.where(hcv == 0, scv,
+                     hcv * jnp.int32(1_000_000) + scv).astype(jnp.int32)
+
+
+def _hamming_stride(pop: int) -> int:
+    """Static coprime pair stride for the diversity Hamming sample:
+    the largest a <= pop//2 with gcd(a, pop) == 1, so pairing row i
+    with row (i + a) mod pop walks a full cycle with maximal spread —
+    a DETERMINISTIC sample, deliberately not jax.random.permutation
+    (whose shuffle-sort under shard_map is exactly the TT302 collective
+    hazard the telemetry path must never introduce). 0 when pop < 2
+    (no pairs exist)."""
+    if pop < 2:
+        return 0
+    for a in range(max(1, pop // 2), 0, -1):
+        if math.gcd(a, pop) == 1:
+            return a
+    return 1
+
+
+def _div_stats(event_mask, slots, pen, scv):
+    """One island's diversity block: (obs_quality.N_DIV,) bitcast-int32
+    of penalty mean/var/min/max, scv mean/var/min/max, and the bounded
+    coprime-stride Hamming sample mean over slot assignments — the
+    fraction of differing LIVE slots averaged over min(pop,
+    HAMMING_PAIRS) stride-paired individuals (padded events masked
+    out). Everything is elementwise + local reductions: no collectives,
+    no RNG (tt-analyze TT604 / TT302 discipline).
+
+    Moments are computed on MIN-SHIFTED values (x - min(x)): the
+    infeasible penalty domain sits at ~1e6, where float32's
+    mean-of-squares loses the whole population spread to cancellation
+    (a measured var of 0.0 across a visibly spread population) — the
+    shift keeps the squares at spread scale. mean = min + mean(shift);
+    min/max are exact either way."""
+
+    def moments(x):
+        mn = jnp.min(x)
+        c = x - mn
+        mean_c = jnp.mean(c)
+        var = jnp.maximum(jnp.mean(c * c) - mean_c * mean_c, 0.0)
+        return lax.bitcast_convert_type(
+            jnp.stack([mn + mean_c, var, mn, jnp.max(x)]), jnp.int32)
+
+    parts = [moments(pen.astype(jnp.float32)),
+             moments(scv.astype(jnp.float32))]
+    pop = slots.shape[0]
+    k_pairs = min(pop, obs_quality.HAMMING_PAIRS)
+    stride = _hamming_stride(pop)
+    if stride == 0:
+        ham = jnp.zeros((1,), jnp.float32)
+    else:
+        a = slots[:k_pairs]
+        b = jnp.roll(slots, -stride, axis=0)[:k_pairs]
+        m = event_mask.astype(jnp.float32)
+        live = jnp.maximum(jnp.sum(m), 1.0)
+        ham = (jnp.sum((a != b).astype(jnp.float32) * m[None, :])
+               / (k_pairs * live))[None]
+    parts.append(lax.bitcast_convert_type(ham, jnp.int32))
+    return jnp.concatenate(parts)
+
+
+def _div_rows(pa, blk: ga.PopState):
+    """(L, obs_quality.N_DIV) diversity rows over per-island blocks
+    (one shared problem; the lane runner vmaps _div_stats with its
+    per-lane masks instead)."""
+    return jax.vmap(lambda s, p, v: _div_stats(pa.event_mask, s, p, v))(
+        blk.slots, blk.penalty, blk.scv)
+
+
+def _append_quality(trace, qops, mig, div):
+    """THE quality-block wire layout: [event leaf | N_OPS operator
+    counters | migration gain | N_DIV diversity]. obs_quality's OFF_*
+    constants and split_quality decode exactly this column order, so
+    the three runners (static/dynamic/lane) share this one packer —
+    a column added in one place but not the others would otherwise
+    mis-decode silently (int32 counters bitcast as float32 diversity),
+    since decode_rows validates only the total width."""
+    return jnp.concatenate([trace, qops, mig[:, None], div], axis=1)
+
+
+def effective_trace_mode(trace_mode: str, quality: bool) -> str:
+    """The telemetry leaf's actual packing. The quality block rides the
+    COMPRESSED leaf (extra bounded int32 columns — the fetch stays one
+    leaf), so quality mode upgrades a `full` trace to `deltas` packing.
+    The emitted record stream is unchanged by that upgrade: full and
+    compressed leaves already yield identical streams (the established
+    trace-mode contract, tests/test_obs.py)."""
+    if quality and trace_mode == "full":
+        return "deltas"
+    return trace_mode
+
+
+def split_quality(trace, quality: bool):
+    """Host-side split of a fetched telemetry leaf into (event leaf,
+    quality block) — numpy only. The quality block is the trailing
+    obs_quality.QUALITY_WIDTH columns the runners appended; the event
+    leaf keeps the exact layout trace_events expects."""
+    if not quality:
+        return trace, None
+    tr = np.asarray(trace)
+    w = obs_quality.QUALITY_WIDTH
+    return tr[:, :-w], tr[:, -w:]
+
+
+def trace_leaf_width(n_gens: int, trace_mode: str,
+                     quality: bool = False) -> int:
     """Packed telemetry columns per island for a compressed trace:
-    K events x (gen, hcv, scv) + the improvement count [+ moments]."""
-    k = min(n_gens, TRACE_DELTAS_CAP)
-    return 3 * k + 1 + (TRACE_N_MOMENTS if trace_mode == "stats" else 0)
+    K events x (gen, hcv, scv) + the improvement count [+ moments]
+    [+ the quality observatory's block]. A quality-upgraded `full`
+    trace is uncapped (K = n_gens; see _compress_trace's `cap`)."""
+    if quality and trace_mode == "full":
+        k = n_gens
+    else:
+        k = min(n_gens, TRACE_DELTAS_CAP)
+    mode = effective_trace_mode(trace_mode, quality)
+    return (3 * k + 1 + (TRACE_N_MOMENTS if mode == "stats" else 0)
+            + (obs_quality.QUALITY_WIDTH if quality else 0))
 
 
-def _compress_trace(trace, n_valid, trace_mode: str):
+def _compress_trace(trace, n_valid, trace_mode: str, cap: int = None):
     """(L, T, 2) per-generation (hcv, scv) trace -> (L, W) packed int32.
 
     Per island: a scan computes the dispatch-local running lex-min of
@@ -400,9 +574,18 @@ def _compress_trace(trace, n_valid, trace_mode: str):
     None (every row real), a scalar (the dynamic runner's shared
     n_gens), or an (L,) vector (the lane runner's per-lane quantum
     counts). Stats mode appends bitcast float32 moments over the valid
-    rows."""
+    rows.
+
+    `cap` overrides TRACE_DELTAS_CAP for the event-slot count. The
+    quality runners pass cap=T when UPGRADING a `full` trace
+    (effective_trace_mode): a user who chose full asked for every
+    generation, so the upgraded leaf must keep every improvement —
+    n_imp <= T == K means overflow is impossible there, and the
+    quality-on stream stays identical to the quality-off full stream
+    unconditionally (not just under the cap). A user-chosen
+    deltas/stats mode keeps its configured cap semantics."""
     T = trace.shape[1]
-    K = min(T, TRACE_DELTAS_CAP)
+    K = min(T, TRACE_DELTAS_CAP if cap is None else cap)
     gidx = jnp.arange(T, dtype=jnp.int32)
     if n_valid is None:
         nv = jnp.full((trace.shape[0],), T, jnp.int32)
@@ -757,7 +940,8 @@ def make_lahc_runners(mesh: Mesh, cfg: ga.GAConfig, hist_len: int,
 def make_island_runner_dynamic(mesh: Mesh, cfg: ga.GAConfig,
                                max_gens: int, n_islands: int = None,
                                donate: bool = False,
-                               trace_mode: str = "full"):
+                               trace_mode: str = "full",
+                               quality: bool = False):
     """Like `make_island_runner(n_epochs=1)` but the generation count is
     a RUNTIME argument `n_gens <= max_gens`: `run(pa, key, state, n_gens)`.
 
@@ -771,6 +955,9 @@ def make_island_runner_dynamic(mesh: Mesh, cfg: ga.GAConfig,
     trace_mode "deltas"/"stats" ships the compressed telemetry leaf
     instead (_compress_trace, with rows >= n_gens masked out of the
     moments; sentinel rows can never register as improvements).
+    quality=True appends the quality observatory's block exactly like
+    make_island_runner's (the executed fori_loop covers only real
+    generations, so the operator counters never see sentinel rows).
     """
     if n_islands is None:
         n_islands = mesh.devices.size
@@ -789,27 +976,46 @@ def make_island_runner_dynamic(mesh: Mesh, cfg: ga.GAConfig,
     def _run(pa, key, state, n_gens):
         my_key = jax.random.fold_in(key, lax.axis_index(AXIS))
         tr0 = jnp.full((max_gens, L, 2), _SENTINEL, jnp.int32)
+        q0 = jnp.zeros((L, obs_quality.N_OPS), jnp.int32)
 
         def body(i, carry):
-            st, tr = carry
+            st, tr, q = carry
             sb = _blocks(st, L, pop)
             kks = jax.random.split(jax.random.fold_in(my_key, i), L)
-            sb = jax.vmap(
-                lambda b, kb: ga.generation(pa, kb, b, cfg))(sb, kks)
+            if quality:
+                sb, qg = jax.vmap(
+                    lambda b, kb: ga.generation(
+                        pa, kb, b, cfg, with_quality=True))(sb, kks)
+                q = q + qg
+            else:
+                sb = jax.vmap(
+                    lambda b, kb: ga.generation(pa, kb, b, cfg))(sb, kks)
             tr = lax.dynamic_update_index_in_dim(
                 tr, jnp.stack([sb.hcv[:, 0], sb.scv[:, 0]], axis=-1),
                 i, 0)
-            return _flat(sb), tr
+            return _flat(sb), tr, q
 
-        state, trace = lax.fori_loop(0, n_gens, body, (state, tr0))
-        state = _migrate(state, n_islands, L)
-        if trace_mode != "full":
+        state, trace, qops = lax.fori_loop(0, n_gens, body,
+                                           (state, tr0, q0))
+        if quality:
+            state, mig = _migrate(state, n_islands, L, return_gain=True)
             trace = _compress_trace(jnp.transpose(trace, (1, 0, 2)),
-                                    n_gens, trace_mode)
+                                    n_gens,
+                                    effective_trace_mode(trace_mode,
+                                                         True),
+                                    cap=(max_gens if trace_mode ==
+                                         "full" else None))
+            trace = _append_quality(
+                trace, qops, mig, _div_rows(pa, _blocks(state, L, pop)))
         else:
-            # (max_gens, L, 2) -> (L, 1, max_gens, 2): island-major like
-            # the static runner's trace
-            trace = jnp.transpose(trace, (1, 0, 2))[:, None]
+            state = _migrate(state, n_islands, L)
+            if trace_mode != "full":
+                trace = _compress_trace(jnp.transpose(trace, (1, 0, 2)),
+                                        n_gens, trace_mode)
+            else:
+                # (max_gens, L, 2) -> (L, 1, max_gens, 2): island-major
+                # like the static runner's trace
+                trace = jnp.transpose(trace, (1, 0, 2))[:, None]
         best_local = jnp.min(_blocks(state, L, pop).penalty[:, 0])
         global_best = lax.pmin(best_local, AXIS)
         return state, trace, global_best
@@ -864,7 +1070,7 @@ def make_lane_init(mesh: Mesh, pop_size: int, cfg: ga.GAConfig,
 
 def make_lane_runner(mesh: Mesh, cfg: ga.GAConfig, max_gens: int,
                      n_lanes: int, donate: bool = False,
-                     trace_mode: str = "full"):
+                     trace_mode: str = "full", quality: bool = False):
     """The serve dispatch program:
     `run(pa_l, seeds, chunks, state, gens) -> (state, trace)`.
 
@@ -882,7 +1088,11 @@ def make_lane_runner(mesh: Mesh, cfg: ga.GAConfig, max_gens: int,
               trace_mode "deltas"/"stats" ships the packed (n_lanes,
               trace_leaf_width(max_gens, mode)) leaf instead
               (_compress_trace, per-lane gens as the valid mask) — the
-              serve path's telemetry shrinks exactly like the engine's
+              serve path's telemetry shrinks exactly like the engine's.
+              quality=True appends each lane's quality block (operator
+              counters masked to the lane's own executed generations;
+              migration gain 0 — lanes never migrate; diversity from
+              the lane's final population under its OWN event mask)
 
     One compile serves every quantum size and every job mix of a
     bucket. Each device iterates to the max of ITS lanes' counts and
@@ -902,6 +1112,7 @@ def make_lane_runner(mesh: Mesh, cfg: ga.GAConfig, max_gens: int,
     def _run(pa_l, seeds, chunks, state, gens):
         sb = _blocks(state, L, pop)
         tr0 = jnp.full((L, max_gens, 2), _SENTINEL, jnp.int32)
+        q0 = jnp.zeros((L, obs_quality.N_OPS), jnp.int32)
         n_steps = jnp.max(gens)
 
         def lane_keys(seed, chunk):
@@ -910,24 +1121,47 @@ def make_lane_runner(mesh: Mesh, cfg: ga.GAConfig, max_gens: int,
         keys = jax.vmap(lane_keys)(seeds, chunks)
 
         def body(i, carry):
-            st, tr = carry
+            st, tr, q = carry
 
-            def one_lane(pa_i, k, b, g, tr_i):
-                b2 = ga.generation(pa_i, jax.random.fold_in(k, i), b,
-                                   cfg)
+            def one_lane(pa_i, k, b, g, tr_i, q_i):
+                if quality:
+                    b2, qg = ga.generation(pa_i, jax.random.fold_in(k, i),
+                                           b, cfg, with_quality=True)
+                else:
+                    b2 = ga.generation(pa_i, jax.random.fold_in(k, i), b,
+                                       cfg)
+                    qg = jnp.zeros((obs_quality.N_OPS,), jnp.int32)
                 keep = i < g
                 b = jax.tree.map(
                     lambda new, old: jnp.where(keep, new, old), b2, b)
+                # a masked (not-executed) generation must not count:
+                # the lane's stream is a pure function of its own
+                # progress, and so are its quality counters
+                q_i = q_i + jnp.where(keep, qg, 0)
                 row = jnp.stack([b.hcv[0], b.scv[0]])
                 tr_i = lax.dynamic_update_index_in_dim(
                     tr_i, jnp.where(keep, row, tr_i[i]), i, 0)
-                return b, tr_i
+                return b, tr_i, q_i
 
-            st, tr = jax.vmap(one_lane)(pa_l, keys, st, gens, tr)
-            return st, tr
+            st, tr, q = jax.vmap(one_lane)(pa_l, keys, st, gens, tr, q)
+            return st, tr, q
 
-        sb, trace = lax.fori_loop(0, n_steps, body, (sb, tr0))
-        if trace_mode != "full":
+        sb, trace, qops = lax.fori_loop(0, n_steps, body, (sb, tr0, q0))
+        if quality:
+            trace = _compress_trace(trace, gens,
+                                    effective_trace_mode(trace_mode,
+                                                         True),
+                                    cap=(max_gens if trace_mode ==
+                                         "full" else None))
+            div = jax.vmap(
+                lambda pa_i, s, p, v: _div_stats(pa_i.event_mask, s, p,
+                                                 v))(
+                pa_l, sb.slots, sb.penalty, sb.scv)
+            # lanes never migrate: the gain column ships zeros so the
+            # layout stays uniform with the island runners'
+            trace = _append_quality(trace, qops,
+                                    jnp.zeros((L,), jnp.int32), div)
+        elif trace_mode != "full":
             trace = _compress_trace(trace, gens, trace_mode)
         return _flat(sb), trace
 

@@ -203,6 +203,30 @@ class RunConfig:
     #                           /readyz — a daemon-thread listener that
     #                           shares nothing with the dispatch loop
     #                           but the registry lock (None = off)
+    # ---- search-quality observatory (tt-obs v4; obs/quality.py +
+    # parallel/islands.py quality runners, README "Search-quality
+    # observatory"): on-device diversity/operator/migration telemetry
+    # packed onto the telemetry leaf, decoded into the quality.*
+    # metrics namespace (+ qualityEntry records under --obs). The
+    # record stream is bit-identical with it on or off (modulo
+    # qualityEntry/timing records — tests/test_quality.py pins it).
+    quality: bool = False     # --quality enables the quality runners
+    stall_window: int = 8     # stall detector: consecutive dispatches
+    #                           with no new global best before the run
+    #                           counts as plateaued (0 disables the
+    #                           detector; active only under --quality)
+    stall_hamming: float = 0.05  # diversity-collapse threshold: the
+    #                           most-collapsed island's Hamming sample
+    #                           must sit at/below this for a plateau to
+    #                           count as a STALL (a diverse plateau may
+    #                           still recombine its way off)
+    auto_kick_on_stall: bool = False  # opt-in: a detected stall
+    #                           triggers the existing kick path
+    #                           (islands.make_kick_runner) — reseeds
+    #                           the worst half of every island from
+    #                           mutated elites, with the usual
+    #                           escalation ladder; disables pipelining
+    #                           (the kick is a control read)
     trace_profile: Optional[str] = None  # capture a jax.profiler trace of
     #                           one mid-run dispatch into this directory
     #                           (SURVEY section 5 tracing; view with
@@ -425,6 +449,8 @@ _FLAG_MAP = {
     "--trace-mode": ("trace_mode", str),
     "--metrics-every": ("metrics_every", int),
     "--obs-listen": ("obs_listen", str),
+    "--stall-window": ("stall_window", int),
+    "--stall-hamming": ("stall_hamming", float),
     "--max-recoveries": ("max_recoveries", int),
     "--fetch-timeout": ("fetch_timeout", float),
     "--faults": ("faults", str),
@@ -436,6 +462,8 @@ _FLAG_MAP = {
 _BOOL_FLAGS = {"--resume": "resume", "--nsga2": "nsga2",
                "--ls-full-eval": "ls_full_eval", "--trace": "trace",
                "--ls-converge": "ls_converge", "--obs": "obs",
+               "--quality": "quality",
+               "--auto-kick-on-stall": "auto_kick_on_stall",
                "--distributed": "distributed"}
 
 # device-side telemetry reduction modes (mirrors islands.TRACE_MODES —
@@ -548,6 +576,16 @@ def parse_args(argv) -> RunConfig:
     if cfg.mem_poll_every < 0:
         raise SystemExit("--mem-poll-every must be >= 0 seconds "
                          "(0 disables the device memory poller)")
+    if cfg.stall_window < 0:
+        raise SystemExit("--stall-window must be >= 0 dispatches "
+                         "(0 disables the stall detector)")
+    if not 0.0 <= cfg.stall_hamming <= 1.0:
+        raise SystemExit("--stall-hamming must be in [0, 1] (a Hamming "
+                         "sample mean is a fraction of differing slots)")
+    if cfg.auto_kick_on_stall and not cfg.quality:
+        raise SystemExit("--auto-kick-on-stall needs --quality (the "
+                         "stall detector reads the on-device diversity "
+                         "telemetry)")
     if cfg.coordinator is not None and (cfg.num_processes is None
                                         or cfg.process_id is None):
         raise SystemExit("--coordinator requires --num-processes and "
@@ -629,6 +667,13 @@ class ServeConfig:
     #                               snapshots on the record stream
     trace_mode: str = "full"      # lane-runner telemetry reduction
     #                               (full | deltas | stats)
+    quality: bool = False         # search-quality observatory on the
+    #                               lane runners: per-job operator
+    #                               efficacy + diversity telemetry
+    #                               (quality.* metrics; per-job
+    #                               qualityEntry records under --obs;
+    #                               the record stream is identical with
+    #                               it on or off)
     metrics_every: int = 10       # dispatches between metricsEntry
     #                               snapshots under --obs
     obs_listen: Optional[str] = None  # HOST:PORT pull front (/metrics
@@ -688,7 +733,7 @@ _SERVE_FLAG_MAP = {
     "--faults": ("faults", str),
 }
 
-_SERVE_BOOL_FLAGS = {"--obs": "obs"}
+_SERVE_BOOL_FLAGS = {"--obs": "obs", "--quality": "quality"}
 
 
 def _serve_usage() -> str:

@@ -108,6 +108,7 @@ import numpy as np
 
 from timetabling_ga_tpu.obs import cost as obs_cost
 from timetabling_ga_tpu.obs import metrics as obs_metrics
+from timetabling_ga_tpu.obs import quality as obs_quality
 from timetabling_ga_tpu.obs.spans import NULL_TRACER, SpanTracer
 from timetabling_ga_tpu.ops import ga
 from timetabling_ga_tpu.parallel import islands
@@ -180,7 +181,7 @@ def _clone(state):
 
 def cached_runner(mesh, gacfg: ga.GAConfig, n_epochs: int, gens: int,
                   sig, n_islands: int, donate: bool = False,
-                  trace_mode: str = "full"):
+                  trace_mode: str = "full", quality: bool = False):
     """Returns (runner, was_cached). was_cached=False means this
     (program, instance shape) pair is fresh, so its first call will pay
     an XLA compile. `donate` is part of the cache key (as in every
@@ -188,9 +189,12 @@ def cached_runner(mesh, gacfg: ga.GAConfig, n_epochs: int, gens: int,
     DIFFERENT executables, and colliding them would hand a
     buffer-deleting program to a caller that reuses its input.
     `trace_mode` likewise: full/deltas/stats runners return
-    differently-shaped telemetry leaves (islands._compress_trace)."""
+    differently-shaped telemetry leaves (islands._compress_trace), and
+    `quality` likewise: the quality observatory's runners append the
+    packed quality block to the leaf (README "Search-quality
+    observatory")."""
     k = (_mesh_key(mesh), gacfg, n_epochs, gens, sig, n_islands, donate,
-         trace_mode)
+         trace_mode, quality)
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
@@ -198,19 +202,21 @@ def cached_runner(mesh, gacfg: ga.GAConfig, n_epochs: int, gens: int,
         islands.make_island_runner(mesh, gacfg, n_epochs=n_epochs,
                                    gens_per_epoch=gens,
                                    n_islands=n_islands, donate=donate,
-                                   trace_mode=trace_mode), "runner")
+                                   trace_mode=trace_mode,
+                                   quality=quality), "runner")
     _RUNNER_CACHE[k] = r
     return r, False
 
 
 def cached_dynamic_runner(mesh, gacfg: ga.GAConfig, max_gens: int, sig,
                           n_islands: int, donate: bool = False,
-                          trace_mode: str = "full"):
+                          trace_mode: str = "full",
+                          quality: bool = False):
     """Tail-dispatch runner with a RUNTIME generation count (one compile
     serves every n_gens <= max_gens), used to spend the last slice of a
     wall-clock budget instead of idling through it."""
     k = ("dyn", _mesh_key(mesh), gacfg, max_gens, sig, n_islands, donate,
-         trace_mode)
+         trace_mode, quality)
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
@@ -218,7 +224,8 @@ def cached_dynamic_runner(mesh, gacfg: ga.GAConfig, max_gens: int, sig,
         islands.make_island_runner_dynamic(mesh, gacfg, max_gens,
                                            n_islands=n_islands,
                                            donate=donate,
-                                           trace_mode=trace_mode),
+                                           trace_mode=trace_mode,
+                                           quality=quality),
         "dyn_runner")
     _RUNNER_CACHE[k] = r
     return r, False
@@ -239,17 +246,17 @@ def cached_init(mesh, pop_size: int, gacfg: ga.GAConfig,
 
 def cached_lane_runner(mesh, gacfg: ga.GAConfig, max_gens: int,
                        n_lanes: int, donate: bool = False,
-                       trace_mode: str = "full"):
+                       trace_mode: str = "full", quality: bool = False):
     """Multi-tenant lane program (islands.make_lane_runner) for the
     serve scheduler: one compiled program per (mesh, config, quantum
     bound, lane count) serves EVERY job whose padded instance shares
     the bucket shape — the compile-cache key is the bucket, not the
     instance (serve/bucket.py). Lives in _RUNNER_CACHE so recovery's
     _purge_programs covers it like every other compiled program.
-    `trace_mode` is part of the key (different telemetry leaf shapes,
-    like cached_runner)."""
+    `trace_mode` and `quality` are part of the key (different telemetry
+    leaf shapes, like cached_runner)."""
     k = ("lane", _mesh_key(mesh), gacfg, max_gens, n_lanes, donate,
-         trace_mode)
+         trace_mode, quality)
     r = _RUNNER_CACHE.get(k)
     if r is not None:
         return r, True
@@ -260,7 +267,8 @@ def cached_lane_runner(mesh, gacfg: ga.GAConfig, max_gens: int,
     # the compile-hit rate bucket-affine routing steers on
     r = obs_cost.instrument(
         islands.make_lane_runner(mesh, gacfg, max_gens, n_lanes,
-                                 donate=donate, trace_mode=trace_mode),
+                                 donate=donate, trace_mode=trace_mode,
+                                 quality=quality),
         "lane_runner")
     _RUNNER_CACHE[k] = r
     return r, False
@@ -1028,7 +1036,7 @@ def precompile(cfg: RunConfig) -> None:
         # shape; executing that shape to measure it is the bug)
         dyn, _ = cached_dynamic_runner(mesh, g, cfg.migration_period,
                                        sig, n_islands, donate,
-                                       cfg.trace_mode)
+                                       cfg.trace_mode, cfg.quality)
         g_state, tr0, _ = dyn(pa, wk[4], g_state, 1)
         _fetch(tr0)
         spg_est = _SPG_CACHE.get(g_spg_key)
@@ -1050,7 +1058,7 @@ def precompile(cfg: RunConfig) -> None:
                 break
             runner, warm = cached_runner(mesh, g, n_ep, gens, sig,
                                          n_islands, donate,
-                                         cfg.trace_mode)
+                                         cfg.trace_mode, cfg.quality)
             g_state, tr2, _ = runner(pa, wk[5], g_state)
             _fetch(tr2)
             if not warm:
@@ -1428,6 +1436,13 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
     t0 = time.monotonic()
     mreg = obs_metrics.REGISTRY
     trace_mode = cfg.trace_mode
+    # search-quality observatory (README "Search-quality observatory"):
+    # the generation runners append the packed quality block to the
+    # telemetry leaf, and the leaf's EVENT half uses the effective
+    # packing (a full trace upgrades to deltas under quality —
+    # islands.effective_trace_mode; the record stream is unchanged)
+    quality = cfg.quality
+    ev_mode = islands.effective_trace_mode(trace_mode, quality)
     # stats mode also rides the polish runner: one extra stats row
     # carries the executed sweep-pass count (the same single fetch)
     with_passes = trace_mode == "stats"
@@ -1613,6 +1628,15 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
                 lahc_done = True
         sec_per_gen = _spg_for(cur_key, cur, gacfg, spg_key)
         time_stopped = False
+        # stall detector (quality observatory): fed once per retired
+        # dispatch with (control best, most-collapsed island's Hamming
+        # diversity); drives engine.stalled, the /readyz `stalled`
+        # reason, faultEntry stall records, and --auto-kick-on-stall
+        stall_det = None
+        if quality and cfg.stall_window > 0:
+            stall_det = obs_quality.StallDetector(cfg.stall_window,
+                                                  cfg.stall_hamming)
+        mreg.gauge("engine.stalled").set(0.0)
         kick_stall = 0
         kick_best = min(best_seen)
         kick_streak = 0     # kicks since the last improvement: each one
@@ -1665,9 +1689,14 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
         # Checkpoints do run pipelined: the snapshot fetch is its own
         # fence (it blocks on the in-flight chunk), and the npz
         # serialization rides the writer thread.
+        # --auto-kick-on-stall makes the stall decision a CONTROL read
+        # (it picks whether the next dispatch is a kick program), so it
+        # serializes the loop exactly like a post config does; the
+        # detector WITHOUT auto-kick is pure telemetry and pipelines
         pipelined = bool(cfg.pipeline and gacfg_post is None
                          and jax.process_count() == 1
-                         and cfg.trace_profile is None)
+                         and cfg.trace_profile is None
+                         and not (quality and cfg.auto_kick_on_stall))
         # what the ladder restores to when it steps back to level 0
         # (maybe_relax): the run's CONFIGURED pipelining, not whatever
         # a degraded stretch left behind
@@ -1699,7 +1728,11 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
             tf0 = time.monotonic()
             trace = _fetch(trace_dev, tracer=tracer,
                            flow=flow or None)  # blocks on the dispatch
-            if dyn_gens is not None and trace_mode == "full":
+            # quality observatory: the trailing quality block comes off
+            # the fetched leaf first (numpy slice; the fetch stayed one
+            # leaf), the event half keeps the ev_mode layout
+            trace, qrows = islands.split_quality(trace, quality)
+            if dyn_gens is not None and ev_mode == "full":
                 # compressed leaves carry their own validity (sentinel
                 # event rows); only the full trace needs the tail slice
                 trace = trace[:, :, :dyn_gens]
@@ -1793,7 +1826,7 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
             # skipped on the full trace — the record stream is identical
             # across modes (tests/test_obs.py pins it).
             events, ev_counts, ev_moments = islands.trace_events(
-                trace, trace_mode)
+                trace, ev_mode)
             total = gens_run
             for i in range(n_islands):
                 for g, h, s in events[i]:
@@ -1831,6 +1864,25 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
                     float(ev_moments[:, 2].min()))
                 mreg.gauge("engine.trace_best_max").set(
                     float(ev_moments[:, 3].max()))
+            q_agg = None
+            if qrows is not None:
+                # search-quality telemetry: decode the packed block
+                # (numpy only — quality accounting stays ON DEVICE,
+                # tt-analyze TT604) into the quality.* namespace.
+                # Counters carry per-dispatch deltas, gauges the
+                # dispatch's cross-island diversity view; both land on
+                # /metrics with everything else, and --obs additionally
+                # emits the flat qualityEntry record.
+                q_agg = obs_quality.aggregate(obs_quality.decode_rows(
+                    qrows))
+                for name, v in q_agg["counters"].items():
+                    mreg.counter(name).inc(v)
+                for name, v in q_agg["gauges"].items():
+                    mreg.gauge(name).set(v)
+                if cfg.obs:
+                    jsonl.quality_entry(
+                        out, obs_quality.entry_payload(q_agg),
+                        ts=tracer.now(), dispatch=n_dispatch)
             tracer.record("process", td1, time.monotonic() - td1,
                           cat="engine", gens=gens_run, flow=flow)
             if profiler is not None:
@@ -1872,6 +1924,39 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
                     lahc_done = True
                     return
 
+            def _dispatch_kick() -> int:
+                """THE kick dispatch, shared by the post-phase stall
+                kick and the quality auto-kick (they differ only in
+                trigger condition and bookkeeping around this core):
+                reseed the worst half from mutated elites at the
+                escalating depth, fence, record, count. precompile
+                builds the program for the post-phase path; under
+                --no-precompile (or the auto-kick outside the post
+                phase) the first kick pays its XLA compile inside -t
+                like every other program in that mode. Returns the
+                depth used."""
+                nonlocal state, key, kick_streak
+                kicker, _kwarm = cached_kick_runner(
+                    mesh, cur, sig, n_islands, cfg.donate)
+                n_moves = min(3 << kick_streak, islands.KICK_MAX_MOVES)
+                key, k_kick = jax.random.split(key)
+                t = time.monotonic()
+                faults.maybe_fail("dispatch")
+                state = kicker(pa, k_kick, state, n_moves)
+                _fetch(state.penalty)   # real fence for the phase
+                #                         record (see init above)
+                # context key is at_gen, NOT gens: `gens` on a phase
+                # record means generations EXECUTED by that phase
+                # (budget accounting sums it)
+                _phase(out, cfg.trace, "kick", trial,
+                       time.monotonic() - t, at_gen=gens_done,
+                       moves=n_moves)
+                tracer.record("kick", t, time.monotonic() - t,
+                              cat="device", moves=n_moves)
+                mreg.counter("engine.kicks").inc()
+                kick_streak += 1
+                return n_moves
+
             # stall kick (VERDICT round-4 next #5): in the post phase —
             # the scv-polish endgame where small seed 43 sat pinned on a
             # plateau for its whole budget — count consecutive dispatches
@@ -1900,31 +1985,45 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
                 do_kick, = _sync_vals(
                     kick_stall >= cfg.kick_stall and kick_fits)
                 if do_kick:
-                    # precompile builds this program (same enabling
-                    # condition); under --no-precompile the first kick
-                    # pays its XLA compile inside -t like every other
-                    # program in that mode
-                    kicker, _kwarm = cached_kick_runner(
-                        mesh, cur, sig, n_islands, cfg.donate)
-                    n_moves = min(3 << kick_streak,
-                                  islands.KICK_MAX_MOVES)
-                    key, k_kick = jax.random.split(key)
-                    t = time.monotonic()
-                    faults.maybe_fail("dispatch")
-                    state = kicker(pa, k_kick, state, n_moves)
-                    _fetch(state.penalty)   # real fence for the phase
-                    #                         record (see init above)
-                    # context key is at_gen, NOT gens: `gens` on a
-                    # phase record means generations EXECUTED by
-                    # that phase (budget accounting sums it)
-                    _phase(out, cfg.trace, "kick", trial,
-                           time.monotonic() - t, at_gen=gens_done,
-                           moves=n_moves)
-                    tracer.record("kick", t, time.monotonic() - t,
-                                  cat="device", moves=n_moves)
-                    mreg.counter("engine.kicks").inc()
+                    _dispatch_kick()
                     kick_stall = 0
-                    kick_streak += 1
+
+            # stall detector (quality observatory): a plateau of
+            # cfg.stall_window dispatches with the most-collapsed
+            # island's Hamming diversity at/below cfg.stall_hamming is
+            # a STALL — surfaced via the engine.stalled gauge (a
+            # /readyz `stalled` reason, obs/http.py) and a faultEntry
+            # record. --auto-kick-on-stall additionally fires the
+            # existing kick path — a CONTROL decision, so pipelining
+            # is off whenever the flag is set (see `pipelined`).
+            if stall_det is not None and q_agg is not None:
+                hmin = q_agg["gauges"]["quality.diversity.hamming_min"]
+                was_stalled = stall_det.stalled
+                stalled = stall_det.update(min(best_seen), hmin)
+                mreg.gauge("engine.stalled").set(1.0 if stalled else 0.0)
+                if stalled and not was_stalled:
+                    jsonl.fault_entry(
+                        out, "quality", "stall",
+                        f"no new best for {stall_det.streak} dispatches "
+                        f"with diversity {hmin:.4f} <= "
+                        f"{cfg.stall_hamming}", trial, sup.recoveries,
+                        sup.level, time.monotonic() - t_try,
+                        streak=stall_det.streak, hamming=round(hmin, 6))
+                if (stalled and cfg.auto_kick_on_stall
+                        and cur.pop_size >= 2):
+                    kick_fits = (cfg.time_limit - reserve
+                                 - (time.monotonic() - t_try)) > 0
+                    do_kick, = _sync_vals(kick_fits)
+                    if do_kick:
+                        n_moves = _dispatch_kick()
+                        jsonl.fault_entry(
+                            out, "quality", "kick", "stall auto-kick",
+                            trial, sup.recoveries, sup.level,
+                            time.monotonic() - t_try, moves=n_moves)
+                        # the kick re-diversified the population: the
+                        # stall evidence is stale, re-arm the window
+                        stall_det.reset()
+                        mreg.gauge("engine.stalled").set(0.0)
 
             if (cfg.checkpoint
                     and epochs_done - epochs_at_ckpt
@@ -1958,11 +2057,14 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
                     # stays untouched so the chunk's logEntries still
                     # emit normally when it retires.
                     tr_in = _fetch(inflight.trace)
+                    # the snapshot keeps the EVENT half only (the
+                    # quality block is per-dispatch telemetry a replay
+                    # would double-count)
+                    tr_in, _ = islands.split_quality(tr_in, quality)
                     if (inflight.dyn_gens is not None
-                            and trace_mode == "full"):
+                            and ev_mode == "full"):
                         tr_in = tr_in[:, :, :inflight.dyn_gens]
-                    ev_in, _, _ = islands.trace_events(tr_in,
-                                                       trace_mode)
+                    ev_in, _, _ = islands.trace_events(tr_in, ev_mode)
                     for i in range(n_islands):
                         for _g, h, s in ev_in[i]:
                             bs[i] = min(bs[i],
@@ -2163,13 +2265,13 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
                     if dyn_gens is not None:
                         runner, warm = cached_dynamic_runner(
                             mesh, cur, cfg.migration_period, sig, n_islands,
-                            cfg.donate, trace_mode)
+                            cfg.donate, trace_mode, quality)
                         args = (pa, k_epoch, state, dyn_gens)
                         gens_run = dyn_gens
                     else:
                         runner, warm = cached_runner(mesh, cur, n_ep, gens,
                                                      sig, n_islands, cfg.donate,
-                                                     trace_mode)
+                                                     trace_mode, quality)
                         args = (pa, k_epoch, state)
                         gens_run = n_ep * gens
                     # fault-injection point (runtime/faults.py `dispatch`
@@ -2378,7 +2480,7 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
                     # resuming — emitted-floor gating keeps records the
                     # pre-failure stream already carries from repeating
                     ev_fl, _, _ = islands.trace_events(
-                        snap.inflight_trace, trace_mode)
+                        snap.inflight_trace, ev_mode)
                     tnow = time.monotonic() - t_try
                     for i in range(n_islands):
                         for _g, h, s in ev_fl[i]:

@@ -376,6 +376,32 @@ def metrics_entry(stream: IO, snapshot: dict, ts=None) -> None:
     _write(stream, {"metricsEntry": rec})
 
 
+def quality_entry(stream: IO, payload: dict, ts=None,
+                  job: Optional[str] = None, **extra) -> None:
+    """Observability EXTENSION record (tt-obs search-quality
+    observatory, obs/quality.py; emitted only under --obs with
+    --quality): one decoded quality block per retired dispatch —
+
+      {"qualityEntry":{"quality.diversity.hamming":0.41,
+                       "quality.ops.crossover_wins":3, ...,
+                       "ts":5.2[,"job":"j42"]}}
+
+    Engine entries carry the run-wide cross-island aggregate
+    (obs_quality.entry_payload); serve entries carry one LANE's flat
+    payload tagged with its job id (obs_quality.lane_payload). Search
+    telemetry, not protocol output: strip_timing drops the whole record
+    (like spanEntry), which is what keeps the quality observatory's
+    on/off A/B in the byte-identity domain."""
+    rec = dict(payload)
+    if job is not None:
+        rec["job"] = str(job)
+    if ts is not None:
+        rec["ts"] = round(max(0.0, float(ts)), 6)
+    for k, v in extra.items():
+        rec[k] = v
+    _write(stream, {"qualityEntry": rec})
+
+
 def cost_entry(stream: IO, program: str, **extra) -> None:
     """Observability EXTENSION record (tt-obs cost observatory,
     obs/cost.py; emitted only when a run's observatory has a bound
@@ -422,11 +448,14 @@ TIMING_FIELDS = {"logEntry": ("time",), "solution": ("totalTime",),
 
 # record types that are timing through and through — the determinism
 # A/Bs drop them entirely rather than field-stripping them. phase and
-# the obs records (spanEntry/metricsEntry/costEntry) are wall-clock
-# measurements; faultEntry is excluded by the fault-recovery contract
-# (a recovered run matches an uninjected one MODULO fault records).
+# the obs records (spanEntry/metricsEntry/costEntry/qualityEntry) are
+# wall-clock measurements or observer-only telemetry; faultEntry is
+# excluded by the fault-recovery contract (a recovered run matches an
+# uninjected one MODULO fault records), and qualityEntry by the quality
+# observatory's (streams identical with it on or off MODULO
+# qualityEntry/timing records — tests/test_quality.py).
 TIMING_RECORDS = ("phase", "faultEntry", "spanEntry", "metricsEntry",
-                  "costEntry")
+                  "costEntry", "qualityEntry")
 
 
 def strip_timing(records: List[dict]) -> List[dict]:

@@ -55,6 +55,7 @@ import time
 import numpy as np
 
 from timetabling_ga_tpu.obs import metrics as obs_metrics
+from timetabling_ga_tpu.obs import quality as obs_quality
 from timetabling_ga_tpu.obs.spans import NULL_TRACER
 from timetabling_ga_tpu.ops import ga
 from timetabling_ga_tpu.parallel import islands
@@ -293,7 +294,8 @@ class Scheduler:
                               flow=flows, gens=int(gens.sum())):
             runner, _ = engine.cached_lane_runner(
                 self.mesh, self.gacfg, self.cfg.quantum, lanes,
-                donate=True, trace_mode=self.cfg.trace_mode)
+                donate=True, trace_mode=self.cfg.trace_mode,
+                quality=self.cfg.quality)
             tq0 = self._now()
             state, trace = runner(pa_stack, seeds, chunks, state, gens)
             trace = np.asarray(trace)   # (lanes, quantum, 2) | packed
@@ -311,12 +313,19 @@ class Scheduler:
         with self.tracer.span("park", cat="serve", job=jids,
                               flow=flows):
             host = engine.fetch_state(state)
+            # quality observatory: split the trailing quality block off
+            # the fetched leaf, then decode events with the effective
+            # packing (a full trace upgrades to deltas under quality —
+            # stream-identical, the established trace-mode contract)
+            trace, qrows = islands.split_quality(trace,
+                                                 self.cfg.quality)
             # the telemetry decode shared with the engine: full traces
             # list every executed generation, compressed leaves the
             # pre-selected improvement events — the per-job emitted
             # floor below makes the record stream identical either way
             events, ev_counts, _ = islands.trace_events(
-                trace, self.cfg.trace_mode)
+                trace, islands.effective_trace_mode(
+                    self.cfg.trace_mode, self.cfg.quality))
             if ev_counts is not None:
                 # same overflow surfacing as the engine: the count says
                 # how many improvements happened on device, the event
@@ -334,6 +343,19 @@ class Scheduler:
                               f" improvement event(s) this dispatch "
                               f"(cap {islands.TRACE_DELTAS_CAP}; raise "
                               f"TT_TRACE_DELTAS_CAP)", file=sys.stderr)
+            q_dec = None
+            if qrows is not None:
+                # decode only the lanes that carried real jobs: filler
+                # lanes hold INT_MAX padding whose "diversity" means
+                # nothing. Per-job qualityEntry records go out under
+                # --obs; the cross-lane aggregate feeds the same
+                # quality.* registry families the engine uses.
+                q_dec = obs_quality.decode_rows(qrows[:len(jobs)])
+                q_agg = obs_quality.aggregate(q_dec)
+                for name, v in q_agg["counters"].items():
+                    self._metrics.counter(name).inc(v)
+                for name, v in q_agg["gauges"].items():
+                    self._metrics.gauge(name).set(v)
             now = self._now()
             for lane, job in enumerate(jobs):
                 job.snapshot = _slice_state(host, lane, pop)
@@ -348,6 +370,11 @@ class Scheduler:
                         jsonl.log_entry(self.out, 0, 0, rep,
                                         now - job.submitted_t,
                                         job=job.id)
+                if q_dec is not None and self.cfg.obs:
+                    jsonl.quality_entry(
+                        self.out, obs_quality.lane_payload(q_dec, lane),
+                        ts=self.tracer.now(), job=job.id,
+                        gens=int(gens[lane]))
                 job.state = JobState.PARKED
                 if job.remaining() == 0:
                     self._finalize(job)

@@ -1,0 +1,208 @@
+"""Perf-history trajectory report: BENCH_r*.json / MULTICHIP_r*.json
+-> one table.
+
+Each PR round leaves a `BENCH_r0N.json` (the driver's capture of
+`python bench.py`: {"n", "cmd", "rc", "tail", "parsed"}) and a
+`MULTICHIP_r0N.json` in the repo root. The perf history is currently
+unreadable without hand-diffing five of them — worse, the captures are
+imperfect: `parsed` is often null and `tail` keeps only the LAST ~2000
+characters of stdout, which can clip the head off the final JSON line.
+This tool salvages what each round actually recorded:
+
+  1. `parsed` when the driver managed to parse the bench JSON;
+  2. else the largest JSON object decodable from `tail` (scanning
+     forward from each '{' — survives a head-clipped tail whose final
+     legs are intact);
+  3. else regex extraction of the known metric keys from the raw text
+     (`"gens_per_sec": 12.3` fragments survive any truncation).
+
+Output: a markdown trajectory table per metric family (throughput,
+dispatch pipeline host gap, serve soak, compile-hit rate), one row per
+round, plus the multichip dry-run status — the at-a-glance answer to
+"did round N regress round N-1".
+
+    python tools/bench_report.py               # tables on stdout
+    python tools/bench_report.py --json        # raw extracted dicts
+
+Stdlib-only and device-free: reading the history must work anywhere
+the repo is checked out.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (column header, leg, key). leg=None: the key is globally unique in
+# the bench JSON, searched flat over whatever text survived the tail
+# truncation. leg="<name>": the key appears under SEVERAL legs
+# (gen_per_sec is emitted by both generation_scan and
+# generation_parallel), so the lookup is scoped to that leg's object —
+# a flat first-match would source the column from whichever leg
+# survived a given round's truncation, silently comparing different
+# configurations across rounds.
+_METRICS = [
+    ("gens/s scan", "generation_scan", "gen_per_sec"),
+    ("gens/s parallel", "generation_parallel", "gen_per_sec"),
+    ("ms/gen sweep128", "generation_sweep_128", "ms_per_gen"),
+    ("host gap ms/gen serial", None, "host_gap_ms_per_gen_serial"),
+    ("host gap ms/gen piped", None, "host_gap_ms_per_gen_pipelined"),
+    ("loop speedup", None, "loop_speedup"),
+    ("soak jobs/min", None, "jobs_per_min"),
+    ("soak p50 s", None, "p50_latency_s"),
+    ("soak p99 s", None, "p99_latency_s"),
+    ("compile-hit rate", None, "compile_hit_rate"),
+    ("shed rate", None, "shed_rate"),
+    ("obs ms/dispatch", None, "obs_overhead_ms_per_dispatch"),
+    ("quality ms/dispatch", None, "quality_overhead_ms_per_dispatch"),
+    ("achieved TFLOPS", None, "achieved_tflops"),
+]
+
+_NUM = r"(-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)"
+
+
+def _decode_tail_json(tail: str):
+    """Largest decodable JSON object in a (possibly head-clipped)
+    tail: try json.loads from every '{' (earliest first — the
+    outermost surviving object wins)."""
+    for m in re.finditer(r"\{", tail):
+        chunk = tail[m.start():]
+        try:
+            obj = json.loads(chunk)
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            return obj
+    return None
+
+
+def _flatten(obj, out=None):
+    out = {} if out is None else out
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            if isinstance(v, (dict, list)):
+                _flatten(v, out)
+            elif isinstance(v, (int, float)) and k not in out:
+                out[k] = v
+    elif isinstance(obj, list):
+        for v in obj:
+            _flatten(v, out)
+    return out
+
+
+def _metric(doc, text: str, leg, key):
+    """One metric's value for a round: the decoded JSON when it
+    survived, else a regex over the raw text. Leg-scoped lookups search
+    only inside that leg's object (both paths), so a truncated round
+    can never substitute another leg's same-named key."""
+    if leg is None:
+        if isinstance(doc, dict):
+            flat = _flatten(doc)
+            if key in flat:
+                return float(flat[key])
+        m = re.search(rf'"{key}":\s*{_NUM}', text)
+        return float(m.group(1)) if m else None
+    if isinstance(doc, dict):
+        obj = doc.get(leg)
+        if obj is None and isinstance(doc.get("extra"), dict):
+            obj = doc["extra"].get(leg)
+        if isinstance(obj, dict) and key in obj:
+            return float(obj[key])
+    m = re.search(rf'"{leg}":\s*\{{[^}}]*"{key}":\s*{_NUM}', text)
+    return float(m.group(1)) if m else None
+
+
+def load_bench_round(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        cap = json.load(f)
+    tail = cap.get("tail") or ""
+    doc = cap.get("parsed")
+    if not isinstance(doc, dict):
+        doc = _decode_tail_json(tail)
+    metrics: dict = {}
+    for header, leg, key in _METRICS:
+        v = _metric(doc, tail, leg, key)
+        if v is not None:
+            metrics[header] = v
+    return {"round": cap.get("n"), "rc": cap.get("rc"),
+            "metrics": metrics,
+            "salvage": ("parsed" if isinstance(cap.get("parsed"), dict)
+                        else "tail-json" if isinstance(doc, dict)
+                        else "regex")}
+
+
+def load_multichip_round(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        cap = json.load(f)
+    tail = (cap.get("tail") or "").strip()
+    m = re.search(r"(?:global_)?best=(\d+)", tail)
+    g = re.search(r"gens=(\d+)", tail)
+    return {"round": int(re.search(r"_r0*(\d+)", path).group(1)),
+            "n_devices": cap.get("n_devices"), "ok": cap.get("ok"),
+            "best": int(m.group(1)) if m else None,
+            "gens": int(g.group(1)) if g else None}
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float) and v != int(v):
+        return f"{v:.3g}"
+    return str(int(v) if isinstance(v, float) else v)
+
+
+def report(root: str = REPO) -> str:
+    bench_paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    multi_paths = sorted(glob.glob(os.path.join(root,
+                                                "MULTICHIP_r*.json")))
+    rounds = [load_bench_round(p) for p in bench_paths]
+    multis = [load_multichip_round(p) for p in multi_paths]
+    lines = []
+    if rounds:
+        headers = ["round"] + [h for h, _, _ in _METRICS] + ["salvage"]
+        lines.append("| " + " | ".join(headers) + " |")
+        lines.append("|" + "---|" * len(headers))
+        for r in rounds:
+            row = [f"r{_fmt(r['round'])}"]
+            for header, _, _ in _METRICS:
+                row.append(_fmt(r["metrics"].get(header)))
+            row.append(r["salvage"])
+            lines.append("| " + " | ".join(row) + " |")
+    else:
+        lines.append("no BENCH_r*.json rounds found")
+    lines.append("")
+    if multis:
+        lines.append("| round | devices | multichip ok | best | gens |")
+        lines.append("|---|---|---|---|---|")
+        for m in multis:
+            lines.append(
+                f"| r{_fmt(m['round'])} | {_fmt(m['n_devices'])} | "
+                f"{'yes' if m['ok'] else 'NO'} | {_fmt(m['best'])} | "
+                f"{_fmt(m['gens'])} |")
+    return "\n".join(lines)
+
+
+def main(argv) -> int:
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    root = argv[0] if argv else REPO
+    if as_json:
+        rounds = [load_bench_round(p) for p in
+                  sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))]
+        multis = [load_multichip_round(p) for p in
+                  sorted(glob.glob(os.path.join(root,
+                                                "MULTICHIP_r*.json")))]
+        print(json.dumps({"bench": rounds, "multichip": multis},
+                         indent=2))
+    else:
+        print(report(root))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

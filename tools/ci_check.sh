@@ -45,6 +45,9 @@ if [ "${1:-}" = "--fast" ]; then
     step "cost observatory tests (tests/test_cost.py)"
     timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_cost.py -q -p no:cacheprovider || fail=1
+    step "search-quality observatory tests (tests/test_quality.py)"
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_quality.py -q -p no:cacheprovider || fail=1
     [ "$fail" -eq 0 ] && step "OK (fast mode: full test tier skipped)"
     exit $fail
 fi

@@ -33,12 +33,23 @@ N` requests N islands on the TPU side — N may exceed the device count
 parallel/islands.py local_islands). `--nsga2` switches the TPU side to
 the NSGA-II replacement stage.
 
+Quality-explained rows (ISSUE 9): `--quality` runs every TPU leg with
+the search-quality observatory on and attaches a "quality" dict to its
+row — diversity trend (Hamming first -> final), crossover/mutation win
+rates, sweep Move1/2/3 accepts, migration gain, and stall/kick counts —
+so a race result explains WHY one strategy won, not just that it did.
+Opt-IN deliberately: races are BUDGET-bound (generations=1e9 under -t),
+so the observatory's per-dispatch host cost buys fewer generations per
+budget — the telemetry is trajectory-identical per generation, but a
+quality row is not wall-clock-comparable against the pre-PR-9 history
+rows; flip the flag on both sides of a comparison.
+
 Usage:
   python tools/quality_race.py [--budget S] [--quick] [--seeds a,b,c]
       [--pop N] [--sweeps N] [--init-sweeps N] [--swap-block N]
       [--instances small,small-tight,...] [--no-cpu] [--no-tpu]
       [--cpu-budget-factor N] [--cpu-islands N] [--tpu-islands N]
-      [--nsga2]
+      [--nsga2] [--quality]
 """
 
 from __future__ import annotations
@@ -157,14 +168,20 @@ _TUNE_FIELDS = {"pop": "pop_size", "sweeps": "ls_sweeps",
 
 
 def tpu_config(tim_path: str, budget: float, seed: int, tune: dict,
-               n_events: int):
+               n_events: int, quality: bool = False):
     """Explicit --pop/--sweeps/... flags win; anything left unset takes
     the size-tuned solver defaults (RunConfig.apply_tuned_defaults, the
     production rule — so the race measures the SHIPPED configuration
-    unless the operator overrides it)."""
+    unless the operator overrides it). `quality` switches on the
+    search-quality observatory (+ --obs for the qualityEntry stream):
+    trajectory-identical per generation (tests/test_quality.py pins
+    it), but the per-dispatch host cost means a BUDGET-bound leg
+    completes fewer generations — see the module docstring on
+    comparability."""
     from timetabling_ga_tpu.runtime.config import RunConfig
     cfg = RunConfig(input=tim_path, seed=seed, islands=1,
-                    generations=10 ** 9, time_limit=budget)
+                    generations=10 ** 9, time_limit=budget,
+                    quality=quality, obs=quality)
     # tuned defaults FIRST, explicit flags after — the other order would
     # drop an explicit flag whose value coincides with the dataclass
     # default (apply_tuned_defaults cannot tell those apart)
@@ -176,28 +193,66 @@ def tpu_config(tim_path: str, budget: float, seed: int, tune: dict,
 
 
 def warm_tpu(tim_path: str, budget: float, seed: int, tune: dict,
-             n_events: int):
+             n_events: int, quality: bool = False):
     """Compile + measure outside the budget via engine.precompile: every
     program a timed run can dispatch (init, epoch runner, dynamic tail
     runner) lands in the module-level caches, and the seconds-per-
     generation estimate is seeded from a clean post-compile dispatch."""
     from timetabling_ga_tpu.runtime import engine
-    engine.precompile(tpu_config(tim_path, budget, seed, tune, n_events))
+    engine.precompile(tpu_config(tim_path, budget, seed, tune, n_events,
+                                 quality))
+
+
+def _quality_summary(lines) -> dict:
+    """Per-strategy quality telemetry from the run's qualityEntry /
+    faultEntry stream — the WHY behind a race row's final penalty
+    (ROADMAP item 5): did diversity collapse, which operators earned
+    their cycles, did migration move anything, how long was the run
+    stalled."""
+    from timetabling_ga_tpu.obs.quality import (entry_total,
+                                                entry_win_rate)
+    qes = [x["qualityEntry"] for x in lines if "qualityEntry" in x]
+    stalls = [x["faultEntry"] for x in lines
+              if x.get("faultEntry", {}).get("site") == "quality"]
+    if not qes:
+        return {}
+    first, last = qes[0], qes[-1]
+    return {
+        "hamming_first": first.get("quality.diversity.hamming"),
+        "hamming_final": last.get("quality.diversity.hamming"),
+        "crossover_win_rate": entry_win_rate(
+            qes, "quality.ops.crossover_wins",
+            "quality.ops.crossover_attempts"),
+        "mutation_win_rate": entry_win_rate(
+            qes, "quality.ops.mutation_wins",
+            "quality.ops.mutation_attempts"),
+        "sweep_accepts": [entry_total(qes, "quality.ops.move1_accepts"),
+                          entry_total(qes, "quality.ops.move2_accepts"),
+                          entry_total(qes, "quality.ops.move3_accepts")],
+        "migration_gain": entry_total(qes, "quality.migration.gain"),
+        "stall_events": sum(1 for f in stalls
+                            if f.get("action") == "stall"),
+        "kick_events": sum(1 for f in stalls
+                           if f.get("action") == "kick"),
+    }
 
 
 def run_tpu(tim_path: str, budget: float, seed: int, tune: dict,
-            n_events: int) -> dict:
+            n_events: int, quality: bool = False) -> dict:
     from timetabling_ga_tpu.runtime import engine
-    cfg = tpu_config(tim_path, budget, seed, tune, n_events)
+    cfg = tpu_config(tim_path, budget, seed, tune, n_events, quality)
     buf = io.StringIO()
     t0 = time.perf_counter()
     best = engine.run(cfg, out=buf)
     dt = time.perf_counter() - t0
     lines = [json.loads(x) for x in buf.getvalue().splitlines()]
     used = {k: getattr(cfg, field) for k, field in _TUNE_FIELDS.items()}
-    return {"best": best, "feasible": best < 1_000_000,
-            "time_to_feasible_s": _first_feasible_time(lines),
-            "wall_s": round(dt, 1), **used}
+    row = {"best": best, "feasible": best < 1_000_000,
+           "time_to_feasible_s": _first_feasible_time(lines),
+           "wall_s": round(dt, 1), **used}
+    if quality:
+        row["quality"] = _quality_summary(lines)
+    return row
 
 
 def _tpu_retry(fn, *args):
@@ -250,6 +305,10 @@ def main():
     }
     do_cpu = "--no-cpu" not in argv
     do_tpu = "--no-tpu" not in argv
+    # per-strategy quality telemetry, opt-IN (module docstring: the
+    # observatory's host cost buys fewer generations per wall-clock
+    # budget, so quality rows are not comparable to non-quality ones)
+    quality = "--quality" in argv
     cpu_factor = opt("--cpu-budget-factor", 1.0)
     cpu_islands = opt("--cpu-islands", 1, int)
     cpu_clock = opt("--cpu-clock", None, str)
@@ -263,7 +322,7 @@ def main():
             tim_path = fh.name
         if do_tpu:
             _tpu_retry(warm_tpu, tim_path, budget, seeds[0], tune,
-                       problem.n_events)
+                       problem.n_events, quality)
         for seed in seeds:
             cpu = (run_cpu_baseline(tim_path, budget, seed,
                                     factor=cpu_factor,
@@ -271,7 +330,8 @@ def main():
                                     clock=cpu_clock)
                    if do_cpu else None)
             tpu = (_tpu_retry(run_tpu, tim_path, budget, seed, tune,
-                              problem.n_events) if do_tpu else None)
+                              problem.n_events, quality)
+                   if do_tpu else None)
             row = {"instance": name, "budget_s": budget, "seed": seed,
                    "cpu_budget_factor": cpu_factor,
                    "cpu": cpu, "tpu": tpu}
