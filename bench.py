@@ -894,6 +894,173 @@ def measure_soak() -> dict:
     return out
 
 
+def measure_fleet() -> dict:
+    """extra.fleet leg (ISSUE 10): the same-seed mixed-bucket job
+    stream through the fleet gateway against 1 routed replica vs 2,
+    reporting the routing story's numbers:
+
+      jobs/min (1 vs 2)    end-to-end completion rate at the gateway
+      p50/p99 latency      submit-to-settled per job (includes the
+                           gateway's poll cadence — the HONEST e2e
+                           number a tenant sees)
+      affinity hit rate    fraction of post-warm-up routings that
+                           landed where the bucket was already warm
+                           (fleet/router.py hit_rate)
+      records identical    every routed job's record stream (modulo
+                           timing fields) bit-equal to the SAME job
+                           solved on a bare unrouted SolveService —
+                           the failover/packing-neutrality contract
+
+    In-process replicas with private registries (the CPU test double
+    for N worker processes); the 1-replica run is the routed baseline,
+    so the delta isolates what the second replica buys."""
+    import io
+
+    from timetabling_ga_tpu.fleet.gateway import Gateway
+    from timetabling_ga_tpu.fleet.replicas import (
+        http_json, in_process_replica)
+    from timetabling_ga_tpu.problem import dump_tim, random_instance
+    from timetabling_ga_tpu.runtime import jsonl
+    from timetabling_ga_tpu.runtime.config import FleetConfig, ServeConfig
+    from timetabling_ga_tpu.serve.service import SolveService
+
+    # two shape buckets (E<=32 and E<=64 with the default floors),
+    # interleaved — mixed traffic that exercises per-bucket pinning
+    shapes = [(28, 3, 24), (52, 5, 40), (25, 3, 20), (60, 6, 44),
+              (30, 3, 28), (56, 5, 42), (26, 3, 22), (62, 6, 46),
+              (29, 3, 26), (58, 5, 44)]
+    problems = [random_instance(5000 + i, n_events=e, n_rooms=r,
+                                n_features=4, n_students=s,
+                                attend_prob=0.08)
+                for i, (e, r, s) in enumerate(shapes)]
+    tims = [dump_tim(p) for p in problems]
+    gens = 40
+
+    def serve_cfg():
+        return ServeConfig(backend="cpu", lanes=2, quantum=10,
+                           pop_size=6, max_steps=16,
+                           http="127.0.0.1:0")
+
+    def run_fleet(n_replicas):
+        reps, handles = [], []
+        for r in range(n_replicas):
+            rep, handle = in_process_replica(serve_cfg(), f"b{r}")
+            reps.append(rep)
+            handles.append(handle)
+        fcfg = FleetConfig(listen="127.0.0.1:0",
+                           replicas=[h.url for h in handles],
+                           probe_every=0.1, poll_every=0.05)
+        gw = Gateway(fcfg, handles).start()
+
+        def settled():
+            deadline = time.perf_counter() + 600
+            while time.perf_counter() < deadline:
+                with gw.jobs_lock:
+                    if gw.jobs and all(
+                            j.terminal() and j.records_final
+                            for j in gw.jobs.values()):
+                        return
+                time.sleep(0.05)
+
+        # warm-up: one tiny job per bucket pays each bucket's compile
+        # on whichever replica the router pins it to, BEFORE the
+        # clock starts — the timed stream then measures routed solve
+        # throughput, not compile order (the affinity pins from the
+        # warm-up are exactly what the timed jobs ride)
+        for w, tim in enumerate(tims[:2]):
+            http_json("POST", gw.url + "/v1/solve",
+                      {"tim": tim, "id": f"warm{w}", "seed": 900 + w,
+                       "generations": 2})
+        settled()
+        t0 = time.perf_counter()
+        for i, tim in enumerate(tims):
+            http_json("POST", gw.url + "/v1/solve",
+                      {"tim": tim, "id": f"f{i}", "seed": i,
+                       "generations": gens})
+        settled()
+        wall = time.perf_counter() - t0
+        with gw.jobs_lock:
+            timed = [j for j in gw.jobs.values()
+                     if j.id.startswith("f")]     # warm-ups excluded
+            lats = sorted(j.finished_t - j.submitted_t
+                          for j in timed if j.finished_t is not None)
+            records = {j.id: jsonl.strip_timing(j.records)
+                       for j in timed}
+            states = {j.id: j.state for j in timed}
+        stats = gw.router.stats()
+        gw.request_drain()
+        gw.drained.wait(60)
+        gw.close()
+        for rep in reps:
+            rep.stop()
+        return wall, lats, stats, records, states
+
+    wall2, lat2, stats2, recs2, states2 = run_fleet(2)
+    wall1, lat1, stats1, recs1, states1 = run_fleet(1)
+
+    # unrouted baseline: the same jobs (same ids, seeds, budgets,
+    # serve shape) on a bare SolveService — per-job streams must match
+    buf = io.StringIO()
+    svc = SolveService(ServeConfig(backend="cpu", lanes=2, quantum=10,
+                                   pop_size=6, max_steps=16), out=buf)
+    for i, p in enumerate(problems):
+        svc.submit(p, job_id=f"f{i}", seed=i, generations=gens)
+    svc.drive()
+    svc.close()
+    base: dict = {}
+    for line in buf.getvalue().splitlines():
+        rec = json.loads(line)
+        kind = next(iter(rec))
+        job = rec[kind].get("job") if isinstance(rec[kind], dict) \
+            else None
+        if job is not None:
+            base.setdefault(job, []).append(rec)
+    base = {j: jsonl.strip_timing(rs) for j, rs in base.items()}
+    identical = all(recs2.get(j) == base.get(j)
+                    and recs1.get(j) == base.get(j) for j in base)
+
+    def pct(vals, q):
+        if not vals:
+            return None     # no finished job: report, don't crash
+        return round(vals[min(len(vals) - 1, int(q * len(vals)))], 3)
+
+    out = {
+        "jobs": len(problems),
+        "generations_per_job": gens,
+        "jobs_done_2rep": sum(1 for s in states2.values()
+                              if s == "done"),
+        "jobs_done_1rep": sum(1 for s in states1.values()
+                              if s == "done"),
+        "jobs_per_min_2rep": round(len(problems) / wall2 * 60, 2),
+        "jobs_per_min_1rep": round(len(problems) / wall1 * 60, 2),
+        "fleet_speedup": round(wall1 / wall2, 2) if wall2 else 0.0,
+        "p50_latency_s_2rep": pct(lat2, 0.5),
+        "p99_latency_s_2rep": pct(lat2, 0.99),
+        "p50_latency_s_1rep": pct(lat1, 0.5),
+        "p99_latency_s_1rep": pct(lat1, 0.99),
+        "affinity_hit_rate": stats2["affinity_hit_rate"],
+        "affinity_hits": stats2["affinity_hits"],
+        "warmups": stats2["warmups"],
+        "records_identical": bool(identical),
+        "note": "2 in-process replicas (private registries) behind "
+                "the gateway vs 1, same-seed 2-bucket 10-job stream; "
+                "records_identical strips timing fields and compares "
+                "every routed job's stream to a bare unrouted "
+                "SolveService run of the same jobs. On a serial CPU "
+                "box the replicas share cores, so fleet_speedup "
+                "reflects scheduling overlap, not hardware scaling.",
+    }
+    if not identical:
+        out["error"] = "routed record stream diverged from unrouted"
+    print(f"# fleet: {out['jobs_per_min_2rep']} jobs/min @2rep vs "
+          f"{out['jobs_per_min_1rep']} @1rep (speedup "
+          f"{out['fleet_speedup']}), affinity "
+          f"{out['affinity_hit_rate']}, p50/p99 "
+          f"{out['p50_latency_s_2rep']}/{out['p99_latency_s_2rep']}s, "
+          f"records identical: {identical}", file=sys.stderr)
+    return out
+
+
 def measure_scrape() -> dict:
     """extra.scrape leg (ISSUE 6): the pull front's cost on a live
     serve stream.
@@ -1201,6 +1368,7 @@ def main() -> None:
             ("quality", lambda: measure_quality(problem)),
             ("serve", measure_serve),
             ("soak", measure_soak),
+            ("fleet", measure_fleet),
             ("scrape", measure_scrape),
             ("scale_2000ev", measure_scale),
             ("ls_shootout", lambda: measure_ls_shootout(problem)),

@@ -1,0 +1,47 @@
+"""TT605 fixture: device work / unbounded reads on fleet handler paths.
+
+Not imported or executed — parsed by tests/test_analysis.py (the test
+config adds this file to `fleet-modules`). The fleet front's design
+rule (fleet/gateway.py): HTTP handlers ENQUEUE and READ ONLY — the
+drive loop owns every device call, the dispatcher thread every piece
+of outbound I/O, and body reads are bounded by Content-Length.
+"""
+import http.server
+
+import jax
+
+
+class SolveFrontHandler(http.server.BaseHTTPRequestHandler):
+    def do_POST(self):
+        body = self.rfile.read()                     # EXPECT TT605
+        job = self.server.api.svc.submit(body)       # EXPECT TT605
+        self._solve_inline(job)
+
+    def _solve_inline(self, job):
+        # reachable via self._solve_inline() from do_POST — still the
+        # handler path
+        state = self.server.api.scheduler.step()     # EXPECT TT605
+        jax.block_until_ready(state)                 # EXPECT TT605
+        push_result(state)
+
+    def do_GET(self):
+        n = int(self.headers.get("Content-Length", 0))
+        chunk = self.rfile.read(n)                   # OK: bounded read
+        self._reply(chunk)
+
+    def _reply(self, body):
+        self.wfile.write(body)                       # OK: own socket
+
+
+def push_result(state):
+    # bare-name reachable from _solve_inline — still the handler path
+    arrs = state.problem.device_arrays()             # EXPECT TT605
+    return arrs
+
+
+def drive_loop_is_fine(svc):
+    # OK: not reachable from any handler — the DRIVE LOOP is exactly
+    # where dispatch entries and device materialization belong
+    while svc.queue.ready():
+        svc.step()
+        svc.scheduler.drive()

@@ -48,6 +48,7 @@ def fixture_config() -> AnalyzerConfig:
                                                          "viol_quality.py"]
     cfg.sharded_modules = (list(cfg.sharded_modules)
                            + ["viol_collective.py", "viol_quality.py"])
+    cfg.fleet_modules = list(cfg.fleet_modules) + ["viol_fleet.py"]
     return cfg
 
 
@@ -75,6 +76,8 @@ def analyze_fixture(fixture: str):
     "viol_quality.py",     # TT604 host-side quality recompute in
     #                        dispatch loops + collectives in quality
     #                        reduction paths
+    "viol_fleet.py",       # TT605 device work / unbounded socket
+    #                        reads on fleet handler paths
 ])
 def test_rule_fires_at_expected_lines(fixture):
     """Each rule family fires exactly at the marked (rule, line) pairs —

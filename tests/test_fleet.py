@@ -1,0 +1,662 @@
+"""tt-fleet (ISSUE 10): HTTP solve front, bucket-affine routing,
+failover, drain.
+
+The acceptance properties pinned here:
+
+  1. AFFINITY — a mixed-bucket stream against 2 routed replicas keeps
+     each bucket pinned to one replica (hit rate >= 0.9 after
+     warm-up) and spreads distinct buckets across the fleet;
+  2. FAILOVER — killing a replica mid-stream still completes every
+     submitted job exactly once;
+  3. RECORD IDENTITY — every routed job's record stream (modulo
+     timing fields) is bit-identical to the same job solved on a bare
+     unrouted SolveService;
+  4. ISOLATION — a wedged gateway accept loop or routing decision
+     (fault sites `gateway` / `route`) never stalls replica dispatch
+     or writer drain;
+  5. the /readyz wire contract the router parses: structured JSON
+     (`{"ready": bool, "reasons": [...]}`), content-type
+     application/json, with the `draining` / `no_ready_replica`
+     reasons.
+"""
+
+import io
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from timetabling_ga_tpu.fleet.client import main_submit
+from timetabling_ga_tpu.fleet.gateway import (
+    Gateway, parse_solve_body, payload_counts)
+from timetabling_ga_tpu.fleet.replicas import (
+    FleetHTTPError, JobTail, ReplicaHandle, ReplicaSet, http_json,
+    in_process_replica)
+from timetabling_ga_tpu.fleet.router import NoReplicaError, Router
+from timetabling_ga_tpu.obs import http as obs_http
+from timetabling_ga_tpu.obs.metrics import MetricsRegistry
+from timetabling_ga_tpu.problem import dump_tim, random_instance
+from timetabling_ga_tpu.runtime import faults, jsonl
+from timetabling_ga_tpu.runtime.config import (
+    FleetConfig, ServeConfig, parse_fleet_args)
+from timetabling_ga_tpu.serve.service import SolveService
+
+# bucket A: E<=32; bucket B: 32<E<=64 (default geometric floors)
+_SHAPE_A = dict(n_events=12, n_rooms=3, n_features=2, n_students=8,
+                attend_prob=0.2)
+_SHAPE_B = dict(n_events=40, n_rooms=4, n_features=2, n_students=30,
+                attend_prob=0.1)
+
+
+def _problem(seed, shape):
+    return random_instance(seed, **shape)
+
+
+def _serve_cfg(**kw):
+    kw.setdefault("backend", "cpu")
+    kw.setdefault("lanes", 2)
+    kw.setdefault("quantum", 5)
+    kw.setdefault("pop_size", 4)
+    kw.setdefault("max_steps", 8)
+    kw.setdefault("http", "127.0.0.1:0")
+    return ServeConfig(**kw)
+
+
+def _fleet_cfg(urls, **kw):
+    kw.setdefault("listen", "127.0.0.1:0")
+    kw.setdefault("probe_every", 0.1)
+    kw.setdefault("poll_every", 0.05)
+    kw.setdefault("dead_after", 2)
+    return FleetConfig(replicas=list(urls), **kw)
+
+
+def _wait_jobs(url, ids, timeout=120.0):
+    """Poll the front until every id is terminal with settled records;
+    returns {id: view}."""
+    from urllib.parse import quote
+    deadline = time.monotonic() + timeout
+    views = {}
+    while time.monotonic() < deadline:
+        views = {j: http_json("GET", f"{url}/v1/jobs/{quote(j)}",
+                              ok=(200,))
+                 for j in ids}
+        if all(v["state"] in ("done", "failed", "cancelled", "shed",
+                              "rejected") for v in views.values()):
+            return views
+        time.sleep(0.1)
+    raise AssertionError(
+        f"jobs not terminal after {timeout}s: "
+        f"{ {j: v['state'] for j, v in views.items()} }")
+
+
+def _unrouted_streams(jobs):
+    """{id: strip_timing(records)} for the SAME jobs on a bare
+    SolveService — the record-identity baseline."""
+    buf = io.StringIO()
+    svc = SolveService(ServeConfig(backend="cpu", lanes=2, quantum=5,
+                                   pop_size=4, max_steps=8), out=buf)
+    for job_id, problem, seed, gens in jobs:
+        svc.submit(problem, job_id=job_id, seed=seed,
+                   generations=gens)
+    svc.drive()
+    svc.close()
+    per_job: dict = {}
+    for line in buf.getvalue().splitlines():
+        rec = json.loads(line)
+        body = rec[next(iter(rec))]
+        if isinstance(body, dict) and body.get("job") is not None:
+            per_job.setdefault(body["job"], []).append(rec)
+    return {j: jsonl.strip_timing(rs) for j, rs in per_job.items()}
+
+
+# ------------------------------------------------------------- protocol
+
+
+def test_parse_solve_body_forms():
+    assert parse_solve_body(b'{"tim": "1 2 3 4", "seed": 7}') == {
+        "tim": "1 2 3 4", "seed": 7}
+    # raw .tim text
+    assert parse_solve_body(b"4 2 2 5\n10\n") == {"tim": "4 2 2 5\n10\n"}
+    # unknown JSON keys are dropped, not errors
+    assert "x" not in parse_solve_body(b'{"tim": "1 1 1 1", "x": 2}')
+    with pytest.raises(ValueError):
+        parse_solve_body(b"")
+    with pytest.raises(ValueError):
+        parse_solve_body(b'{"seed": 1}')       # neither tim nor problem
+    with pytest.raises(ValueError):
+        parse_solve_body(b'{"tim": ')          # bad JSON
+
+
+def test_payload_counts_header_only():
+    assert payload_counts({"tim": "12 3 2 8\nrest ignored"}) == (
+        12, 3, 2, 8, 5, 9)
+    assert payload_counts({"tim": "1 1 1 1", "n_days": 3,
+                           "slots_per_day": 4}) == (1, 1, 1, 1, 3, 4)
+    assert payload_counts({"problem": {
+        "n_events": 9, "n_rooms": 2, "n_features": 1,
+        "n_students": 5}}) == (9, 2, 1, 5, 5, 9)
+    with pytest.raises(ValueError):
+        payload_counts({"tim": "12 3"})        # short header
+    with pytest.raises(ValueError):
+        payload_counts({"tim": "a b c d"})
+
+
+def test_parse_fleet_args():
+    cfg = parse_fleet_args(["--listen", "127.0.0.1:0", "--replica",
+                            "http://a:1", "--replica", "http://b:2",
+                            "--probe-every", "0.2", "--",
+                            "--backend", "cpu", "--lanes", "4"])
+    assert cfg.replicas == ["http://a:1", "http://b:2"]
+    assert cfg.probe_every == 0.2
+    assert cfg.serve_args == ["--backend", "cpu", "--lanes", "4"]
+    with pytest.raises(SystemExit):
+        parse_fleet_args([])                   # no replicas
+    with pytest.raises(SystemExit):
+        parse_fleet_args(["--replica", "http://a:1", "--spawn", "2"])
+    with pytest.raises(SystemExit):            # bad worker flags
+        parse_fleet_args(["--spawn", "1", "--", "--bogus", "x"])
+    with pytest.raises(SystemExit):
+        parse_fleet_args(["--replica", "u", "--dead-after", "0"])
+
+
+# ------------------------------------------------------------ record tail
+
+
+def test_job_tail_tee_and_filter():
+    base = io.StringIO()
+    tail = JobTail(base, cap=3)
+    # chunked writes must reassemble into lines
+    tail.write('{"jobEntry": {"job": "a", "ev')
+    tail.write('ent": "admitted"}}\n{"logEntry": {"best": 1}}\n')
+    tail.write('{"logEntry": {"best": 2, "job": "a"}}\n')
+    for i in range(5):
+        tail.write(json.dumps(
+            {"logEntry": {"best": i, "job": "b"}}) + "\n")
+    assert base.getvalue().count("\n") == 8      # byte passthrough
+    assert [r[next(iter(r))].get("event", r[next(iter(r))].get("best"))
+            for r in tail.tail("a")] == ["admitted", 2]
+    assert len(tail.tail("b")) == 3              # capped
+    assert tail.tail("zzz") == []                # unknown job
+    # the untagged record reached the stream but no tail
+    assert '"best": 1' in base.getvalue()
+
+
+# ----------------------------------------------------------- router unit
+
+
+class _FakeHandle:
+    def __init__(self, name, depth=0.0, hits=0.0, count=0.0):
+        self.name = name
+        self.ready = True
+        self.dead = False
+        self.queue_depth = depth
+        self.compile_count = count
+        self.compile_cache_hits = hits
+
+    def compile_hit_rate(self):
+        total = self.compile_count + self.compile_cache_hits
+        return self.compile_cache_hits / total if total else 0.0
+
+
+class _FakeSet:
+    def __init__(self, handles):
+        self.handles = handles
+
+    def live(self):
+        return [h for h in self.handles if not h.dead]
+
+
+def test_router_affinity_and_scoring():
+    r0, r1 = _FakeHandle("r0"), _FakeHandle("r1")
+    router = Router(_FakeSet([r0, r1]))
+    ba, bb = ("A",), ("B",)
+    # first landing pins deterministically; repeats stay pinned
+    first = router.route(ba)
+    for _ in range(4):
+        assert router.route(ba) is first
+    # a second bucket spreads to the other replica (pin-count term)
+    second = router.route(bb)
+    assert second is not first
+    assert router.hit_rate() == 1.0
+    assert router.stats()["warmups"] == 2
+
+    # not-ready home: the job DETOURS (a miss) but the pin stays —
+    # the moment the home probes ready again the bucket returns to
+    # its warm programs as a hit
+    first.ready = False
+    moved = router.route(ba)
+    assert moved is second
+    assert router.stats()["misses"] == 1
+    assert router.stats()["repins"] == 0       # detour, not a repin
+    first.ready = True
+    back = router.route(ba)
+    assert back is first
+    assert router.stats()["misses"] == 1       # a warm hit, no churn
+
+    # backlog dominates placement of a FRESH bucket
+    second.queue_depth, first.queue_depth = 9.0, 0.0
+    assert router.route(("C",)) is first
+
+    # death: pins + warmth forgotten; survivors take over
+    second.dead = True
+    router.on_replica_dead(second.name)
+    assert router.route(bb) is first
+    # nothing live -> NoReplicaError
+    first.dead = True
+    with pytest.raises(NoReplicaError):
+        router.route(ba)
+
+
+def test_replica_set_boot_grace_and_restart():
+    """A replica that has NEVER probed OK stays alive through the
+    boot grace (a spawned worker pays a long jax import before it
+    binds its port); once the grace expires it dies — or respawns,
+    with its grace and probe state reset, until restarts run out."""
+    deaths = []
+
+    class _Proc:
+        def poll(self):
+            return None
+
+        def terminate(self):
+            pass
+
+        def wait(self, timeout=None):
+            return 0
+
+    # nothing listens on this port: every probe fails fast
+    h = ReplicaHandle("boot", "http://127.0.0.1:9")
+    rs = ReplicaSet([h], dead_after=1, boot_grace=60.0,
+                    probe_timeout=0.2,
+                    on_death=lambda hh, r: deaths.append((hh.name, r)))
+    rs.probe_all()
+    assert not h.dead and deaths == []         # booting, not dead
+    h.born -= 120.0                            # grace expired
+    rs.probe_all()
+    assert h.dead and deaths == [("boot", False)]
+
+    # a spawned handle respawns (probe state reset) then dies for good
+    deaths.clear()
+    h2 = ReplicaHandle("w", "http://127.0.0.1:9", proc=_Proc(),
+                       respawn=_Proc)
+    h2.ok_once = True                          # it HAD come up once
+    rs2 = ReplicaSet([h2], dead_after=1, boot_grace=60.0,
+                     probe_timeout=0.2, max_restarts=1,
+                     on_death=lambda hh, r: deaths.append(r))
+    rs2.probe_all()
+    assert deaths == [True] and not h2.dead    # respawned
+    assert h2.restarts == 1 and not h2.ok_once
+    h2.born -= 120.0                           # the respawn never
+    rs2.probe_all()                            # comes up either
+    assert h2.dead and deaths == [True, False]
+
+
+def test_router_compile_hit_rate_tie_break():
+    cold = _FakeHandle("cold", count=10.0, hits=0.0)
+    warm = _FakeHandle("warm", count=10.0, hits=90.0)
+    router = Router(_FakeSet([cold, warm]))
+    # equal depth, equal pins: the measured compile-hit rate decides
+    assert router.route(("N",)) is warm
+
+
+# ----------------------------------------------------- /readyz contract
+
+
+def test_readyz_structured_json_contract():
+    """Satellite: the router PARSES /readyz — body shape, content
+    type, and the status-code contract are wire-pinned here."""
+    reg = MetricsRegistry()
+    srv = obs_http.ObsServer("127.0.0.1:0", registry=reg).start()
+    try:
+        with urllib.request.urlopen(srv.url + "/readyz",
+                                    timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == "application/json"
+            body = json.loads(resp.read())
+        assert body["ready"] is True and body["reasons"] == []
+
+        # draining flips 503 with a parseable reason
+        reg.gauge("serve.draining").set(1.0)
+        try:
+            urllib.request.urlopen(srv.url + "/readyz", timeout=5)
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert e.headers["Content-Type"] == "application/json"
+            body = json.loads(e.read())
+        assert body["ready"] is False
+        assert "draining" in body["reasons"]
+    finally:
+        srv.close()
+
+
+def test_readyz_no_ready_replica_reason():
+    reg = MetricsRegistry()
+    reg.gauge("fleet.replicas_ready").set(0.0)
+    ok, detail = obs_http.readiness(reg)
+    assert not ok and "no_ready_replica" in detail["reasons"]
+    reg.gauge("fleet.replicas_ready").set(2.0)
+    ok, detail = obs_http.readiness(reg)
+    assert ok and detail["reasons"] == []
+
+
+# ------------------------------------------------------- replica front
+
+
+def test_replica_http_lifecycle():
+    """One in-process replica: solve, status, rejection, duplicate,
+    cancel, drain — all over the /v1 protocol, with /readyz flipping
+    to `draining` and the record stream drained on exit."""
+    rep, _ = in_process_replica(_serve_cfg(), "rx")
+    url = rep.url
+    try:
+        tim = dump_tim(_problem(0, _SHAPE_A))
+        acc = http_json("POST", url + "/v1/solve",
+                        {"tim": tim, "id": "ok1", "seed": 1,
+                         "generations": 10})
+        assert acc == {"id": "ok1", "state": "accepted"}
+        # duplicate id refused while the first lives
+        dup = http_json("POST", url + "/v1/solve",
+                        {"tim": tim, "id": "ok1"}, ok=(409,))
+        assert dup["error"] == "duplicate job id"
+        # a garbage instance is REJECTED by the drive loop, recorded,
+        # and the replica keeps serving
+        http_json("POST", url + "/v1/solve",
+                  {"tim": "9 9 9 9\nnot numbers at all"},
+                  ok=(202,))
+        # unknown job
+        with pytest.raises(FleetHTTPError):
+            http_json("GET", url + "/v1/jobs/nope", ok=(200,))
+        # a long job we cancel mid-flight
+        http_json("POST", url + "/v1/solve",
+                  {"tim": tim, "id": "long1", "seed": 2,
+                   "generations": 500})
+        http_json("DELETE", url + "/v1/jobs/long1", ok=(202,))
+        # an id with a quotable character round-trips (clients QUOTE
+        # the URL segment; the handler must unquote it back)
+        http_json("POST", url + "/v1/solve",
+                  {"tim": tim, "id": "sp 1", "seed": 6,
+                   "generations": 5})
+
+        views = _wait_jobs(url, ["ok1", "long1", "sp 1"])
+        assert views["sp 1"]["state"] == "done"
+        assert views["ok1"]["state"] == "done"
+        assert views["ok1"]["result"]["gens"] == 10
+        kinds = [next(iter(r)) for r in views["ok1"]["records"]]
+        assert "jobEntry" in kinds and "solution" in kinds
+        assert views["long1"]["state"] == "cancelled"
+
+        # drain: no new work, /readyz says so, loop exits, writer
+        # drained
+        http_json("POST", url + "/v1/drain", {}, ok=(200,))
+        assert rep.drained.wait(30)
+        rz = http_json("GET", url + "/readyz", ok=(503,))
+        assert "draining" in rz["reasons"]
+        refused = http_json("POST", url + "/v1/solve", {"tim": tim},
+                            ok=(503,))
+        assert refused["error"] == "draining"
+        assert not rep.svc.writer.alive()       # closed + drained
+        stream_events = [
+            json.loads(ln)["jobEntry"]["event"]
+            for ln in rep.tail._stream.getvalue().splitlines()
+            if "jobEntry" in json.loads(ln)]
+        assert "done" in stream_events
+        assert "rejected" in stream_events
+        assert "cancelled" in stream_events
+    finally:
+        rep.kill()
+
+
+# --------------------------------------------- acceptance: fleet e2e
+
+
+def test_fleet_acceptance_affinity_failover_record_identity():
+    """ISSUE 10 acceptance: gateway + 2 in-process replicas solve a
+    mixed-bucket stream with affinity >= 0.9 after warm-up; killing
+    one replica mid-stream still completes every job exactly once;
+    and every job's record stream is bit-identical to the same job
+    solved unrouted (modulo timing fields)."""
+    rep0, h0 = in_process_replica(_serve_cfg(), "r0")
+    rep1, h1 = in_process_replica(_serve_cfg(), "r1")
+    gw = Gateway(_fleet_cfg([h0.url, h1.url]), [h0, h1]).start()
+    jobs = []      # (id, problem, seed, gens) — the baseline replays
+    try:
+        # phase 1: interleaved 2-bucket stream
+        ids1 = []
+        for i in range(8):
+            shape = _SHAPE_A if i % 2 == 0 else _SHAPE_B
+            p = _problem(100 + i, shape)
+            jid = f"p1-{i}"
+            jobs.append((jid, p, i, 10))
+            ids1.append(jid)
+            http_json("POST", gw.url + "/v1/solve",
+                      {"tim": dump_tim(p), "id": jid, "seed": i,
+                       "generations": 10})
+        views1 = _wait_jobs(gw.url, ids1)
+        assert all(v["state"] == "done" for v in views1.values())
+        stats = gw.router.stats()
+        assert stats["affinity_hit_rate"] >= 0.9
+        # two buckets spread over two replicas, each pinned to one
+        assert len(stats["pins"]) == 2
+        assert sorted(stats["pins"].values()) == ["r0", "r1"]
+
+        # phase 2: longer jobs, then kill a replica MID-STREAM — the
+        # kill waits until a phase-2 job is observably in flight on
+        # r0, so failover is guaranteed to have real work to move
+        ids2 = []
+        gens2 = 200                         # 40 quanta: can't finish
+        #                                     inside the kill latency
+        for i in range(6):
+            shape = _SHAPE_A if i % 2 == 0 else _SHAPE_B
+            p = _problem(200 + i, shape)
+            jid = f"p2-{i}"
+            jobs.append((jid, p, 50 + i, gens2))
+            ids2.append(jid)
+            http_json("POST", gw.url + "/v1/solve",
+                      {"tim": dump_tim(p), "id": jid, "seed": 50 + i,
+                       "generations": gens2})
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with gw.jobs_lock:
+                inflight = [j for j in gw.jobs.values()
+                            if j.id.startswith("p2-")
+                            and j.replica == "r0"
+                            and not j.terminal()]
+            if inflight:
+                break
+            time.sleep(0.02)
+        assert inflight, "no phase-2 job ever in flight on r0"
+        rep0.kill()
+        views2 = _wait_jobs(gw.url, ids2, timeout=180)
+        assert all(v["state"] == "done" for v in views2.values())
+        # every job of BOTH phases completed exactly once: exactly
+        # one terminal jobEntry and one solution record per stream
+        all_views = {**views1, **_wait_jobs(gw.url, ids1 + ids2)}
+        for jid, view in all_views.items():
+            events = [r["jobEntry"]["event"] for r in view["records"]
+                      if "jobEntry" in r]
+            assert events.count("done") == 1, (jid, events)
+            assert sum(1 for r in view["records"]
+                       if "solution" in r) == 1
+        # record identity vs the bare unrouted service — including
+        # the jobs that failed over mid-flight
+        baseline = _unrouted_streams(jobs)
+        for jid, view in all_views.items():
+            assert jsonl.strip_timing(view["records"]) \
+                == baseline[jid], f"stream diverged for {jid}"
+        # the death was observed and failover engaged
+        assert gw.replicas.get("r0").dead
+        assert gw.registry.counter("fleet.jobs_failed_over").value \
+            >= 1
+    finally:
+        gw.request_drain()
+        gw.drained.wait(30)
+        gw.close()
+        rep0.kill()
+        rep1.kill()
+
+
+def test_cancel_survives_failover():
+    """A job cancelled while its replica is dying must NOT be
+    resurrected by failover onto the surviving replica: the 202 the
+    client got for its DELETE stays the truth."""
+    rep0, h0 = in_process_replica(_serve_cfg(), "c0")
+    rep1, h1 = in_process_replica(_serve_cfg(), "c1")
+    gw = Gateway(_fleet_cfg([h0.url, h1.url]), [h0, h1]).start()
+    try:
+        p = _problem(600, _SHAPE_A)
+        http_json("POST", gw.url + "/v1/solve",
+                  {"tim": dump_tim(p), "id": "cx", "seed": 1,
+                   "generations": 5000})   # cannot finish in time
+        deadline = time.monotonic() + 30
+        view = {}
+        while time.monotonic() < deadline:
+            view = http_json("GET", gw.url + "/v1/jobs/cx",
+                             ok=(200,))
+            if view.get("replica"):
+                break
+            time.sleep(0.05)
+        assert view.get("replica"), "job never routed"
+        victim = rep0 if view["replica"] == "c0" else rep1
+        victim.kill()                       # remote cancel will fail
+        http_json("DELETE", gw.url + "/v1/jobs/cx", ok=(202,))
+        views = _wait_jobs(gw.url, ["cx"], timeout=60)
+        assert views["cx"]["state"] == "cancelled", views["cx"]
+    finally:
+        gw.close()
+        rep0.kill()
+        rep1.kill()
+
+
+def test_gateway_drain_finishes_parked_jobs():
+    """A drain requested while jobs are parked mid-budget lets them
+    FINISH (full generation budget, state done — not cancelled), then
+    drains the owned replicas, which exit their drive loops."""
+    rep, handle = in_process_replica(_serve_cfg(), "rd")
+    gw = Gateway(_fleet_cfg([handle.url]), [handle],
+                 owned=True).start()
+    try:
+        ids = []
+        for i in range(3):
+            p = _problem(300 + i, _SHAPE_A)
+            ids.append(f"d{i}")
+            http_json("POST", gw.url + "/v1/solve",
+                      {"tim": dump_tim(p), "id": f"d{i}", "seed": i,
+                       "generations": 15})    # 3 quanta -> parks
+        http_json("POST", gw.url + "/v1/drain", {}, ok=(200,))
+        # new work refused the moment the drain is requested
+        refused = http_json("POST", gw.url + "/v1/solve",
+                            {"tim": dump_tim(_problem(9, _SHAPE_A))},
+                            ok=(503,))
+        assert "draining" in refused.get("reasons", [])
+        assert gw.drained.wait(120), "gateway drain never completed"
+        views = {j: http_json("GET", f"{gw.url}/v1/jobs/{j}",
+                              ok=(200,)) for j in ids}
+        for j, v in views.items():
+            assert v["state"] == "done", (j, v["state"], v["error"])
+            assert v["result"]["gens"] == 15
+        # owned replica was drained too: drive loop exited cleanly
+        assert rep.drained.wait(30)
+    finally:
+        gw.close()
+        rep.kill()
+
+
+# ------------------------------------------------- fault-site isolation
+
+
+def test_wedged_gateway_never_stalls_replica():
+    """`gateway:1:hang` parks the gateway's accept loop at startup:
+    the front is unreachable, but a replica served directly keeps
+    dispatching and its writer drains on close — the isolation
+    contract of the new fault sites."""
+    rep, handle = in_process_replica(_serve_cfg(), "ri")
+    try:
+        gw = Gateway(_fleet_cfg([handle.url],
+                                faults="gateway:1:hang"),
+                     [handle]).start()
+        try:
+            # the accept loop is parked; the replica solves anyway
+            tim = dump_tim(_problem(7, _SHAPE_A))
+            http_json("POST", rep.url + "/v1/solve",
+                      {"tim": tim, "id": "iso1", "seed": 3,
+                       "generations": 10})
+            views = _wait_jobs(rep.url, ["iso1"], timeout=60)
+            assert views["iso1"]["state"] == "done"
+        finally:
+            gw.close()
+            faults.install(None)
+    finally:
+        rep.stop(timeout=60)
+        assert rep.drained.is_set()             # writer drained
+        assert not rep.svc.writer.alive()
+
+
+def test_route_die_kills_dispatcher_not_replicas():
+    """`route:1:die` ends the dispatcher thread on the first routing
+    decision: the gateway's /healthz dispatcher probe goes false and
+    the routed job stays `accepted` — while the replica keeps solving
+    direct submissions untouched."""
+    rep, handle = in_process_replica(_serve_cfg(), "rj")
+    gw = Gateway(_fleet_cfg([handle.url], faults="route:1:die"),
+                 [handle]).start()
+    try:
+        tim = dump_tim(_problem(8, _SHAPE_A))
+        http_json("POST", gw.url + "/v1/solve",
+                  {"tim": tim, "id": "dead1", "seed": 4,
+                   "generations": 10})
+        deadline = time.monotonic() + 20
+        down = False
+        while time.monotonic() < deadline and not down:
+            hz = http_json("GET", gw.url + "/healthz",
+                           ok=(200, 503))
+            down = hz["probes"].get("dispatcher") is False
+            time.sleep(0.1)
+        assert down, "dispatcher death never surfaced on /healthz"
+        view = http_json("GET", gw.url + "/v1/jobs/dead1", ok=(200,))
+        assert view["state"] == "accepted"      # never placed
+        # the replica is untouched by the dead dispatcher
+        http_json("POST", rep.url + "/v1/solve",
+                  {"tim": tim, "id": "alive1", "seed": 5,
+                   "generations": 10})
+        views = _wait_jobs(rep.url, ["alive1"], timeout=60)
+        assert views["alive1"]["state"] == "done"
+    finally:
+        gw.close()
+        faults.install(None)
+        rep.kill()
+
+
+# --------------------------------------------------------- tt submit
+
+
+def test_tt_submit_round_trip(tmp_path, capsys):
+    """`tt submit` round-trips a `.tim` fixture end-to-end on CPU:
+    file -> gateway -> routed replica -> polled result on stdout."""
+    p = _problem(4, _SHAPE_A)
+    tim_path = os.path.join(tmp_path, "instance.tim")
+    with open(tim_path, "w") as fh:
+        fh.write(dump_tim(p))
+    rep, handle = in_process_replica(_serve_cfg(), "rs")
+    gw = Gateway(_fleet_cfg([handle.url]), [handle]).start()
+    try:
+        rc = main_submit([gw.url, tim_path, "--id", "cli1", "-s", "9",
+                          "--generations", "10", "--poll", "0.1",
+                          "--records"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["state"] == "done" and out["id"] == "cli1"
+        assert out["replica"] == "rs"
+        assert isinstance(out["result"]["best"], int)
+        assert any("solution" in r for r in out["records"])
+        # and the stream matches the unrouted baseline
+        baseline = _unrouted_streams([("cli1", p, 9, 10)])
+        assert jsonl.strip_timing(out["records"]) == baseline["cli1"]
+    finally:
+        gw.request_drain()
+        gw.drained.wait(30)
+        gw.close()
+        rep.stop(timeout=60)

@@ -77,9 +77,9 @@ class _Context:
 
 def _rule_modules():
     from timetabling_ga_tpu.analysis import (
-        rules_api, rules_cost, rules_donate, rules_http, rules_obs,
-        rules_quality, rules_recompile, rules_rng, rules_sync,
-        rules_trace)
+        rules_api, rules_cost, rules_donate, rules_fleet, rules_http,
+        rules_obs, rules_quality, rules_recompile, rules_rng,
+        rules_sync, rules_trace)
     return {
         "TT101": rules_trace,
         "TT102": rules_trace,
@@ -96,6 +96,7 @@ def _rule_modules():
         "TT602": rules_http,
         "TT603": rules_cost,
         "TT604": rules_quality,
+        "TT605": rules_fleet,
     }
 
 

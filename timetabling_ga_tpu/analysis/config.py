@@ -17,7 +17,7 @@ import re
 
 ALL_RULES = ("TT101", "TT102", "TT201", "TT202", "TT203", "TT301",
              "TT302", "TT401", "TT402", "TT501", "TT502", "TT601",
-             "TT602", "TT603", "TT604")
+             "TT602", "TT603", "TT604", "TT605")
 
 
 @dataclasses.dataclass
@@ -62,6 +62,10 @@ class AnalyzerConfig:
     # function-name pattern marking quality-reduction helpers (TT604
     # bans collectives and collective-bearing random ops inside them)
     quality_path_pattern: str = r"quality|hamming|div_stats|div_rows"
+    # modules (path substring match) whose handler-reachable code
+    # TT605 audits for inline device work and unbounded socket reads
+    fleet_modules: list[str] = dataclasses.field(
+        default_factory=lambda: ["fleet/"])
 
     root: str = "."
 

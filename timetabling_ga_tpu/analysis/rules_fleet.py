@@ -1,0 +1,98 @@
+"""TT605 — fleet handler discipline: no device work, bounded reads.
+
+The fleet front's one contract (fleet/gateway.py docstring): HTTP
+handlers ENQUEUE and READ ONLY. The drive loop owns every device call;
+the dispatcher thread owns every piece of outbound I/O. Two ways a
+handler silently breaks that:
+
+  - DEVICE WORK INLINE: calling `block_until_ready` (or anything that
+    forces one — `device_put`, `copy_to_host_async`), touching the
+    solve path's dispatch-loop callees (`step`, `drive`, `submit`,
+    `prepare`), or materializing device buffers (`device_arrays`,
+    `reshard_state`, `fetch_state`) from a handler thread. A handler
+    that dispatches device work serializes tenant requests behind the
+    accelerator AND races the drive loop's control fences — the exact
+    coupling the inbox exists to prevent.
+  - UNBOUNDED SOCKET READS: `self.rfile.read()` with no size parks the
+    handler thread until the CLIENT closes the connection (HTTP/1.1
+    keep-alive: possibly never) — a tenant-controlled hang. Bodies
+    must be read with an explicit Content-Length-derived bound
+    (ApiHandler._body is the sanctioned shape).
+
+Scope: handler-reachable code (the TT602 reachability walk — handler
+classes' methods plus intra-module `self.x()` / bare-name callees) in
+the configured fleet modules (`fleet-modules` in pyproject, default
+the fleet/ package).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from timetabling_ga_tpu.analysis.core import Finding, qual_matches, qualname
+from timetabling_ga_tpu.analysis.rules_http import _reachable
+
+RULE = "TT605"
+
+# callee tails that mean "device work" when reached from a handler:
+# jax sync points plus the solve path's dispatch-loop entries
+_DEVICE_CALLEES = {
+    "block_until_ready", "jax.block_until_ready",
+    "device_put", "jax.device_put", "copy_to_host_async",
+    "device_arrays", "reshard_state", "fetch_state",
+    # scheduler/service dispatch entries: a handler may enqueue a
+    # command FOR these, never call them
+    "scheduler.step", "scheduler.drive", "svc.step", "svc.drive",
+    "svc.submit", "scheduler.prepare",
+}
+
+
+def _in_scope(path: str, ctx) -> bool:
+    rel = path.replace("\\", "/")
+    modules = getattr(ctx.config, "fleet_modules", ["fleet/"])
+    return any(m in rel for m in modules)
+
+
+def _is_unbounded_rfile_read(node: ast.Call) -> str | None:
+    """`<...>.rfile.read()` (or a bare `rfile.read()`) with no size
+    argument — the read that blocks until the peer hangs up."""
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "read"):
+        return None
+    if node.args or node.keywords:
+        return None
+    recv = qualname(f.value)
+    if recv is not None and recv.split(".")[-1] == "rfile":
+        return recv
+    return None
+
+
+def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
+    if not _in_scope(path, ctx):
+        return []
+    findings: list[Finding] = []
+    for where, fn in _reachable(tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            qn = qualname(node.func)
+            if qual_matches(qn, _DEVICE_CALLEES):
+                findings.append(Finding(
+                    RULE, path, node.lineno, node.col_offset,
+                    f"device/dispatch call `{qn}` on the fleet "
+                    f"handler path `{where}` — handlers enqueue and "
+                    f"read only; device work belongs to the drive "
+                    f"loop, outbound I/O to the dispatcher thread "
+                    f"(fleet/gateway.py handler discipline)"))
+                continue
+            recv = _is_unbounded_rfile_read(node)
+            if recv is not None:
+                findings.append(Finding(
+                    RULE, path, node.lineno, node.col_offset,
+                    f"unbounded socket read `{recv}.read()` on the "
+                    f"fleet handler path `{where}` — a body read with "
+                    f"no Content-Length bound parks this handler "
+                    f"thread until the CLIENT closes the connection; "
+                    f"read exactly the declared length "
+                    f"(fleet/gateway.py ApiHandler._body)"))
+    return findings

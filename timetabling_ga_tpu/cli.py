@@ -39,6 +39,16 @@ serve process (its `--obs-listen` front) to record a jax.profiler
 trace of its next N dispatches into its `--profile-dir`.
 
     python -m timetabling_ga_tpu.cli profile 127.0.0.1:9100 --for 5
+
+`fleet` / `submit` subcommands — the N-replica serving front (README
+"Fleet"; timetabling_ga_tpu/fleet): a gateway HTTP API with a
+bucket-affine router over replicas (`tt serve --http` workers), and
+the stdlib client that submits one instance and waits.
+
+    python -m timetabling_ga_tpu.cli fleet --listen 127.0.0.1:8070 \
+        --spawn 2 -- --backend cpu --lanes 4
+    python -m timetabling_ga_tpu.cli submit http://127.0.0.1:8070 \
+        comp01.tim -s 42 --generations 200
 """
 
 from __future__ import annotations
@@ -73,6 +83,16 @@ def main(argv=None) -> int:
         # capture its next N dispatches (obs/cost.py ProfileCapture)
         from timetabling_ga_tpu.obs.cost import main_profile
         return main_profile(argv[1:])
+    if argv and argv[0] == "fleet":
+        # the fleet gateway (README "Fleet"; timetabling_ga_tpu/fleet):
+        # HTTP solve front + bucket-affine router over N replicas —
+        # the gateway process routes, it never solves
+        from timetabling_ga_tpu.fleet.gateway import main_fleet
+        return main_fleet(argv[1:])
+    if argv and argv[0] == "submit":
+        # stdlib HTTP solve client against a gateway or replica front
+        from timetabling_ga_tpu.fleet.client import main_submit
+        return main_submit(argv[1:])
     # runtime imports deferred past the subcommand dispatch (and the
     # package __init__ is PEP 562-lazy): `tt trace`/`tt stats` must
     # work without importing jax (the log may be on a machine with no

@@ -1,0 +1,32 @@
+"""tt-fleet: the HTTP solve front and N-replica router (README
+"Fleet"; ROADMAP item 3).
+
+Layers:
+
+  gateway.py   the shared `/v1` HTTP API (solve / jobs / cancel /
+               drain) spoken by BOTH the gateway and every replica,
+               and the Gateway itself: accept-and-enqueue handlers, a
+               dispatcher thread that owns every piece of outbound
+               I/O (routing, submission, status polls, failover), and
+               a cached job table the handlers serve reads from.
+  router.py    the bucket-affine router: jobs land where their shape
+               bucket's lane programs are already compiled, driven by
+               each replica's /readyz reasons, backlog gauge, and
+               measured compile-hit rate.
+  replicas.py  replica-set management: the drive loop that turns a
+               SolveService into an HTTP replica (in-process or
+               `tt serve --http` foreground), spawned local worker
+               processes, liveness probing with restart-on-death, and
+               graceful drain.
+  client.py    `tt submit` — the stdlib HTTP client.
+
+Import discipline: the gateway never touches a device — it routes on
+`.tim` headers and scraped gauges (serve/bucket.py's key math only);
+the solver stack enters a process exclusively through the replica
+drive loop's deferred imports. `tt submit` (client.py) is pure stdlib
+— it runs on machines with no accelerator stack at all.
+"""
+
+from timetabling_ga_tpu.fleet.router import NoReplicaError, Router
+
+__all__ = ["Router", "NoReplicaError"]

@@ -1,0 +1,155 @@
+"""`tt submit` — the stdlib solve client.
+
+POSTs one `.tim` instance to a gateway (or directly to a replica —
+same protocol), then polls the job to completion and prints the final
+state as JSON on stdout:
+
+    tt submit http://127.0.0.1:8070 comp01.tim -s 42 \
+        --generations 200 --priority 5
+    tt submit URL instance.tim --no-wait        just the job id
+    tt submit URL instance.tim --records        include the record tail
+
+Pure stdlib (urllib + json): it must run from any machine that can
+reach the fleet, with no solver stack installed. Exit status: 0 when
+the job reaches `done`, 1 for any other terminal state, 2 for
+transport errors.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from timetabling_ga_tpu.fleet.gateway import TERMINAL
+from timetabling_ga_tpu.fleet.replicas import FleetHTTPError, http_json
+
+_USAGE = """\
+usage: python -m timetabling_ga_tpu submit URL INSTANCE.tim [flags]
+
+submit one instance to a fleet gateway (or a single replica) and wait:
+  --id <str>            job id (default: server-assigned)
+  --priority <int>      scheduling priority (higher first)
+  -s <int>              seed
+  --generations <int>   generation budget
+  --deadline <float>    wall-clock deadline, seconds
+  --poll <float>        poll interval, seconds (default 0.5)
+  --timeout <float>     give up after this many seconds (default 3600)
+  --records             print the job-tagged record tail too
+  --no-wait             print the job id and exit without polling
+  -h, --help            show this message and exit"""
+
+
+def submit_and_wait(url: str, payload: dict, poll: float = 0.5,
+                    timeout: float = 3600.0, wait: bool = True):
+    """POST /v1/solve then poll GET /v1/jobs/<id> until terminal.
+    Returns the final job view (or the accept reply when not
+    waiting). Raises FleetHTTPError/OSError on transport failure and
+    TimeoutError when the budget runs out."""
+    url = url.rstrip("/")
+    accepted = http_json("POST", url + "/v1/solve", payload,
+                         ok=(200, 202))
+    if not wait:
+        return accepted
+    job_id = accepted["id"]
+    deadline = time.monotonic() + timeout
+    from urllib.parse import quote
+    while True:
+        # steady-state polls are STATE-ONLY (the record tail is the
+        # expensive part of the view — same discipline as the
+        # gateway's dispatcher); the full view is fetched once, at
+        # terminal
+        view = http_json(
+            "GET", f"{url}/v1/jobs/{quote(job_id)}?records=0",
+            ok=(200,))
+        if view.get("state") in TERMINAL:
+            return http_json(
+                "GET", f"{url}/v1/jobs/{quote(job_id)}", ok=(200,))
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"job {job_id} still {view.get('state')!r} after "
+                f"{timeout:.0f}s")
+        time.sleep(poll)
+
+
+def main_submit(argv) -> int:
+    """`tt submit` entry point (cli.py dispatches here)."""
+    args = list(argv)
+    if not args or args[0] in ("-h", "--help"):
+        print(_USAGE)
+        return 0
+    if len(args) < 2:
+        print(_USAGE, file=sys.stderr)
+        return 2
+    url, instance = args[0], args[1]
+    rest = args[2:]
+    payload: dict = {}
+    poll, timeout = 0.5, 3600.0
+    wait = True
+    records = False
+    i = 0
+    flag_types = {"--id": ("id", str), "--priority": ("priority", int),
+                  "-s": ("seed", int),
+                  "--generations": ("generations", int),
+                  "--deadline": ("deadline", float)}
+    while i < len(rest):
+        a = rest[i]
+        if a in ("-h", "--help"):
+            print(_USAGE)
+            return 0
+        if a == "--records":
+            records = True
+            i += 1
+            continue
+        if a == "--no-wait":
+            wait = False
+            i += 1
+            continue
+        if a in ("--poll", "--timeout"):
+            if i + 1 >= len(rest):
+                print(f"flag {a} needs a value", file=sys.stderr)
+                return 2
+            try:
+                if a == "--poll":
+                    poll = float(rest[i + 1])
+                else:
+                    timeout = float(rest[i + 1])
+            except ValueError:
+                print(f"flag {a} wants a number, got "
+                      f"{rest[i + 1]!r}", file=sys.stderr)
+                return 2
+            i += 2
+            continue
+        if a not in flag_types:
+            print(f"unknown flag: {a}", file=sys.stderr)
+            return 2
+        if i + 1 >= len(rest):
+            print(f"flag {a} needs a value", file=sys.stderr)
+            return 2
+        key, typ = flag_types[a]
+        try:
+            payload[key] = typ(rest[i + 1])
+        except ValueError:
+            # usage errors share the transport-error contract: one
+            # line on stderr, status 2, never a traceback
+            print(f"flag {a} wants {typ.__name__}, got "
+                  f"{rest[i + 1]!r}", file=sys.stderr)
+            return 2
+        i += 2
+    try:
+        with open(instance, "r") as fh:
+            payload["tim"] = fh.read()
+        view = submit_and_wait(url, payload, poll=poll,
+                               timeout=timeout, wait=wait)
+    except (FleetHTTPError, OSError, TimeoutError) as e:
+        # a missing instance file and a dead gateway exit the same
+        # way: status 2 with one line, never a traceback
+        print(f"tt submit: {e}", file=sys.stderr)
+        return 2
+    if not wait:
+        print(json.dumps(view))
+        return 0
+    if not records:
+        view = {k: v for k, v in view.items() if k != "records"}
+    print(json.dumps(view))
+    return 0 if view.get("state") == "done" else 1

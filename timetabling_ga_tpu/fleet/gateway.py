@@ -1,0 +1,821 @@
+"""The fleet gateway: one HTTP solve front over N routed replicas.
+
+Protocol (spoken IDENTICALLY by the gateway and by every replica's
+`tt serve --http` front — fleet/replicas.py — so the router can treat
+a replica as a one-member fleet):
+
+  POST   /v1/solve      submit a job. Body: a raw `.tim` payload, or
+                        JSON `{"tim": "...", "id": ..., "priority":
+                        ..., "seed": ..., "generations": ...,
+                        "deadline": ...}`, or pre-parsed problem JSON
+                        (`{"problem": {...}}` — problem_from_json's
+                        schema). Replies 202 `{"id": ...}` at once:
+                        the job is ACCEPTED, not solved.
+  GET    /v1/jobs/<id>  status + result + the job-tagged record tail
+                        (the same JSONL records an unrouted solve
+                        emits, demultiplexed per job).
+  DELETE /v1/jobs/<id>  cancel, through the existing queue
+                        cancellation path (serve/queue.py: immediate
+                        for parked work, next control fence for
+                        running work).
+  POST   /v1/drain      graceful drain: admit nothing new, let parked
+                        jobs finish, then shut down.
+  GET    /v1/fleet      (gateway only) replica set, router stats,
+                        job-state counts.
+  GET    /metrics /healthz /readyz   the obs/http.py pull front, same
+                        port — the router's scrape needs no second
+                        listener.
+
+Handler discipline (enforced by tt-analyze TT605): handlers ENQUEUE
+and READ ONLY. A POST validates cheap text (the `.tim` header), drops
+a command on the dispatcher's inbox, and returns; a GET serves the
+cached job table. No handler ever does outbound I/O, touches a device,
+or calls into a scheduler — ONE dispatcher thread owns every piece of
+outbound HTTP (routing, submission, status polls, failover) and every
+mutation of router state, so a scrape storm or a wedged handler can
+never stall placement, and placement races cannot exist.
+
+Failover: the ReplicaSet's prober declares a replica dead after
+`--dead-after` consecutive failed probes (or a reaped worker process);
+the dispatcher then forgets the dead replica's pins, discards its
+unfinished jobs' partial record tails, and resubmits each job —
+idempotent by job id, same payload, same seed — wherever the router
+now places it. A job's record stream is a pure function of its own
+(seed, chunk) lane RNG (serve/scheduler.py), so the replayed solve
+emits records bit-identical to an unrouted solve of the same job
+(tests/test_fleet.py and bench extra.fleet pin it, modulo timing
+fields).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import queue as queue_mod
+import sys
+import threading
+import time
+import urllib.parse
+
+from timetabling_ga_tpu.obs import http as obs_http
+from timetabling_ga_tpu.obs import metrics as obs_metrics
+from timetabling_ga_tpu.problem import (
+    DAYS_DEFAULT, SLOTS_PER_DAY_DEFAULT)
+from timetabling_ga_tpu.runtime import faults
+from timetabling_ga_tpu.runtime.config import (
+    FleetConfig, ServeConfig, parse_fleet_args, parse_serve_args)
+from timetabling_ga_tpu.runtime.retry import retry_transient
+from timetabling_ga_tpu.serve.bucket import (
+    BucketSpec, bucket_key_from_counts)
+from timetabling_ga_tpu.fleet.router import NoReplicaError, Router
+
+# request-body bound: the biggest committed ITC instance serializes to
+# well under a megabyte; 32 MiB leaves room for dense problem JSON
+# while keeping a lying Content-Length from ballooning a handler
+MAX_BODY = 32 * 1024 * 1024
+
+# terminal job states at the gateway (mirrors serve/queue.py JobState
+# terminals plus the gateway-side 'rejected')
+TERMINAL = ("done", "failed", "cancelled", "shed", "rejected")
+
+_PAYLOAD_KEYS = ("id", "tim", "problem", "priority", "seed",
+                 "generations", "deadline", "n_days", "slots_per_day")
+
+
+# ---------------------------------------------------------------- protocol
+
+
+def parse_solve_body(body: bytes) -> dict:
+    """Canonical submit payload from a POST /v1/solve body: JSON when
+    it parses as an object, else the whole body is a `.tim` text.
+    Raises ValueError on anything unusable."""
+    try:
+        text = body.decode("utf-8")
+    except UnicodeDecodeError as e:
+        raise ValueError(f"body is not UTF-8: {e}") from None
+    stripped = text.lstrip()
+    if stripped.startswith("{"):
+        try:
+            obj = json.loads(text)
+        except ValueError as e:
+            raise ValueError(f"bad JSON body: {e}") from None
+        payload = {k: obj[k] for k in _PAYLOAD_KEYS if k in obj}
+        if "tim" not in payload and "problem" not in payload:
+            raise ValueError(
+                "JSON body needs a 'tim' text or a 'problem' object")
+        return payload
+    if not stripped:
+        raise ValueError("empty body")
+    return {"tim": text}
+
+
+def payload_counts(payload: dict) -> tuple:
+    """(E, R, F, S, n_days, slots_per_day) from a submit payload —
+    `.tim` HEADER parse only (four ints off the first tokens), never
+    the full instance: this runs on the gateway's routing path, where
+    conflict matrices would be pure waste."""
+    days = int(payload.get("n_days", DAYS_DEFAULT))
+    slots = int(payload.get("slots_per_day", SLOTS_PER_DAY_DEFAULT))
+    if "problem" in payload:
+        p = payload["problem"]
+        try:
+            counts = tuple(int(p[k]) for k in (
+                "n_events", "n_rooms", "n_features", "n_students"))
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"bad problem object: {e}") from None
+        days = int(p.get("n_days", days))
+        slots = int(p.get("slots_per_day", slots))
+    else:
+        # maxsplit: read ONLY the first four tokens — a dense instance
+        # near the body cap must not be tokenized wholesale on the
+        # handler thread
+        toks = str(payload["tim"]).split(None, 4)[:4]
+        if len(toks) < 4:
+            raise ValueError(".tim header needs 4 counts "
+                             "(events rooms features students)")
+        try:
+            counts = tuple(int(t) for t in toks)
+        except ValueError:
+            raise ValueError(
+                f".tim header is not 4 ints: {toks}") from None
+    if any(c < 0 for c in counts):
+        raise ValueError(f"negative instance counts: {counts}")
+    return counts + (days, slots)
+
+
+# ---------------------------------------------------------------- handler
+
+
+class ApiHandler(obs_http._Handler):
+    """The `/v1` request router, shared by gateway and replica fronts.
+
+    Extends the pull front's handler (GET /metrics //healthz //readyz
+    keep working on the same port) with the solve API. TT605: every
+    branch here bounds its socket reads by Content-Length and only
+    calls the server's `api` object — whose entire surface enqueues
+    commands or reads cached/queue state."""
+
+    def do_GET(self):  # noqa: N802 (http.server's naming)
+        path, _, query = self.path.partition("?")
+        if path.startswith("/v1/jobs/"):
+            params = dict(p.split("=", 1)
+                          for p in query.split("&") if "=" in p)
+            status, obj = self.server.api.job_view(
+                self._job_id(path),
+                with_records=params.get("records") != "0")
+            self._reply_json(status, obj)
+        elif path == "/v1/jobs":
+            # bulk state-only view: the gateway's steady-state poll is
+            # ONE of these per replica per tick, not one GET per job
+            status, obj = self.server.api.jobs_view()
+            self._reply_json(status, obj)
+        elif path == "/v1/fleet":
+            status, obj = self.server.api.fleet_view()
+            self._reply_json(status, obj)
+        else:
+            super().do_GET()
+
+    @staticmethod
+    def _job_id(path: str) -> str:
+        # clients QUOTE the id into the URL (ReplicaHandle, tt
+        # submit); without the matching unquote here an id with a
+        # space would 404 every poll — which _poll_jobs reads as
+        # "replica lost the job" and fails over, forever
+        return urllib.parse.unquote(path[len("/v1/jobs/"):])
+
+    def do_POST(self):  # noqa: N802
+        path, _, _ = self.path.partition("?")
+        if path == "/v1/solve":
+            body = self._body()
+            if body is None:
+                return
+            try:
+                payload = parse_solve_body(body)
+            except ValueError as e:
+                self._reply_json(400, {"error": str(e)[:300]})
+                return
+            status, obj = self.server.api.accept_solve(payload)
+            self._reply_json(status, obj)
+        elif path == "/v1/drain":
+            # consume any declared body BEFORE the 200: a keep-alive
+            # client's next request must not be parsed out of the
+            # leftover payload bytes (the >=400 path closes the
+            # connection instead — _reply)
+            self._discard_body()
+            status, obj = self.server.api.accept_drain()
+            self._reply_json(status, obj)
+        else:
+            self._reply_json(404, {"error": f"no route {path!r}"})
+
+    def do_DELETE(self):  # noqa: N802
+        path, _, _ = self.path.partition("?")
+        if path.startswith("/v1/jobs/"):
+            status, obj = self.server.api.accept_cancel(
+                self._job_id(path))
+            self._reply_json(status, obj)
+        else:
+            self._reply_json(404, {"error": f"no route {path!r}"})
+
+    def _discard_body(self) -> None:
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            n = 0
+        if 0 < n <= MAX_BODY:
+            self.rfile.read(n)
+        elif n > MAX_BODY:
+            self.close_connection = True
+
+    def _reply(self, status: int, body: bytes, ctype: str) -> None:
+        # error replies may leave an unread request body in the
+        # socket (411/413 before the read, POST routes that never
+        # read): close the connection rather than let a keep-alive
+        # client parse its next request out of the leftover bytes
+        if status >= 400:
+            self.close_connection = True
+        super()._reply(status, body, ctype)
+
+    def _body(self):
+        """Content-Length-bounded body read; replies and returns None
+        on anything else. An unbounded `rfile.read()` would park this
+        handler thread until the client hangs up — exactly the read
+        TT605 bans."""
+        n = self.headers.get("Content-Length")
+        if n is None:
+            self._reply_json(411, {"error": "Content-Length required"})
+            return None
+        try:
+            n = int(n)
+        except ValueError:
+            self._reply_json(400, {"error": "bad Content-Length"})
+            return None
+        if n < 0 or n > MAX_BODY:
+            self._reply_json(
+                413, {"error": f"body over {MAX_BODY} bytes"})
+            return None
+        return self.rfile.read(n)
+
+
+# ---------------------------------------------------------------- gateway
+
+
+class GatewayJob:
+    """One job's gateway-side life: payload kept for failover replay,
+    state/result/records mirrored from the owning replica by the
+    dispatcher's polls (handlers read ONLY this cache)."""
+
+    def __init__(self, job_id: str, payload: dict, now: float):
+        self.id = job_id
+        self.payload = payload
+        self.counts = None           # payload_counts result
+        self.bucket = None
+        self.replica = None          # owning replica name
+        self.state = "accepted"
+        self.result = None
+        self.error = None
+        self.records: list = []
+        self.records_final = False
+        self.records_truncated = False   # tail lost records (over-cap
+        #                                  ring, or a settle fallback)
+        #                                  — identity cannot hold
+        self.extra_polls = 0         # terminal-tail settle budget
+        self.place_attempts = 0
+        self.place_started = None    # current placement round's epoch:
+        #                              reset by failover, so a job that
+        #                              ran for hours still gets the
+        #                              full --place-timeout to wait
+        #                              out a respawning replica
+        self.cancel_requested = False
+        self.sent_any = False        # some send of this payload may
+        #                              have reached a replica: later
+        #                              sends are idempotent resends
+        #                              (409 = already placed)
+        self.submitted_t = now
+        self.finished_t = None
+        self.counted = False         # terminal counters bumped once
+
+    def terminal(self) -> bool:
+        return self.state in TERMINAL
+
+    def view(self, with_records: bool = True) -> dict:
+        out = {"id": self.id, "state": self.state,
+               "replica": self.replica,
+               "bucket": list(self.bucket) if self.bucket else None,
+               "result": self.result, "error": self.error}
+        if with_records:
+            out["records"] = list(self.records)
+            out["records_truncated"] = self.records_truncated
+        return out
+
+
+class GatewayApi:
+    """The handlers' surface: enqueue-or-read-only over the Gateway
+    (TT605 — no outbound I/O, no device, no registry mutation)."""
+
+    def __init__(self, gw: "Gateway"):
+        self._gw = gw
+
+    def accept_solve(self, payload: dict):
+        gw = self._gw
+        if gw.draining:
+            return 503, {"error": "draining", "reasons": ["draining"]}
+        try:
+            counts = payload_counts(payload)
+        except ValueError as e:
+            return 400, {"error": str(e)[:300]}
+        with gw.jobs_lock:
+            job_id = payload.get("id")
+            if job_id is None:
+                # auto-ids skip anything a client already claimed —
+                # an id-less submission must never be rejected for a
+                # collision it did not cause
+                job_id = f"gw-{next(gw.auto_id)}"
+                while job_id in gw.jobs:
+                    job_id = f"gw-{next(gw.auto_id)}"
+            job_id = str(job_id)
+            if job_id in gw.jobs:
+                return 409, {"error": "duplicate job id", "id": job_id,
+                             "state": gw.jobs[job_id].state}
+            active = sum(1 for j in gw.jobs.values()
+                         if not j.terminal())
+            if active >= gw.cfg.backlog:
+                return 429, {"error": f"gateway backlog full "
+                                      f"({gw.cfg.backlog} active)"}
+            job = GatewayJob(job_id, dict(payload, id=job_id),
+                             gw.now())
+            job.counts = counts
+            gw.jobs[job_id] = job
+        gw.inbox.put(("submit", job_id))
+        return 202, {"id": job_id, "state": "accepted"}
+
+    def job_view(self, job_id: str, with_records: bool = True):
+        with self._gw.jobs_lock:
+            job = self._gw.jobs.get(job_id)
+            if job is None:
+                return 404, {"error": f"unknown job {job_id!r}"}
+            return 200, job.view(with_records=with_records)
+
+    def jobs_view(self):
+        """Bulk state-only view (protocol parity with the replica
+        front — a meta-gateway could poll this gateway the same
+        way)."""
+        with self._gw.jobs_lock:
+            return 200, {"jobs": {j.id: {"state": j.state,
+                                         "replica": j.replica}
+                                  for j in self._gw.jobs.values()}}
+
+    def accept_cancel(self, job_id: str):
+        gw = self._gw
+        with gw.jobs_lock:
+            job = gw.jobs.get(job_id)
+            if job is None:
+                return 404, {"error": f"unknown job {job_id!r}"}
+            if job.terminal():
+                return 409, {"id": job_id, "state": job.state,
+                             "error": "already terminal"}
+        gw.inbox.put(("cancel", job_id))
+        return 202, {"id": job_id, "cancelling": True}
+
+    def accept_drain(self):
+        gw = self._gw
+        gw.draining = True
+        gw.inbox.put(("drain",))
+        with gw.jobs_lock:
+            active = sum(1 for j in gw.jobs.values()
+                         if not j.terminal())
+        return 200, {"draining": True, "active": active}
+
+    def fleet_view(self):
+        gw = self._gw
+        with gw.jobs_lock:
+            states: dict = {}
+            for j in gw.jobs.values():
+                states[j.state] = states.get(j.state, 0) + 1
+        return 200, {"replicas": [h.view()
+                                  for h in gw.replicas.all()],
+                     "router": gw.router.stats(),
+                     "jobs": states, "draining": gw.draining}
+
+
+class Gateway:
+    """The fleet front: HTTP API + single-threaded dispatcher that
+    owns routing, submission, polling, failover, and drain."""
+
+    def __init__(self, cfg: FleetConfig, handles, owned: bool = False,
+                 now=None):
+        # deterministic fault injection, mirroring SolveService: the
+        # gateway/route sites fire under `tt fleet` too
+        spec = faults.active_spec(cfg.faults)
+        if spec:
+            faults.install(spec)
+        self.cfg = cfg
+        self.now = now or time.monotonic
+        self.owned = owned           # gateway manages replica lifetime
+        self.draining = False
+        self.drained = threading.Event()
+        self.jobs: dict = {}
+        self.jobs_lock = threading.RLock()
+        self.auto_id = itertools.count(1)
+        self.inbox = queue_mod.Queue()
+        self._requeue: list = []     # placement retries, drained ONCE
+        #                              per poll tick (an inbox requeue
+        #                              would be popped right back and
+        #                              starve the poll/drain phases)
+        self._terminal_order: list = []   # settled ids, eviction FIFO
+        # the serve flags spawned workers run with double as the
+        # router's bucket spec — one parse, no drift
+        serve_cfg = (parse_serve_args(cfg.serve_args)
+                     if cfg.serve_args else ServeConfig())
+        self.spec = BucketSpec(
+            event_floor=serve_cfg.bucket_events,
+            room_floor=serve_cfg.bucket_rooms,
+            feature_floor=serve_cfg.bucket_features,
+            student_floor=serve_cfg.bucket_students,
+            ratio=serve_cfg.bucket_ratio)
+        from timetabling_ga_tpu.fleet.replicas import ReplicaSet
+        self.replicas = ReplicaSet(
+            handles, probe_every=cfg.probe_every,
+            probe_timeout=cfg.probe_timeout,
+            dead_after=cfg.dead_after, max_restarts=cfg.max_restarts,
+            on_death=self._on_death, boot_grace=cfg.boot_grace)
+        self.router = Router(self.replicas)
+        self.registry = obs_metrics.MetricsRegistry()
+        self.registry.gauge_fn(
+            "fleet.replicas_ready",
+            lambda: sum(1 for h in self.replicas.live() if h.ready))
+        self.registry.gauge_fn(
+            "serve.queue_depth",
+            lambda: sum(1 for j in list(self.jobs.values())
+                        if not j.terminal()))
+        self.registry.gauge("serve.backlog").set(cfg.backlog)
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, name="tt-fleet-dispatch",
+            daemon=True)
+        self.front = obs_http.ObsServer(
+            cfg.listen, registry=self.registry,
+            probes={"dispatcher": self._thread.is_alive},
+            handler=ApiHandler, api=GatewayApi(self), site="gateway")
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "Gateway":
+        # one synchronous probe round before anything routes: the
+        # router's first decision should see real readiness, not the
+        # all-unprobed default
+        self.replicas.probe_all()
+        self.replicas.start()
+        self.front.start()
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return self.front.url
+
+    def request_drain(self) -> None:
+        self.draining = True
+        self.inbox.put(("drain",))
+
+    def close(self) -> None:
+        self._stop = True
+        self.inbox.put(("wake",))
+        self._thread.join(timeout=5.0)
+        self.front.close()
+        self.replicas.close()
+
+    # -- the dispatcher thread: ALL outbound I/O lives here -------------
+
+    _stop = False
+
+    def _dispatch_loop(self) -> None:
+        try:
+            while not self._stop:
+                try:
+                    cmd = self.inbox.get(timeout=self.cfg.poll_every)
+                except queue_mod.Empty:
+                    cmd = None
+                while cmd is not None:
+                    self._handle(cmd)
+                    try:
+                        cmd = self.inbox.get_nowait()
+                    except queue_mod.Empty:
+                        cmd = None
+                self._poll_jobs()
+                # deferred placement retries AFTER the poll phase, one
+                # round per tick: a replica paying its boot-time jax
+                # import must not starve status polls or drain progress
+                retries, self._requeue = self._requeue, []
+                for job_id in retries:
+                    self._handle(("submit", job_id))
+                self._drain_tick()
+        except SystemExit:
+            # injected `route`/`gateway` die: ends THIS thread only —
+            # /healthz's dispatcher probe goes false, replicas run on
+            return
+
+    def _handle(self, cmd: tuple) -> None:
+        kind = cmd[0]
+        if kind == "submit":
+            with self.jobs_lock:
+                job = self.jobs.get(cmd[1])
+            if job is not None and not job.terminal():
+                if job.cancel_requested:
+                    # cancelled while waiting for placement: settle
+                    # locally, nothing to route
+                    job.state = "cancelled"
+                    self._settle(job)
+                    return
+                if job.place_attempts == 0:   # not a requeue retry
+                    self.registry.counter("fleet.jobs_accepted").inc()
+                if job.place_started is None:
+                    job.place_started = self.now()
+                self._place(job)
+        elif kind == "cancel":
+            self._cancel(cmd[1])
+        elif kind == "drain":
+            self.registry.gauge("serve.draining").set(1.0)
+        elif kind == "failover":
+            self._failover(cmd[1])
+        # "wake" and anything else: just a loop tick
+
+    def _place(self, job: GatewayJob, exclude: tuple = ()) -> None:
+        """Route + submit one job, failing over across replicas until
+        placed or nothing remains."""
+        try:
+            job.bucket = bucket_key_from_counts(*job.counts,
+                                                spec=self.spec)
+            handle = self.router.route(job.bucket, exclude=exclude)
+        except NoReplicaError as e:
+            self._fail(job, str(e))
+            return
+        except faults.FaultInjected as e:
+            self._fail(job, f"routing fault: {e}")
+            return
+        job.place_attempts += 1
+
+        def send():
+            # DATA-plane timeout: the payload can be a multi-MB
+            # problem JSON; the 2 s probe budget is for gauges.
+            # Any attempt after the first is an idempotent RESEND
+            # (the earlier one may have landed and lost its reply) —
+            # only then is a replica's 409 'already have it' success.
+            idem = job.sent_any
+            job.sent_any = True
+            return handle.post_job(job.payload,
+                                   timeout=self.cfg.io_timeout,
+                                   idempotent=idem)
+
+        try:
+            retry_transient(send, attempts=self.cfg.route_retries,
+                            wait_s=self.cfg.retry_wait_s, backoff=2.0,
+                            max_wait_s=2.0)
+        except Exception as e:
+            from timetabling_ga_tpu.runtime.retry import is_transient
+            started = (job.place_started if job.place_started
+                       is not None else self.now())
+            if (is_transient(e) and self.now() - started
+                    < self.cfg.place_timeout):
+                # a replica still booting or mid-restart: requeue —
+                # retried once per poll tick (the deferred list, not
+                # the inbox) rather than burning the exclusion list on
+                # a process that is paying its jax import (a spawned
+                # worker takes many seconds before it binds its port).
+                # The window is anchored at THIS placement round, so
+                # failover after a long run gets the full budget.
+                self._requeue.append(job.id)
+                return
+            remaining = [h for h in self.replicas.live()
+                         if h.name not in exclude
+                         and h.name != handle.name]
+            if remaining:
+                self._place(job, exclude + (handle.name,))
+            else:
+                self._fail(job, f"no replica accepted job: "
+                                f"{str(e)[:200]}")
+            return
+        job.replica = handle.name
+        job.state = "routed"
+        self.registry.counter("fleet.jobs_routed").inc()
+
+    def _cancel(self, job_id: str) -> None:
+        with self.jobs_lock:
+            job = self.jobs.get(job_id)
+        if job is None or job.terminal():
+            return
+        # remembered across failover: a job cancelled while its
+        # replica is dying must NOT be resubmitted and solved to
+        # completion — _failover and the requeue path check this flag
+        job.cancel_requested = True
+        if job.replica is None:
+            job.state = "cancelled"
+            self._settle(job)
+            return
+        handle = self.replicas.get(job.replica)
+        if handle is not None:
+            try:
+                handle.cancel_job(job.id,
+                                  timeout=self.cfg.probe_timeout)
+            except Exception:
+                pass           # polls (or failover) settle the state
+
+    def _poll_jobs(self) -> None:
+        """Refresh the cached job table from the owning replicas —
+        the ONLY place replica job state enters the gateway. The
+        steady-state poll is STATE-ONLY (`?records=0` — a long job's
+        tail would otherwise be re-serialized on every tick); the
+        record tail is fetched once the job turns terminal, and the
+        job settles when that tail carries the terminal jobEntry (the
+        replica's AsyncWriter drains asynchronously, so state can
+        lead the records by a beat). An over-cap ring tail or an
+        exhausted settle budget settles with `records_truncated`
+        marked — visible, never a silently frozen partial stream."""
+        with self.jobs_lock:
+            jobs = [j for j in self.jobs.values()
+                    if j.replica is not None
+                    and not (j.terminal() and j.records_final)]
+        by_replica: dict = {}
+        for job in jobs:
+            by_replica.setdefault(job.replica, []).append(job)
+        for name, group in by_replica.items():
+            handle = self.replicas.get(name)
+            if handle is None or handle.dead:
+                continue           # prober + failover own this case
+            try:
+                states = handle.list_jobs(
+                    timeout=self.cfg.probe_timeout)
+            except Exception:
+                continue           # prober decides life and death
+            for job in group:
+                info = states.get(job.id)
+                if info is None:
+                    # a LIVE replica that does not know the job: it
+                    # restarted inside the dead_after window and lost
+                    # its state — per-job failover, because the
+                    # prober sees a healthy process and will never
+                    # declare it dead
+                    self._reassign(job)
+                    continue
+                state = info.get("state")
+                if not state or state not in TERMINAL:
+                    if state:
+                        job.state = state
+                    continue
+                # the replica reports terminal — but the gateway view
+                # must not SAY so until the record tail is cached, or
+                # a fast client reads `done` with an empty stream;
+                # state and records publish together at settle
+                try:
+                    full = handle.get_job(
+                        job.id, timeout=self.cfg.io_timeout)
+                except Exception:
+                    continue
+                job.result = full.get("result", job.result)
+                job.error = full.get("error", job.error)
+                records = full.get("records") or []
+                complete = any(
+                    rec.get("jobEntry", {}).get("event") in TERMINAL
+                    for rec in records)
+                truncated = bool(full.get("records_truncated"))
+                job.extra_polls += 1
+                if complete or truncated or job.extra_polls >= 50:
+                    job.records = records
+                    job.state = state
+                    job.records_truncated = truncated or not complete
+                    self._settle(job)
+
+    def _on_death(self, handle, respawned: bool) -> None:
+        """ReplicaSet prober callback (PROBER thread): only enqueue —
+        router/job state is touched exclusively on the dispatcher.
+        A respawned worker comes back cold, so its jobs fail over
+        exactly like a dead one's (the handle stays live and may win
+        them back)."""
+        self.inbox.put(("failover", handle.name))
+
+    def _failover(self, name: str) -> None:
+        """A replica died (prober callback, via the inbox — so router
+        state is only ever touched on this thread): forget its pins
+        and warmth, then resubmit every unfinished job it owned.
+        Idempotent by job id: the payload (id, seed, generation
+        budget) replays verbatim, partial record tails are discarded,
+        and the fresh solve's stream replaces them wholesale — the
+        client observes exactly one completion with exactly one record
+        stream. A job that COMPLETED on the dead replica but whose
+        records the polls had not finished caching is replayed too:
+        the stream is a pure function of the job, so the replay emits
+        the identical records the lost copy held."""
+        self.router.on_replica_dead(name)
+        with self.jobs_lock:
+            victims = [j for j in self.jobs.values()
+                       if j.replica == name
+                       and not (j.terminal() and j.records_final)]
+        for job in victims:
+            self._reassign(job)
+
+    def _reassign(self, job: GatewayJob) -> None:
+        """One job's failover: discard the lost copy's partial
+        records and replay the payload through a fresh routing — or
+        honor a pending cancel (the replica that would have solved
+        the rest is gone anyway)."""
+        if job.cancel_requested:
+            job.state = "cancelled"
+            self._settle(job)
+            return
+        job.records = []
+        job.records_final = False
+        job.records_truncated = False
+        job.replica = None
+        job.state = "accepted"
+        job.extra_polls = 0
+        job.place_started = self.now()       # fresh placement budget
+        self.registry.counter("fleet.jobs_failed_over").inc()
+        self._place(job)
+
+    def _settle(self, job: GatewayJob) -> None:
+        """A job is terminal AND its records are cached: final
+        accounting, then retention — the payload (the whole `.tim`
+        text, kept only for failover replay) is released, and settled
+        jobs beyond `--retain-terminal` are evicted oldest-first (a
+        long-running gateway must not hold every instance it ever
+        served; an evicted id answers 404)."""
+        job.records_final = True
+        if job.finished_t is None:
+            job.finished_t = self.now()
+        job.payload = None
+        job.counts = None
+        if not job.counted:
+            job.counted = True
+            name = ("fleet.jobs_done" if job.state == "done"
+                    else "fleet.jobs_failed")
+            self.registry.counter(name).inc()
+            self.registry.histogram("fleet.job_seconds").observe(
+                job.finished_t - job.submitted_t,
+                exemplar={"job": job.id})
+        self._terminal_order.append(job.id)
+        while len(self._terminal_order) > self.cfg.retain_terminal:
+            evicted = self._terminal_order.pop(0)
+            with self.jobs_lock:
+                self.jobs.pop(evicted, None)
+
+    def _fail(self, job: GatewayJob, reason: str) -> None:
+        job.state = "failed"
+        job.error = reason
+        self._settle(job)
+
+    def _drain_tick(self) -> None:
+        if not self.draining or self.drained.is_set():
+            return
+        with self.jobs_lock:
+            active = [j for j in self.jobs.values()
+                      if not (j.terminal() and j.records_final)]
+        if active or not self.inbox.empty():
+            return
+        # every job settled AND its records are cached — only now may
+        # owned replicas drain (they exit after draining; a replica
+        # that exits before the gateway cached its tails would lose
+        # them)
+        if self.owned:
+            self.replicas.stop_restarts()
+            for handle in self.replicas.live():
+                try:
+                    handle.drain(timeout=self.cfg.probe_timeout)
+                except Exception:
+                    pass
+        self.drained.set()
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def main_fleet(argv) -> int:
+    """`tt fleet` entry point (cli.py dispatches here). Runs until a
+    POST /v1/drain (or SIGTERM/SIGINT, mapped to the same drain)
+    completes."""
+    import signal
+
+    cfg = parse_fleet_args(argv)
+    from timetabling_ga_tpu.fleet import replicas as replicas_mod
+    if cfg.spawn:
+        handles = replicas_mod.spawn_local(cfg)
+    else:
+        handles = [replicas_mod.ReplicaHandle(f"r{i}", url)
+                   for i, url in enumerate(cfg.replicas)]
+    gw = Gateway(cfg, handles, owned=bool(cfg.spawn))
+    gw.start()
+    print(f"# tt fleet: gateway on {gw.url} fronting "
+          f"{len(handles)} replica(s): "
+          f"{', '.join(h.url for h in handles)}",
+          file=sys.stderr, flush=True)
+
+    def _drain(signum, frame):
+        print("# tt fleet: drain requested", file=sys.stderr,
+              flush=True)
+        gw.request_drain()
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)
+    try:
+        while not gw.drained.wait(0.5):
+            pass
+    finally:
+        gw.close()
+    return 0

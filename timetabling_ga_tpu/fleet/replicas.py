@@ -1,0 +1,890 @@
+"""Replica-set management: drive loops, probes, restart, drain.
+
+Three replica shapes, one gateway-side view:
+
+  Replica       turns a SolveService into an HTTP replica: a DRIVE
+                LOOP thread owns every device call (admission prepare,
+                scheduler steps, cancellation fences) and consumes a
+                command inbox the HTTP handlers feed — the handler
+                threads themselves only enqueue and read (TT605).
+                Used in-process (tests, bench, programmatic fleets)
+                via `.start()`, or as the `tt serve --http` foreground
+                process via `.run()`.
+  spawn_local   `tt fleet --spawn N`: one `tt serve --http` worker
+                process per replica on a local port, with a respawn
+                closure the prober uses for restart-on-death.
+  ReplicaHandle the gateway's client-side view of ANY replica (remote
+                URL, spawned process, or in-process): submit / poll /
+                cancel / drain calls plus the probe state the router
+                reads (readiness reasons, backlog gauge, compile-hit
+                counters).
+
+ReplicaSet owns the probe thread: every `probe_every` seconds it
+refreshes each handle's `/readyz` JSON and `/metrics` families, and
+after `dead_after` consecutive failed probes (or a reaped worker
+process) either respawns the worker (restart-on-death, bounded by
+`max_restarts`) or declares the replica dead — both reported through
+`on_death`, which the gateway turns into failover.
+
+Drain order matters: a draining replica finishes its PARKED jobs
+first (the drive loop keeps stepping until the queue has no active
+job), then closes its service — so the writer drains, the record
+stream completes, and only then does the process exit. `/readyz`
+reports `draining` the whole time so routers stop sending work
+(obs/http.py readiness).
+"""
+
+from __future__ import annotations
+
+import collections
+import io
+import itertools
+import json
+import os
+import queue as queue_mod
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from timetabling_ga_tpu.fleet.gateway import TERMINAL, ApiHandler
+from timetabling_ga_tpu.obs import http as obs_http
+from timetabling_ga_tpu.runtime import jsonl
+from timetabling_ga_tpu.runtime.config import FleetConfig, ServeConfig
+
+# per-job record-tail bound on a replica: GET /v1/jobs/<id> serves at
+# most this many records (a fleet job's stream is a handful of
+# logEntries + lifecycle records; 4096 only guards a pathological
+# tenant from holding the replica's memory)
+TAIL_CAP = int(os.environ.get("TT_FLEET_TAIL_CAP", "4096"))
+# how many JOBS keep a tail (and how many rejected-submission entries
+# the front index keeps): beyond this the oldest are evicted — a
+# long-running replica must not hold every record tail it ever served
+# (the gateway has the same policy as --retain-terminal)
+TAIL_JOBS = int(os.environ.get("TT_FLEET_TAIL_JOBS", "4096"))
+
+
+# ------------------------------------------------------------- HTTP client
+
+
+class FleetHTTPError(RuntimeError):
+    """Non-OK HTTP status from a replica/gateway."""
+
+    def __init__(self, status: int, url: str, detail):
+        self.status = status
+        self.detail = detail
+        super().__init__(f"HTTP {status} from {url}: "
+                         f"{str(detail)[:200]}")
+
+
+def http_json(method: str, url: str, obj=None, timeout: float = 5.0,
+              ok: tuple = (200, 202)):
+    """One JSON-in/JSON-out HTTP call (stdlib urllib). 4xx/5xx bodies
+    are parsed too; statuses outside `ok` raise FleetHTTPError with
+    the parsed detail attached."""
+    data = None
+    headers = {}
+    if obj is not None:
+        data = json.dumps(obj).encode()
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            status = resp.status
+            body = resp.read()
+    except urllib.error.HTTPError as e:
+        status = e.code
+        body = e.read()
+    try:
+        parsed = json.loads(body) if body else {}
+    except ValueError:
+        parsed = {"raw": body.decode("utf-8", "replace")[:200]}
+    if status not in ok:
+        raise FleetHTTPError(status, url, parsed)
+    return parsed
+
+
+def http_text(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
+# ------------------------------------------------------------ record tail
+
+
+class JobTail:
+    """Out-stream tee keeping a per-job tail of job-tagged records.
+
+    Sits between the service's AsyncWriter and the real output stream:
+    every line still reaches the stream byte-identically (the tee adds
+    no records and reorders nothing), and each parsed record carrying
+    a `job` tag lands in that job's tail, which GET /v1/jobs/<id>
+    serves. Runs on the WRITER thread (the parse cost rides the
+    off-dispatch-path worker, like every other serialization cost)."""
+
+    def __init__(self, stream, cap: int = TAIL_CAP,
+                 max_jobs: int = TAIL_JOBS):
+        self._stream = stream
+        self._cap = cap
+        self._max_jobs = max_jobs
+        self._buf = ""
+        self._tails: dict = {}       # insertion-ordered: FIFO evict
+        self._counts: dict = {}      # records INGESTED per job — a
+        #                              ring holding exactly cap
+        #                              records is only truncated if
+        #                              MORE than cap ever arrived
+        self._lock = threading.Lock()
+
+    # -- stream protocol (AsyncWriter's view) ---------------------------
+
+    def write(self, s: str) -> None:
+        self._stream.write(s)
+        self._buf += s
+        while "\n" in self._buf:
+            line, self._buf = self._buf.split("\n", 1)
+            self._ingest(line)
+
+    def flush(self) -> None:
+        self._stream.flush()
+
+    # -- tail store -----------------------------------------------------
+
+    def _ingest(self, line: str) -> None:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            return
+        if not isinstance(rec, dict) or not rec:
+            return
+        kind = next(iter(rec))
+        body = rec.get(kind)
+        job = body.get("job") if isinstance(body, dict) else None
+        if job is None:
+            return
+        with self._lock:
+            tail = self._tails.get(str(job))
+            if tail is None:
+                # a bounded RING per job — an over-cap stream keeps
+                # its LAST records, so the terminal jobEntry (what
+                # settle logic and clients need most) always survives
+                # truncation; only the prefix is lost
+                tail = collections.deque(maxlen=self._cap)
+                self._tails[str(job)] = tail
+            tail.append(rec)
+            self._counts[str(job)] = self._counts.get(str(job), 0) + 1
+            while len(self._tails) > self._max_jobs:
+                # oldest job's tail goes (dict insertion order): the
+                # stream itself is the durable copy; the tail only
+                # feeds GET /v1/jobs/<id>
+                evicted = next(iter(self._tails))
+                self._tails.pop(evicted)
+                self._counts.pop(evicted, None)
+
+    def tail(self, job_id: str) -> list:
+        with self._lock:
+            return list(self._tails.get(str(job_id), ()))
+
+    def truncated(self, job_id: str) -> bool:
+        """True when the ring actually DROPPED records (more arrived
+        than it holds) — a records-identity comparison cannot hold.
+        A stream of exactly cap records is complete, not truncated."""
+        with self._lock:
+            t = self._tails.get(str(job_id))
+            return (t is not None
+                    and self._counts.get(str(job_id), 0) > len(t))
+
+
+# ----------------------------------------------------------- the replica
+
+
+def payload_problem(payload: dict):
+    """Parse a submit payload into a Problem — the FULL parse, on the
+    replica that solves it (the gateway only ever reads the header)."""
+    from timetabling_ga_tpu.problem import load_tim
+    kw = {}
+    if "n_days" in payload:
+        kw["n_days"] = int(payload["n_days"])
+    if "slots_per_day" in payload:
+        kw["slots_per_day"] = int(payload["slots_per_day"])
+    if "problem" in payload:
+        return problem_from_json(payload["problem"])
+    return load_tim(str(payload["tim"]), **kw)
+
+
+def problem_from_json(obj: dict):
+    """Pre-parsed problem JSON -> Problem (the POST /v1/solve
+    `{"problem": {...}}` form): raw counts + the four reference
+    arrays; derived matrices are recomputed here, never trusted from
+    the wire."""
+    import numpy as np
+
+    from timetabling_ga_tpu.problem import (
+        DAYS_DEFAULT, SLOTS_PER_DAY_DEFAULT, derive)
+    try:
+        E, R, F, S = (int(obj[k]) for k in (
+            "n_events", "n_rooms", "n_features", "n_students"))
+        return derive(
+            E, R, F, S,
+            np.asarray(obj["room_size"], np.int32),
+            np.asarray(obj["attends"], np.int8),
+            np.asarray(obj["room_features"], np.int8),
+            np.asarray(obj["event_features"], np.int8),
+            n_days=int(obj.get("n_days", DAYS_DEFAULT)),
+            slots_per_day=int(obj.get("slots_per_day",
+                                      SLOTS_PER_DAY_DEFAULT)))
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"bad problem JSON: {e}") from None
+
+
+def problem_to_json(problem) -> dict:
+    """Problem -> the wire form problem_from_json accepts."""
+    import numpy as np
+    return {"n_events": problem.n_events, "n_rooms": problem.n_rooms,
+            "n_features": problem.n_features,
+            "n_students": problem.n_students,
+            "n_days": problem.n_days,
+            "slots_per_day": problem.slots_per_day,
+            "room_size": np.asarray(problem.room_size).tolist(),
+            "attends": np.asarray(problem.attends).tolist(),
+            "room_features":
+                np.asarray(problem.room_features).tolist(),
+            "event_features":
+                np.asarray(problem.event_features).tolist()}
+
+
+class ReplicaApi:
+    """The replica front's handler surface: enqueue-or-read-only
+    (TT605). Submissions and cancellations become inbox commands the
+    drive loop executes at its next control fence; job views read the
+    queue's job table and the record tail directly."""
+
+    def __init__(self, replica: "Replica"):
+        self._r = replica
+
+    def accept_solve(self, payload: dict):
+        r = self._r
+        if r.draining:
+            return 503, {"error": "draining", "reasons": ["draining"]}
+        if not r.driving():
+            return 503, {"error": "drive loop down"}
+        with r.index_lock:
+            job_id = str(payload.get("id")
+                         or f"{r.name}-{next(r.auto_id)}")
+            if job_id in r.index or job_id in r.svc.queue:
+                return 409, {"error": "duplicate job id", "id": job_id}
+            r.index[job_id] = {"state": "accepted"}
+        r.inbox.put(("submit", job_id, dict(payload, id=job_id)))
+        return 202, {"id": job_id, "state": "accepted"}
+
+    def job_view(self, job_id: str, with_records: bool = True):
+        r = self._r
+        try:
+            job = r.svc.queue.get(job_id)
+        except KeyError:
+            job = None
+        if job is None:
+            with r.index_lock:
+                info = r.index.get(job_id)
+            if info is None:
+                return 404, {"error": f"unknown job {job_id!r}"}
+            view = {"id": job_id, "state": info["state"],
+                    "error": info.get("error"), "result": None}
+        else:
+            view = {"id": job_id, "state": job.state,
+                    "gens": job.gens_done, "error": job.error,
+                    "result": job.result}
+        if with_records:
+            # serializing a long tail is the expensive part of this
+            # view — ?records=0 (the gateway's steady-state poll)
+            # skips it and fetches the tail once, at terminal
+            view["records"] = r.tail.tail(job_id)
+            view["records_truncated"] = r.tail.truncated(job_id)
+        return 200, view
+
+    def jobs_view(self):
+        """Bulk STATE-ONLY view of every job this replica knows — one
+        response serves the gateway's whole steady-state poll tick
+        for this replica (no record tails, no results: those are
+        fetched per job, once, at terminal). Read order matters: the
+        INDEX first, then the queue (which overrides) — a submission
+        is in the index until AFTER it enters the queue, so it can
+        never be absent from both; the other order has a window the
+        gateway would misread as 'replica lost the job' and fail
+        over, double-solving it."""
+        r = self._r
+        out = {}
+        with r.index_lock:
+            for job_id, info in r.index.items():
+                out[job_id] = {"state": info["state"]}
+        for job in list(r.svc.queue._jobs.values()):
+            out[job.id] = {"state": job.state, "gens": job.gens_done}
+        return 200, {"jobs": out}
+
+    def accept_cancel(self, job_id: str):
+        r = self._r
+        known = job_id in r.svc.queue
+        if not known:
+            with r.index_lock:
+                known = job_id in r.index
+        if not known:
+            return 404, {"error": f"unknown job {job_id!r}"}
+        r.inbox.put(("cancel", job_id))
+        return 202, {"id": job_id, "cancelling": True}
+
+    def accept_drain(self):
+        r = self._r
+        r.inbox.put(("drain",))
+        return 200, {"draining": True,
+                     "active": len(r.svc.queue.active())}
+
+    def fleet_view(self):
+        return 404, {"error": "not a gateway (single replica)"}
+
+
+class Replica:
+    """One HTTP replica: SolveService + drive loop + `/v1` front.
+
+    The drive loop is the ONLY thread that touches the device: it
+    admits parsed submissions (pad + place), steps the scheduler one
+    dispatch at a time, honors cancellations at control fences, and —
+    once draining — runs the queue dry before closing the service
+    (parked jobs finish; the writer drains; the record stream
+    completes). `kill()` is the test double for a crashed replica:
+    the loop stops dead, nothing finalizes, the front goes silent."""
+
+    def __init__(self, cfg: ServeConfig, name: str = "replica",
+                 out=None, registry=None, now=None):
+        import dataclasses
+
+        # deferred: this is the one fleet entry point that pulls in
+        # the solver stack (jax) — gateways and clients never do
+        from timetabling_ga_tpu.serve.service import SolveService
+        self.name = name
+        self.cfg = cfg
+        base = out
+        self._close_base = False
+        if base is None:
+            if cfg.output:
+                # APPEND: restart-on-death respawns a worker with the
+                # same -o path — truncating would wipe the dead
+                # incarnation's completed jobs from the only durable
+                # record log
+                base = open(cfg.output, "a")
+                self._close_base = True
+            else:
+                # stdout, like line-JSON `tt serve`: a long-lived
+                # replica must stream its records somewhere durable,
+                # never accumulate them in memory (in-process test
+                # replicas pass an explicit buffer instead)
+                base = sys.stdout
+        self.tail = JobTail(base)
+        self.svc = SolveService(
+            dataclasses.replace(cfg, output=None), out=self.tail,
+            now=now, registry=registry)
+        self.inbox = queue_mod.Queue()
+        self.index: dict = {}        # pre-admission / rejected states
+        self.index_lock = threading.Lock()
+        self.auto_id = itertools.count(1)
+        self.draining = False
+        self._reaped: list = []      # terminal ids, oldest first —
+        #                              heavy refs released, then
+        #                              forgotten beyond TAIL_JOBS
+        self._signal_drain = False   # set by signal handlers (a bare
+        #                              store: handlers run on the main
+        #                              thread mid-bytecode and must
+        #                              take NO locks — inbox.put could
+        #                              deadlock against the drive
+        #                              loop's own inbox.get)
+        self.drained = threading.Event()
+        self._killed = False
+        self._thread = None
+        self.front = None
+        if cfg.http:
+            self.front = obs_http.ObsServer(
+                cfg.http, registry=self.svc.registry,
+                probes={"process": lambda: True,
+                        "writer": self.svc.writer.alive,
+                        "drive": self.driving},
+                profile=self.svc.profile_capture,
+                handler=ApiHandler, api=ReplicaApi(self)).start()
+
+    @property
+    def url(self) -> str:
+        return self.front.url
+
+    def driving(self) -> bool:
+        """True while the drive loop can still make progress: before
+        start() (foreground run() pending) or while the thread/loop
+        lives."""
+        if self._killed or self.drained.is_set():
+            return False
+        return self._thread is None or self._thread.is_alive()
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "Replica":
+        """In-process mode: drive loop on a daemon thread."""
+        self._thread = threading.Thread(
+            target=self.run, name=f"tt-replica-{self.name}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def drain(self) -> None:
+        self.inbox.put(("drain",))
+
+    def stop(self, timeout: float = 120.0) -> None:
+        """Graceful stop: drain, wait for the loop to finish, close
+        the front."""
+        self.drain()
+        self.drained.wait(timeout)
+        if self.front is not None:
+            self.front.close()
+
+    def kill(self) -> None:
+        """Simulate replica death (tests/bench): the drive loop exits
+        WITHOUT finalizing or closing the service — running jobs
+        freeze mid-flight, exactly like a crashed process — and the
+        front stops answering, so the gateway's prober declares the
+        replica dead and fails its jobs over."""
+        self._killed = True
+        if self.front is not None:
+            self.front.close()
+        self.inbox.put(("wake",))
+
+    # -- the drive loop -------------------------------------------------
+
+    def run(self) -> None:
+        """Drive until drained (or killed). Foreground entry point for
+        `tt serve --http`; start() wraps it in a thread."""
+        try:
+            while not self._killed:
+                try:
+                    if self._signal_drain and not self.draining:
+                        self._set_draining()
+                    try:
+                        cmd = self.inbox.get_nowait()
+                    except queue_mod.Empty:
+                        cmd = None
+                    if cmd is not None:
+                        self._handle(cmd)
+                        continue
+                    if self.draining and not self.svc.queue.active():
+                        break
+                    busy = False
+                    if self.svc.queue.ready():
+                        busy = bool(self.svc.step())
+                    self._reap_terminal()
+                    if not busy:
+                        try:
+                            self._handle(
+                                self.inbox.get(timeout=0.05))
+                        except queue_mod.Empty:
+                            pass
+                except KeyboardInterrupt:
+                    # foreground mode: ^C = drain request, not a crash
+                    self._set_draining()
+        finally:
+            if not self._killed:
+                try:
+                    self.svc.close()
+                except Exception:
+                    pass
+                if self._close_base:
+                    try:
+                        self.tail._stream.close()
+                    except Exception:
+                        pass
+            self.drained.set()
+
+    def _handle(self, cmd: tuple) -> None:
+        kind = cmd[0]
+        if kind == "submit":
+            job_id, payload = cmd[1], cmd[2]
+            try:
+                problem = payload_problem(payload)
+                self.svc.submit(
+                    problem, job_id=job_id,
+                    priority=int(payload.get("priority", 0)),
+                    seed=payload.get("seed"),
+                    generations=payload.get("generations"),
+                    deadline_s=payload.get("deadline"))
+                with self.index_lock:
+                    self.index.pop(job_id, None)
+            except Exception as e:
+                # mirror the line-JSON protocol: any submit-side
+                # failure is a rejection record and the replica
+                # continues — one bad tenant never takes it down
+                jsonl.job_entry(self.svc.writer, job_id, "rejected",
+                                reason=str(e)[:200])
+                with self.index_lock:
+                    self.index[job_id] = {"state": "rejected",
+                                          "error": str(e)[:200]}
+                    while len(self.index) > TAIL_JOBS:
+                        # bounded like the tails: rejected entries of
+                        # a long-running replica must not accumulate
+                        self.index.pop(next(iter(self.index)))
+        elif kind == "cancel":
+            self.svc.cancel(cmd[1])
+        elif kind == "drain":
+            self._set_draining()
+        # "wake": loop tick only
+
+    def _reap_terminal(self) -> None:
+        """Release terminal jobs' heavy references — the padded
+        device arrays, derived problem matrices, and any lingering
+        host snapshot — the moment they settle (the result dict and
+        record tail keep serving GET /v1/jobs), then FORGET the
+        oldest settled jobs beyond TAIL_JOBS. Without this a
+        long-running replica pins every job it ever solved in HBM —
+        the exact unbounded retention the gateway's
+        --retain-terminal exists to prevent."""
+        for job in list(self.svc.queue._jobs.values()):
+            if job.state in TERMINAL and job.pa_dev is not None:
+                job.pa_dev = None
+                job.padded = None
+                job.problem = None
+                job.snapshot = None
+                self._reaped.append(job.id)
+        while len(self._reaped) > TAIL_JOBS:
+            self.svc.queue.forget(self._reaped.pop(0))
+
+    def _set_draining(self) -> None:
+        if not self.draining:
+            self.draining = True
+            # drive-loop-side registry write (handlers may not):
+            # /readyz now reports `draining` until the process exits
+            self.svc.registry.gauge("serve.draining").set(1.0)
+
+
+def serve_http(cfg: ServeConfig) -> int:
+    """`tt serve --http HOST:PORT` foreground mode (service.main_serve
+    dispatches here): one replica, drive loop on the main thread,
+    SIGTERM/SIGINT mapped to graceful drain."""
+    import signal
+
+    replica = Replica(cfg)
+    print(f"# tt serve --http: replica on {replica.url}",
+          file=sys.stderr, flush=True)
+
+    def _drain(signum, frame):
+        # lock-free by design: the handler interrupts the drive loop's
+        # own thread, so queue/registry locks here could self-deadlock;
+        # the loop reads the flag at its next iteration
+        replica._signal_drain = True
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)
+    replica.run()
+    if replica.front is not None:
+        replica.front.close()
+    return 0
+
+
+# ----------------------------------------------------- gateway-side view
+
+
+class ReplicaHandle:
+    """The gateway's client-side view of one replica: HTTP verbs plus
+    the probe state the router scores on. Probe fields are written by
+    the ReplicaSet's prober thread and read by the dispatcher — plain
+    attribute stores, coherent enough for routing (a stale gauge
+    costs a suboptimal placement, never a wrong result)."""
+
+    def __init__(self, name: str, url: str, proc=None, respawn=None):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.proc = proc             # subprocess.Popen for spawned
+        self.respawn = respawn       # zero-arg -> fresh Popen
+        self.restarts = 0
+        self.fails = 0               # consecutive failed probes
+        self.dead = False
+        self.ok_once = False         # ever answered a probe
+        self.born = time.monotonic()  # (re)spawn time: boot grace
+        # -- router inputs (refreshed by probe()) -----------------------
+        self.ready = False
+        self.reasons: list = ["unprobed"]
+        self.queue_depth = None
+        self.backlog = None
+        self.compile_count = 0.0
+        self.compile_cache_hits = 0.0
+
+    # -- probe ----------------------------------------------------------
+
+    def probe(self, timeout: float) -> bool:
+        """One readiness + metrics scrape. Returns False only when the
+        replica is unreachable (a 503 /readyz is a HEALTHY not-ready
+        answer). The metrics families parsed are exactly the router's
+        inputs: the backlog gauge and the compile hit-rate counters."""
+        try:
+            detail = http_json("GET", self.url + "/readyz",
+                               timeout=timeout, ok=(200, 503))
+        except Exception:
+            return False
+        self.ok_once = True
+        self.ready = bool(detail.get("ready"))
+        self.reasons = list(detail.get("reasons", ()))
+        try:
+            self._scrape_metrics(timeout)
+        except Exception:
+            pass                     # gauges go stale, probe still ok
+        return True
+
+    def _scrape_metrics(self, timeout: float) -> None:
+        text = http_text(self.url + "/metrics", timeout=timeout)
+        for line in text.splitlines():
+            if line.startswith("#") or " " not in line:
+                continue
+            name, _, value = line.partition(" ")
+            try:
+                v = float(value.split()[0])
+            except ValueError:
+                continue
+            if name == "tt_serve_queue_depth":
+                self.queue_depth = v
+            elif name == "tt_serve_backlog":
+                self.backlog = v
+            elif name == "tt_compile_count_total":
+                self.compile_count = v
+            elif name == "tt_compile_cache_hits_total":
+                self.compile_cache_hits = v
+
+    def compile_hit_rate(self) -> float:
+        total = self.compile_count + self.compile_cache_hits
+        return self.compile_cache_hits / total if total > 0 else 0.0
+
+    # -- verbs ----------------------------------------------------------
+
+    def post_job(self, payload: dict, timeout: float = 5.0,
+                 idempotent: bool = False):
+        # 409 (duplicate id) is SUCCESS only for a RESEND (failover
+        # resubmission, or a retry whose first attempt landed but
+        # lost its response): the job is already there, the placement
+        # stands. On a job's very FIRST send a 409 is a genuine id
+        # collision (e.g. a replica retaining a previous gateway
+        # incarnation's job) and must surface as an error — silently
+        # adopting the old job would hand the client someone else's
+        # result.
+        ok = (200, 202, 409) if idempotent else (200, 202)
+        return http_json("POST", self.url + "/v1/solve", payload,
+                         timeout=timeout, ok=ok)
+
+    def list_jobs(self, timeout: float = 5.0):
+        """{id: {"state", ...}} for every job the replica knows —
+        the bulk poll (GET /v1/jobs)."""
+        return http_json("GET", f"{self.url}/v1/jobs",
+                         timeout=timeout, ok=(200,)).get("jobs", {})
+
+    def get_job(self, job_id: str, timeout: float = 5.0,
+                with_records: bool = True):
+        suffix = "" if with_records else "?records=0"
+        return http_json(
+            "GET",
+            f"{self.url}/v1/jobs/{urllib.parse.quote(job_id)}"
+            f"{suffix}",
+            timeout=timeout, ok=(200,))
+
+    def cancel_job(self, job_id: str, timeout: float = 5.0):
+        return http_json(
+            "DELETE",
+            f"{self.url}/v1/jobs/{urllib.parse.quote(job_id)}",
+            timeout=timeout, ok=(200, 202, 404, 409))
+
+    def drain(self, timeout: float = 5.0):
+        return http_json("POST", self.url + "/v1/drain", {},
+                         timeout=timeout, ok=(200,))
+
+    # -- process management --------------------------------------------
+
+    def process_exited(self) -> bool:
+        return self.proc is not None and self.proc.poll() is not None
+
+    def terminate(self) -> None:
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+
+    def view(self) -> dict:
+        return {"name": self.name, "url": self.url,
+                "ready": self.ready, "reasons": self.reasons,
+                "dead": self.dead, "restarts": self.restarts,
+                "queue_depth": self.queue_depth,
+                "compile_hit_rate": round(self.compile_hit_rate(), 4)}
+
+
+class ReplicaSet:
+    """Probe-thread owner over a set of handles. Detects death
+    (`dead_after` consecutive failed probes, or a reaped process),
+    respawns spawned workers within `max_restarts`, and reports every
+    death through `on_death(handle, respawned)` — the gateway's
+    failover trigger. A restarted process comes back COLD (fresh
+    compile caches, empty queue), so its jobs fail over exactly like
+    a permanently dead replica's."""
+
+    def __init__(self, handles, probe_every: float = 0.5,
+                 probe_timeout: float = 2.0, dead_after: int = 3,
+                 max_restarts: int = 0, on_death=None,
+                 boot_grace: float = 120.0):
+        self._handles = {h.name: h for h in handles}
+        self.probe_every = probe_every
+        self.probe_timeout = probe_timeout
+        self.dead_after = dead_after
+        self.max_restarts = max_restarts
+        self.on_death = on_death
+        self.boot_grace = boot_grace
+        self._no_restart = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._probe_loop, name="tt-fleet-probe",
+            daemon=True)
+
+    # -- views ----------------------------------------------------------
+
+    def all(self) -> list:
+        return list(self._handles.values())
+
+    def live(self) -> list:
+        return [h for h in self._handles.values() if not h.dead]
+
+    def get(self, name: str):
+        return self._handles.get(name)
+
+    # -- probing --------------------------------------------------------
+
+    def start(self) -> "ReplicaSet":
+        self._thread.start()
+        return self
+
+    def probe_all(self) -> None:
+        for handle in list(self._handles.values()):
+            if not handle.dead:
+                self._probe_one(handle)
+            elif handle.respawn is None and handle.proc is None:
+                # a STATIC (externally managed) replica keeps being
+                # probed after death: a network blip that failed
+                # dead_after probes must not remove a healthy process
+                # from the fleet until the gateway restarts. It
+                # rejoins COLD (its pins and warmth were dropped, its
+                # jobs failed over) on the first answered probe. A
+                # spawned worker's corpse, by contrast, stays dead —
+                # its process is reaped, nothing can answer.
+                if handle.probe(self.probe_timeout):
+                    handle.dead = False
+                    handle.fails = 0
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_every):
+            self.probe_all()
+
+    def _probe_one(self, handle: ReplicaHandle) -> None:
+        exited = handle.process_exited()
+        ok = False if exited else handle.probe(self.probe_timeout)
+        if ok:
+            handle.fails = 0
+            return
+        handle.ready = False
+        if (not exited and not handle.ok_once
+                and time.monotonic() - handle.born < self.boot_grace):
+            # still booting (a spawned worker pays a long jax import
+            # before it binds its port): unreachable is expected, not
+            # a death — declaring it dead mid-boot would kill and
+            # respawn it forever without one ever coming up
+            return
+        handle.fails += 1
+        if exited or handle.fails >= self.dead_after:
+            self._declare_dead(handle)
+
+    def _declare_dead(self, handle: ReplicaHandle) -> None:
+        respawned = False
+        if (not self._no_restart and handle.respawn is not None
+                and handle.restarts < self.max_restarts):
+            try:
+                handle.terminate()   # reap a half-dead process first
+                handle.proc = handle.respawn()
+                handle.restarts += 1
+                handle.fails = 0
+                handle.ok_once = False
+                handle.born = time.monotonic()
+                respawned = True
+            except Exception:
+                pass
+        if not respawned:
+            handle.dead = True
+        if self.on_death is not None:
+            self.on_death(handle, respawned)
+
+    def stop_restarts(self) -> None:
+        """Drain mode: replicas exiting after their drain are done,
+        not dead — stop resurrecting them."""
+        self._no_restart = True
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        for handle in self._handles.values():
+            handle.terminate()
+
+
+# ------------------------------------------------------------- spawning
+
+
+def free_port() -> int:
+    """An ephemeral local port (bind-then-release; the worker rebinds
+    it with SO_REUSEADDR a moment later)."""
+    s = socket.socket()
+    try:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+def spawn_local(cfg: FleetConfig) -> list:
+    """`tt fleet --spawn N`: one `tt serve --http` worker per replica.
+    Each worker's record stream goes to ./tt-fleet-<name>.jsonl unless
+    the passthrough serve flags already set -o; the respawn closure
+    reuses the same port, so a restarted replica keeps its URL."""
+    handles = []
+    for i in range(cfg.spawn):
+        name = f"r{i}"
+        port = free_port()
+        argv = [sys.executable, "-m", "timetabling_ga_tpu", "serve",
+                "--http", f"127.0.0.1:{port}",
+                "--backend", cfg.backend]
+        if "-o" not in cfg.serve_args:
+            argv += ["-o", f"tt-fleet-{name}.jsonl"]
+        argv += list(cfg.serve_args)
+
+        def respawn(argv=tuple(argv)):
+            return subprocess.Popen(
+                list(argv), stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+
+        handles.append(ReplicaHandle(
+            name, f"http://127.0.0.1:{port}", proc=respawn(),
+            respawn=respawn))
+    return handles
+
+
+def in_process_replica(cfg: ServeConfig, name: str, now=None
+                       ) -> tuple:
+    """An in-process replica with a PRIVATE metrics registry (so N of
+    them keep separate /readyz truths in one process) plus its
+    gateway-side handle. cfg.http must be set (use '127.0.0.1:0').
+    Records go to an in-memory buffer (tests read it back through
+    `replica.tail._stream`) unless cfg.output names a file."""
+    from timetabling_ga_tpu.obs.metrics import MetricsRegistry
+    out = io.StringIO() if not cfg.output else None
+    replica = Replica(cfg, name=name, out=out,
+                      registry=MetricsRegistry(), now=now).start()
+    return replica, ReplicaHandle(name, replica.url)

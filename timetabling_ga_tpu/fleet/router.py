@@ -1,0 +1,181 @@
+"""The bucket-affine router: send each job where its programs live.
+
+A replica's expensive asset is its compile cache: every (bucket,
+program) pair it has served cost it a multi-second XLA compile, and a
+warm bucket serves any same-bucket instance with zero compiles
+(serve/bucket.py). A router that sprays jobs round-robin pays that
+compile on EVERY replica per bucket; a bucket-affine router pays it
+once per bucket fleet-wide, then keeps landing that bucket's jobs on
+the replica that already owns the programs.
+
+Routing inputs — exactly the signals ROADMAP item 3 names, all
+refreshed by the ReplicaSet's probe thread (fleet/replicas.py), never
+fetched on the routing path itself:
+
+  /readyz      structured reasons (`backlog_full`, `near_hbm_limit`,
+               `stalled`, `draining`, ...): a not-ready replica keeps
+               its pins but receives no NEW work while any reason is
+               up — except when every live replica is not-ready, in
+               which case the least-loaded one is used anyway
+               (admission control downstream is the real gate, and
+               parking a job at the gateway forever helps nobody);
+  backlog      the `serve.queue_depth` gauge scraped from /metrics —
+               the load component of the placement score;
+  compile-hit  the measured `compile.{count,cache_hits}` families from
+               /metrics: when a bucket must be placed fresh, prefer
+               the replica whose cache already absorbs most of its
+               traffic (a high hit rate means adding one more bucket
+               costs it least marginal compile churn).
+
+Affinity bookkeeping distinguishes three outcomes per routing:
+
+  hit      the chosen replica is already WARM for the bucket (it
+           served it before) — the steady state;
+  warm-up  the bucket's FIRST landing anywhere in the fleet — the
+           unavoidable once-per-bucket compile bill, excluded from
+           the rate;
+  miss     a cold landing of a bucket the fleet has already served
+           somewhere — the job DETOURED off its warm home (not-ready
+           probe, failover exclusion) or the pin moved after a
+           death, so a second replica now pays a compile the
+           affinity policy exists to avoid. A detour never moves the
+           pin: the warm programs still live on the home, and the
+           bucket returns there the moment it probes ready again.
+
+`hit_rate()` = hits / (hits + misses): the fraction of post-warm-up
+routings that landed warm — the number the bench's `extra.fleet` leg
+and the acceptance test measure (>= 0.9 after warm-up on a stable
+fleet; a mid-stream replica death shows up here as misses, one per
+repinned bucket).
+
+Stdlib-only, single-threaded by design: only the gateway's dispatcher
+thread calls `route`, the same thread that handles failover — no lock,
+no torn affinity map.
+"""
+
+from __future__ import annotations
+
+from timetabling_ga_tpu.runtime import faults
+
+
+class NoReplicaError(RuntimeError):
+    """No live replica can take the job (all dead or excluded)."""
+
+
+class Router:
+    """Bucket -> replica placement with affinity, scoring, failover."""
+
+    def __init__(self, replica_set):
+        self._set = replica_set
+        self._pins: dict = {}        # bucket -> replica name
+        self._warm: dict = {}        # replica name -> set of buckets
+        self._seen: set = set()      # buckets routed at least once
+        self.routed = 0
+        self.hits = 0                # landed on an already-warm home
+        self.warmups = 0             # a bucket's fleet-wide first land
+        self.misses = 0              # cold landing of a known bucket
+        self.repins = 0              # pin MOVED (home left the live
+        #                              set); a transient detour is a
+        #                              miss but never a repin
+
+    # -- the decision ---------------------------------------------------
+
+    def route(self, bucket: tuple, exclude: tuple = ()):
+        """Pick the replica for one job of `bucket`. Deterministic
+        given the probe state; raises NoReplicaError when nothing live
+        remains. `exclude` removes replicas this job already failed on
+        (failover must not bounce a job back to its dead home)."""
+        # fault-injection point (runtime/faults.py `route` site): an
+        # injected hang/die parks/ends the gateway's dispatcher thread
+        # — replica dispatch loops and writer drains never wait on it
+        # (tests/test_fleet.py pins the isolation)
+        faults.maybe_fail("route")
+        live = [h for h in self._set.live() if h.name not in exclude]
+        if not live:
+            raise NoReplicaError(
+                f"no live replica for bucket {bucket} "
+                f"(excluded: {list(exclude)})")
+        ready = [h for h in live if h.ready]
+        pool = ready or live     # degraded fleet: least-bad placement
+        pinned = self._pins.get(bucket)
+        if pinned is not None:
+            handle = next((h for h in pool if h.name == pinned), None)
+            if handle is not None:
+                return self._account(bucket, handle)
+            # the home is unusable RIGHT NOW. If it is still in the
+            # live set — merely not-ready, or excluded for THIS job
+            # by a failover — the job detours but the PIN STAYS: a
+            # single backlog_full probe (or one refused send) must
+            # not migrate a bucket whose warm programs still live
+            # there. Only a home gone from the live set entirely
+            # (death-callback race) moves the pin here; outright
+            # deaths clear their pins in on_replica_dead.
+            fallback = min(pool, key=self._score)
+            if not any(h.name == pinned
+                       for h in self._set.live()):
+                self._pins[bucket] = fallback.name
+                self.repins += 1
+            return self._account(bucket, fallback)
+        handle = min(pool, key=self._score)
+        self._pins[bucket] = handle.name
+        return self._account(bucket, handle)
+
+    def _account(self, bucket: tuple, handle):
+        """Affinity bookkeeping for one placement (module docstring:
+        hit / warm-up / miss)."""
+        warm = bucket in self._warm.setdefault(handle.name, set())
+        self.routed += 1
+        if warm:
+            self.hits += 1
+        elif bucket in self._seen:
+            self.misses += 1       # known bucket forced onto a cold
+            #                        replica — the affinity failure mode
+            self._warm[handle.name].add(bucket)
+        else:
+            self.warmups += 1      # unavoidable once-per-bucket compile
+            self._warm[handle.name].add(bucket)
+        self._seen.add(bucket)
+        return handle
+
+    def _score(self, handle) -> tuple:
+        """Placement score for a bucket with no usable pin: fewest
+        queued jobs first (the backlog gauge), then fewest pinned
+        buckets (spread fresh buckets across the fleet even before
+        the load gauges move — probes refresh at probe cadence, jobs
+        can arrive faster), then the WARMEST cache (measured
+        compile-hit rate — adding a bucket there costs the least
+        marginal compile churn), then name for determinism."""
+        depth = handle.queue_depth
+        if depth is None or depth != depth:
+            depth = 0.0
+        pinned_here = sum(1 for r in self._pins.values()
+                          if r == handle.name)
+        return (depth, pinned_here, -handle.compile_hit_rate(),
+                handle.name)
+
+    # -- failover hooks -------------------------------------------------
+
+    def on_replica_dead(self, name: str) -> None:
+        """Forget a dead replica: its pins move on their next routing
+        (counted as repins there) and its warm set is gone — a
+        restarted process starts cold."""
+        self._warm.pop(name, None)
+        for bucket in [b for b, r in self._pins.items() if r == name]:
+            del self._pins[bucket]
+
+    # -- accounting -----------------------------------------------------
+
+    def hit_rate(self) -> float:
+        """Post-warm-up affinity: of the routings that COULD have
+        landed warm (everything but each bucket's fleet-wide first),
+        the fraction that did."""
+        eligible = self.hits + self.misses
+        return self.hits / eligible if eligible > 0 else 1.0
+
+    def stats(self) -> dict:
+        return {"routed": self.routed, "affinity_hits": self.hits,
+                "warmups": self.warmups, "misses": self.misses,
+                "repins": self.repins,
+                "affinity_hit_rate": round(self.hit_rate(), 4),
+                "pins": {str(list(b)): r
+                         for b, r in sorted(self._pins.items())}}

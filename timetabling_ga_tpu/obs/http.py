@@ -68,15 +68,15 @@ def parse_listen(spec: str) -> tuple[str, int]:
     host, sep, port_s = str(spec).rpartition(":")
     if not sep or not host:
         raise ValueError(
-            f"--obs-listen wants HOST:PORT, got {spec!r}")
+            f"listen spec wants HOST:PORT, got {spec!r}")
     try:
         port = int(port_s)
     except ValueError:
         raise ValueError(
-            f"--obs-listen port must be an integer, got {port_s!r}"
+            f"listen port must be an integer, got {port_s!r}"
         ) from None
     if not 0 <= port <= 65535:
-        raise ValueError(f"--obs-listen port out of range: {port}")
+        raise ValueError(f"listen port out of range: {port}")
     return host, port
 
 
@@ -100,11 +100,22 @@ def readiness(registry) -> tuple[bool, dict]:
         detector: the run has plateaued with a collapsed population —
         obs/quality.py StallDetector; the gauge clears when a new best
         lands or the auto-kick fires, so the reason is live, not a
-        one-way trip).
+        one-way trip);
+      - `serve.draining` >= 1 (a fleet drain is in flight — the
+        replica finishes its parked jobs but admits nothing new, so
+        the router must stop sending work; fleet/replicas.py sets the
+        gauge from the drive loop when a `/v1/drain` lands).
 
     Absent gauges (an engine run has no serve queue; a serve process
     may never have set the ladder; no memory poller on CPU) are simply
-    not conditions."""
+    not conditions.
+
+    The body is structured JSON (content-type application/json):
+    `{"ready": bool, "reasons": [...], ...}` with one context key per
+    condition — the fleet router (fleet/router.py) PARSES the reasons
+    (`near_hbm_limit`, `stalled`, `draining`, ...) rather than
+    scraping text, so the reason strings here are a wire contract
+    (tests/test_fleet.py pins body shape and content type)."""
     gauges = registry.snapshot().get("gauges", {})
     reasons = []
     depth = gauges.get("serve.queue_depth")
@@ -125,12 +136,22 @@ def readiness(registry) -> tuple[bool, dict]:
     stalled = gauges.get("engine.stalled")
     if stalled is not None and stalled >= 1:
         reasons.append("stalled")
+    draining = gauges.get("serve.draining")
+    if draining is not None and draining >= 1:
+        reasons.append("draining")
+    # gateway-only gauge (fleet/gateway.py binds it to the replica
+    # set): a fleet front with zero ready replicas can accept work but
+    # not place it — upstream load balancers should know
+    fleet_ready = gauges.get("fleet.replicas_ready")
+    if fleet_ready is not None and fleet_ready < 1:
+        reasons.append("no_ready_replica")
     return not reasons, {"ready": not reasons, "reasons": reasons,
                          "queue_depth": depth, "backlog": bound,
                          "degrade_level": level,
                          "recovery_budget_remaining": budget,
                          "mem_frac_used": mem_frac,
-                         "stalled": stalled}
+                         "stalled": stalled,
+                         "draining": draining}
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):
@@ -238,18 +259,28 @@ class ObsServer:
     registers e.g. its AsyncWriter's worker liveness). `profile` is an
     optional obs/cost.py ProfileCapture the /profile endpoint triggers
     (absent: 404). The registry defaults to THE process REGISTRY — the
-    same numbers every other consumer sees."""
+    same numbers every other consumer sees.
+
+    The fleet fronts (fleet/gateway.py) reuse this lifecycle with
+    their own handler class: `handler` swaps the request router (a
+    `_Handler` subclass adding the `/v1` solve API), `api` is the
+    enqueue-or-read-only object those handlers talk to, and `site`
+    names the accept loop's fault-injection point (`obs_listen` here,
+    `gateway` for the fleet gateway — runtime/faults.py)."""
 
     def __init__(self, listen: str, registry=None, probes=None,
-                 profile=None):
+                 profile=None, handler=None, api=None,
+                 site: str = "obs_listen"):
         host, port = parse_listen(listen)
-        self._srv = _Server((host, port), _Handler)
+        self._srv = _Server((host, port), handler or _Handler)
         self._srv.registry = (obs_metrics.REGISTRY if registry is None
                               else registry)
         self._srv.probes = dict(probes or {})
         self._srv.profile = profile
+        self._srv.api = api
+        self._site = site
         self._thread = threading.Thread(
-            target=self._serve, name="tt-obs-listen", daemon=True)
+            target=self._serve, name=f"tt-{site}", daemon=True)
         self._state_lock = threading.Lock()
         self._serving = False
         self._closed = False
@@ -265,11 +296,12 @@ class ObsServer:
         return f"http://{host}:{port}"
 
     def _serve(self) -> None:
-        # fault-injection point (`obs_listen` site): a `die` here kills
-        # ONLY the accept loop — the process, and every solve path,
-        # runs on untouched
+        # fault-injection point (`obs_listen` site — `gateway` when the
+        # fleet front owns this server): a `die` here kills ONLY the
+        # accept loop — the process, and every solve path, runs on
+        # untouched
         try:
-            faults.maybe_fail("obs_listen")
+            faults.maybe_fail(self._site)
         except SystemExit:
             self._srv.server_close()
             return

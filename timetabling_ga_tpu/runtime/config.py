@@ -703,6 +703,19 @@ class ServeConfig:
     #                               serve-relevant sites are writer,
     #                               obs_listen, scrape); None reads
     #                               $TT_FAULTS, like the engine
+    # ---- fleet front (timetabling_ga_tpu/fleet; README "Fleet"):
+    http: Optional[str] = None    # HOST:PORT of the HTTP solve front
+    #                               (fleet/replicas.py serve_http): the
+    #                               replica speaks the gateway's own
+    #                               /v1 protocol — POST /v1/solve,
+    #                               GET /v1/jobs/<id>, DELETE
+    #                               /v1/jobs/<id>, POST /v1/drain —
+    #                               plus /metrics, /healthz and
+    #                               /readyz, all on ONE port, so the
+    #                               router's scrape and the tenants'
+    #                               submissions need no second
+    #                               listener. None = the line-JSON
+    #                               stdio protocol (the pre-fleet mode)
 
 
 _SERVE_FLAG_MAP = {
@@ -731,6 +744,7 @@ _SERVE_FLAG_MAP = {
     "--shed-queue-hwm": ("shed_queue_hwm", int),
     "--shed-writer-hwm": ("shed_writer_hwm", int),
     "--faults": ("faults", str),
+    "--http": ("http", str),
 }
 
 _SERVE_BOOL_FLAGS = {"--obs": "obs", "--quality": "quality"}
@@ -758,6 +772,7 @@ def parse_serve_args(argv) -> ServeConfig:
     if cfg.metrics_every < 0:
         raise SystemExit("--metrics-every must be >= 0 dispatches")
     _validate_obs_listen(cfg.obs_listen)
+    _validate_obs_listen(cfg.http)   # same HOST:PORT grammar
     if cfg.profile_for < 0:
         raise SystemExit("--profile-for must be >= 0 dispatches")
     if cfg.mem_poll_every < 0:
@@ -774,4 +789,179 @@ def parse_serve_args(argv) -> ServeConfig:
     if cfg.bucket_ratio <= 1.0:
         raise SystemExit("--bucket-ratio must be > 1.0 (geometric "
                          "bucket growth)")
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# Fleet-gateway configuration (`tt fleet`, timetabling_ga_tpu/fleet).
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Configuration of the fleet gateway (fleet/gateway.py).
+
+    The gateway fronts N replicas with one HTTP solve API and routes
+    each job to the replica where its shape bucket's lane programs are
+    already compiled (fleet/router.py). Replicas come from a static
+    `--replica URL` list, or `--spawn N` local worker processes
+    (`tt serve --http`, one per replica — fleet/replicas.py). Flags
+    after a literal `--` pass through verbatim to spawned workers (and
+    the gateway parses them as serve flags, so its router's bucket
+    spec can never drift from the workers')."""
+
+    listen: str = "127.0.0.1:8070"   # gateway HTTP bind
+    replicas: list = dataclasses.field(default_factory=list)
+    spawn: int = 0                   # local worker processes to spawn
+    backend: str = "tpu"             # backend for spawned workers
+    probe_every: float = 0.5         # liveness + /readyz + /metrics
+    #                                  scrape cadence (the router's
+    #                                  inputs refresh at this rate)
+    poll_every: float = 0.2          # job-status poll cadence on the
+    #                                  dispatcher thread (handlers
+    #                                  serve the cached copy — they
+    #                                  never do outbound I/O)
+    probe_timeout: float = 2.0       # per-probe HTTP timeout
+    #                                  (control plane: /readyz,
+    #                                  /metrics, bulk state polls)
+    io_timeout: float = 30.0         # data-plane HTTP timeout:
+    #                                  submissions (a problem-JSON
+    #                                  payload can be tens of MB) and
+    #                                  terminal record-tail fetches —
+    #                                  a 2 s probe budget would fail
+    #                                  every large job on a healthy
+    #                                  but distant replica
+    max_restarts: int = 3            # restart-on-death budget per
+    #                                  spawned replica
+    dead_after: int = 3              # consecutive failed probes before
+    #                                  a replica is declared dead and
+    #                                  its unfinished jobs fail over
+    boot_grace: float = 120.0        # seconds a replica that has NEVER
+    #                                  probed OK may stay unreachable
+    #                                  before failures count — a
+    #                                  spawned worker pays a long jax
+    #                                  import before it binds its port,
+    #                                  and declaring it dead mid-boot
+    #                                  (then killing + respawning it)
+    #                                  burns every restart before the
+    #                                  first one ever comes up
+    place_timeout: float = 120.0     # seconds a job may wait in
+    #                                  requeue-and-retry placement
+    #                                  (e.g. every replica still
+    #                                  booting) before it fails —
+    #                                  anchored per placement round,
+    #                                  so failover restarts the clock
+    retain_terminal: int = 4096      # settled jobs kept queryable in
+    #                                  the gateway's table; beyond
+    #                                  this the oldest are evicted
+    #                                  (404) — a long-running gateway
+    #                                  must not hold every record
+    #                                  tail it ever served
+    route_retries: int = 3           # bounded-backoff submission
+    #                                  attempts per replica
+    #                                  (runtime/retry.py schedule)
+    retry_wait_s: float = 0.2        # base wait of that schedule
+    backlog: int = 256               # gateway job-table admission bound
+    faults: Optional[str] = None     # fault plan (gateway/route sites)
+    serve_args: list = dataclasses.field(default_factory=list)
+    #                                  verbatim worker flags (after --)
+
+
+_FLEET_FLAG_MAP = {
+    "--listen": ("listen", str),
+    "--spawn": ("spawn", int),
+    "--backend": ("backend", str),
+    "--probe-every": ("probe_every", float),
+    "--poll-every": ("poll_every", float),
+    "--probe-timeout": ("probe_timeout", float),
+    "--io-timeout": ("io_timeout", float),
+    "--max-restarts": ("max_restarts", int),
+    "--dead-after": ("dead_after", int),
+    "--boot-grace": ("boot_grace", float),
+    "--place-timeout": ("place_timeout", float),
+    "--retain-terminal": ("retain_terminal", int),
+    "--route-retries": ("route_retries", int),
+    "--retry-wait": ("retry_wait_s", float),
+    "--backlog": ("backlog", int),
+    "--faults": ("faults", str),
+}
+
+
+def _fleet_usage() -> str:
+    return _format_usage(
+        ["usage: python -m timetabling_ga_tpu fleet --listen H:P "
+         "(--replica URL ... | --spawn N) [flags] [-- serve flags]", "",
+         "fleet gateway: HTTP solve front + bucket-affine router over "
+         "N replicas (`--replica` may repeat; flags after `--` pass "
+         "through to spawned `tt serve --http` workers):"],
+        {"--replica": ("replicas (repeatable)", str), **_FLEET_FLAG_MAP})
+
+
+def parse_fleet_args(argv) -> FleetConfig:
+    """Parse `tt fleet` flags. `--replica URL` repeats; everything
+    after a literal `--` is kept verbatim for spawned workers (and
+    parsed as serve flags by the gateway for its bucket spec)."""
+    cfg = FleetConfig()
+    argv = list(argv)
+    if "--" in argv:
+        split = argv.index("--")
+        cfg.serve_args = argv[split + 1:]
+        argv = argv[:split]
+    rest = []
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--replica":
+            if i + 1 >= len(argv):
+                raise SystemExit("flag --replica needs a value")
+            cfg.replicas.append(argv[i + 1])
+            i += 2
+        else:
+            rest.append(argv[i])
+            i += 1
+    _parse_flag_stream(rest, cfg, _FLEET_FLAG_MAP, _fleet_usage)
+    _validate_obs_listen(cfg.listen)
+    if cfg.backend not in ("tpu", "cpu"):
+        raise SystemExit(f"unknown backend: {cfg.backend}")
+    if cfg.spawn < 0:
+        raise SystemExit("--spawn must be >= 0 worker processes")
+    if not cfg.replicas and cfg.spawn == 0:
+        raise SystemExit("fleet needs replicas: pass --replica URL "
+                         "(repeatable) or --spawn N")
+    if cfg.replicas and cfg.spawn:
+        raise SystemExit("--replica and --spawn are exclusive: either "
+                         "the fleet manages its own local workers or "
+                         "it fronts externally managed ones")
+    if cfg.probe_every <= 0 or cfg.poll_every <= 0:
+        raise SystemExit("--probe-every / --poll-every must be > 0 "
+                         "seconds")
+    if cfg.probe_timeout <= 0 or cfg.io_timeout <= 0:
+        raise SystemExit("--probe-timeout / --io-timeout must be > 0 "
+                         "seconds")
+    if cfg.max_restarts < 0:
+        raise SystemExit("--max-restarts must be >= 0")
+    if cfg.dead_after < 1:
+        raise SystemExit("--dead-after must be >= 1 failed probes")
+    if cfg.boot_grace < 0 or cfg.place_timeout < 0:
+        raise SystemExit("--boot-grace / --place-timeout must be "
+                         ">= 0 seconds")
+    if cfg.retain_terminal < 1:
+        raise SystemExit("--retain-terminal must be >= 1 settled job")
+    if cfg.route_retries < 1:
+        raise SystemExit("--route-retries must be >= 1 attempts")
+    if cfg.retry_wait_s <= 0:
+        raise SystemExit("--retry-wait must be > 0 seconds")
+    if cfg.backlog < 1:
+        raise SystemExit("--backlog must be >= 1")
+    # the worker flags must themselves parse (a typo would otherwise
+    # only surface as N crashed spawns); the parsed copy also gives
+    # the gateway its bucket spec, so router and workers agree
+    if cfg.serve_args:
+        parse_serve_args(cfg.serve_args)
+    if cfg.spawn and "-o" in cfg.serve_args:
+        # N worker processes appending one record file interleave
+        # torn JSONL lines — each spawned worker gets its own
+        # tt-fleet-<name>.jsonl instead (fleet/replicas.spawn_local)
+        raise SystemExit("-o in the worker passthrough flags would "
+                         "make every spawned replica write ONE shared "
+                         "record file; drop it — workers write "
+                         "./tt-fleet-<name>.jsonl each")
     return cfg

@@ -46,9 +46,14 @@ retry's window), `obs_listen` (the pull front's server thread at
 startup), `scrape` (once per handled HTTP request, on the handler
 thread — a hang/die there must never stall dispatch, serve, or writer
 drain; tests/test_obs.py pins it), `mem_poll` (once per device-memory
-sample on the cost observatory's poller thread) and `profile` (on the
+sample on the cost observatory's poller thread), `profile` (on the
 profiler-capture worker around each start/stop — same isolation
-contract as the listener sites; tests/test_cost.py pins it).
+contract as the listener sites; tests/test_cost.py pins it),
+`gateway` (the fleet gateway's HTTP accept loop at startup) and
+`route` (once per routing decision on the gateway dispatcher thread —
+both fleet sites share the listener sites' isolation contract:
+tests/test_fleet.py pins that a wedged gateway never stalls replica
+dispatch or writer drain).
 
 The plan is installed per engine.run call (`install`), which resets the
 per-site counters — invocation indices are deterministic within one
@@ -84,8 +89,15 @@ ACTIONS = ("unavailable", "hang", "die", "truncate", "error")
 # threads, with the same isolation contract: a hang parks only that
 # thread, a die ends it, and dispatch/serve/writer drain never wait on
 # either (tests/test_cost.py pins it).
+# `gateway` fires on the fleet gateway's HTTP accept loop at startup
+# (fleet/gateway.py — the obs_listen analogue for the solve front) and
+# `route` once per routing decision on the gateway's dispatcher thread
+# (fleet/router.py Router.route). Both run OFF every replica's
+# dispatch/serve/writer path: a wedged gateway makes the FRONT
+# unreachable, but every replica keeps dispatching and draining its
+# writer untouched (tests/test_fleet.py pins it).
 SITES = ("dispatch", "fetch", "writer", "ckpt", "init", "obs_listen",
-         "scrape", "mem_poll", "profile")
+         "scrape", "mem_poll", "profile", "gateway", "route")
 
 
 class FaultInjected(Exception):

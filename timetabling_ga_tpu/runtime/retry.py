@@ -33,6 +33,21 @@ TRANSIENT_MARKERS = (
     "fetch watchdog",           # engine._fetch deadline timeout (a hung
     #                             control-fence read is assumed to be a
     #                             tunnel stall, not a program bug)
+    # fleet-front connection failures (fleet/gateway.py submission +
+    # status polls): a replica mid-restart refuses or resets its
+    # socket for a bounded window, exactly the sick-window shape this
+    # policy absorbs — the router retries through it with short waits
+    # and only then fails the replica over. These strings cannot arise
+    # from a compiled program, so the engine-side classification is
+    # unchanged.
+    "Connection refused",
+    "Connection reset",
+    "Remote end closed connection",
+    "timed out",                # socket/urllib timeout: a slow or
+    #                             overloaded peer, retryable by every
+    #                             consumer of this policy (the engine's
+    #                             own hung-fetch case is already the
+    #                             'fetch watchdog' marker)
 )
 
 # cause-chain walk bound: a pathological cycle (e1.__cause__ = e2,

@@ -80,6 +80,23 @@ def bucket_dims(problem: Problem, spec: BucketSpec = DEFAULT_SPEC
             _round_up(problem.n_students, spec.student_floor, spec.ratio))
 
 
+def bucket_key_from_counts(n_events: int, n_rooms: int, n_features: int,
+                           n_students: int, n_days: int,
+                           slots_per_day: int,
+                           spec: BucketSpec = DEFAULT_SPEC) -> tuple:
+    """bucket_key from raw instance counts — no Problem required.
+
+    The fleet gateway (fleet/router.py) routes on the bucket key at
+    admission, from nothing but the `.tim` header's four counts: the
+    full parse (conflict matrices, suitability) happens once, on the
+    replica that actually solves the job, never on the routing path."""
+    return (_round_up(n_events, spec.event_floor, spec.ratio),
+            _round_up(n_rooms, spec.room_floor, spec.ratio),
+            _round_up(n_features, spec.feature_floor, spec.ratio),
+            _round_up(n_students, spec.student_floor, spec.ratio),
+            int(n_days), int(slots_per_day))
+
+
 def bucket_key(problem: Problem, spec: BucketSpec = DEFAULT_SPEC
                ) -> tuple:
     """The compile-compatibility key: bucket dims + the slot grid.
@@ -87,8 +104,9 @@ def bucket_key(problem: Problem, spec: BucketSpec = DEFAULT_SPEC
     Two jobs with equal bucket_key (and equal breeding config) execute
     the SAME compiled island programs — the scheduler packs them into
     one dispatch and the engine's program caches serve both."""
-    return bucket_dims(problem, spec) + (problem.n_days,
-                                         problem.slots_per_day)
+    return bucket_key_from_counts(
+        problem.n_events, problem.n_rooms, problem.n_features,
+        problem.n_students, problem.n_days, problem.slots_per_day, spec)
 
 
 def pad_problem(problem: Problem, spec: BucketSpec = DEFAULT_SPEC

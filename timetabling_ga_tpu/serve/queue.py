@@ -111,6 +111,9 @@ class JobQueue:
     def __len__(self) -> int:
         return len(self._jobs)
 
+    def __contains__(self, job_id: str) -> bool:
+        return job_id in self._jobs
+
     def active(self) -> list[Job]:
         return [j for j in self._jobs.values() if j.runnable()]
 

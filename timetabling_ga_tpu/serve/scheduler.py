@@ -95,7 +95,8 @@ class Scheduler:
     """Drives a JobQueue through the engine's lane programs."""
 
     def __init__(self, cfg: ServeConfig, queue: JobQueue, out,
-                 now=None, tracer=NULL_TRACER, profiler=None):
+                 now=None, tracer=NULL_TRACER, profiler=None,
+                 registry=None):
         import jax
         self.cfg = cfg
         self.queue = queue
@@ -104,7 +105,12 @@ class Scheduler:
         self._now = now or time.monotonic
         self._dispatches = 0
         self._overflow_warned = False
-        self._metrics = obs_metrics.REGISTRY
+        # the metrics registry this scheduler reports into — THE
+        # process registry by default, a private one when several
+        # in-process replicas must keep separate /readyz truths
+        # (fleet/replicas.py InProcReplica)
+        self._metrics = (obs_metrics.REGISTRY if registry is None
+                         else registry)
         # on-demand profiler capture (obs/cost.py ProfileCapture, wired
         # by the service): the step loop only ticks its counter
         self._profiler = profiler

@@ -60,11 +60,20 @@ class SolveService:
     stall on host I/O — the same telemetry discipline as the engine's
     run loop, shared across every tenant of the stream."""
 
-    def __init__(self, cfg: ServeConfig, out=None, now=None):
+    def __init__(self, cfg: ServeConfig, out=None, now=None,
+                 registry=None):
         import jax
         if cfg.backend == "cpu":
             jax.config.update("jax_platforms", "cpu")
         self.cfg = cfg
+        # which metrics registry this service reports into: THE process
+        # registry by default; a private MetricsRegistry when several
+        # in-process replicas coexist (fleet/replicas.py InProcReplica)
+        # so each replica's /metrics and /readyz tell only its own
+        # truth. The cost observatory stays process-global either way
+        # (compile caches genuinely are shared in-process).
+        self._registry = (obs_metrics.REGISTRY if registry is None
+                          else registry)
         # deterministic fault injection, mirroring engine.run: install
         # the configured plan (or $TT_FAULTS) so the serve-relevant
         # sites (writer, obs_listen, scrape) fire under `tt serve` too.
@@ -86,9 +95,9 @@ class SolveService:
         # obs wiring, mirroring engine.run's: spans ride the writer,
         # the registry's writer gauges re-bind to this service's writer
         self.tracer = SpanTracer(self.writer, enabled=cfg.obs)
-        obs_metrics.REGISTRY.gauge_fn("writer.queue_depth",
-                                      self.writer.qsize)
-        obs_metrics.REGISTRY.gauge_fn(
+        self._registry.gauge_fn("writer.queue_depth",
+                                self.writer.qsize)
+        self._registry.gauge_fn(
             "writer.records", lambda: self.writer.records_written)
         # cost observatory (obs/cost.py), mirroring engine.run's
         # wiring: costEntry emission binds to this service's writer
@@ -113,7 +122,8 @@ class SolveService:
         self.queue = JobQueue(cfg.backlog, now=now)
         self.scheduler = Scheduler(cfg, self.queue, self.writer,
                                    now=now, tracer=self.tracer,
-                                   profiler=self.profile_capture)
+                                   profiler=self.profile_capture,
+                                   registry=self._registry)
         self._auto_id = 0
         self.obs_server = None
         if cfg.obs_listen:
@@ -125,7 +135,7 @@ class SolveService:
             try:
                 from timetabling_ga_tpu.obs import http as obs_http
                 self.obs_server = obs_http.ObsServer(
-                    cfg.obs_listen,
+                    cfg.obs_listen, registry=self._registry,
                     probes={"process": lambda: True,
                             "writer": self.writer.alive},
                     profile=self.profile_capture).start()
@@ -144,13 +154,19 @@ class SolveService:
                     self.mem_poller.close()
                 obs_cost.OBSERVATORY.unbind()
                 self.writer.close(raise_error=False)
-                obs_metrics.REGISTRY.freeze(
+                self._registry.freeze(
                     "writer.records", self.writer.records_written)
-                obs_metrics.REGISTRY.freeze("writer.queue_depth", 0.0)
-                obs_metrics.REGISTRY.freeze("serve.queue_depth", 0.0)
+                self._registry.freeze("writer.queue_depth", 0.0)
+                self._registry.freeze("serve.queue_depth", 0.0)
                 raise
 
     # -- API -------------------------------------------------------------
+
+    @property
+    def registry(self):
+        """The metrics registry this service reports into (the fleet
+        replica front serves /metrics //readyz from it)."""
+        return self._registry
 
     def submit(self, problem, job_id=None, priority: int = 0,
                seed=None, generations=None, deadline_s=None) -> str:
@@ -196,11 +212,11 @@ class SolveService:
 
     def stats(self) -> dict:
         """Live metrics-registry snapshot (the metricsEntry payload)."""
-        return obs_metrics.REGISTRY.snapshot()
+        return self._registry.snapshot()
 
     def prometheus(self) -> str:
         """Prometheus text exposition of the registry (format 0.0.4)."""
-        return obs_metrics.REGISTRY.to_prometheus()
+        return self._registry.to_prometheus()
 
     def emit_stats(self, prometheus: bool = False) -> None:
         """Answer a `stats` request: one metricsEntry on the record
@@ -228,10 +244,10 @@ class SolveService:
             # costEntry emitter, which holds the same writer)
             from timetabling_ga_tpu.obs import cost as obs_cost
             obs_cost.OBSERVATORY.unbind()
-            obs_metrics.REGISTRY.freeze(
+            self._registry.freeze(
                 "writer.records", self.writer.records_written)
-            obs_metrics.REGISTRY.freeze("writer.queue_depth", 0.0)
-            obs_metrics.REGISTRY.freeze("serve.queue_depth", 0.0)
+            self._registry.freeze("writer.queue_depth", 0.0)
+            self._registry.freeze("serve.queue_depth", 0.0)
             if self._close_out:
                 self._raw_out.close()
 
@@ -298,6 +314,12 @@ def serve_stream(cfg: ServeConfig, in_stream, out_stream=None,
 def main_serve(argv) -> int:
     """`tt serve` entry point (cli.py dispatches here)."""
     cfg = parse_serve_args(argv)
+    if cfg.http:
+        # the fleet replica mode (README "Fleet"): the same service,
+        # driven by a command inbox behind an HTTP front speaking the
+        # gateway's /v1 protocol instead of line-JSON on stdio
+        from timetabling_ga_tpu.fleet.replicas import serve_http
+        return serve_http(cfg)
     if cfg.input:
         with open(cfg.input, "r") as fh:
             serve_stream(cfg, fh)

@@ -61,6 +61,11 @@ _METRICS = [
     ("obs ms/dispatch", None, "obs_overhead_ms_per_dispatch"),
     ("quality ms/dispatch", None, "quality_overhead_ms_per_dispatch"),
     ("achieved TFLOPS", None, "achieved_tflops"),
+    ("fleet jobs/min 2rep", "fleet", "jobs_per_min_2rep"),
+    ("fleet jobs/min 1rep", "fleet", "jobs_per_min_1rep"),
+    ("fleet p50 s", "fleet", "p50_latency_s_2rep"),
+    ("fleet p99 s", "fleet", "p99_latency_s_2rep"),
+    ("fleet affinity", "fleet", "affinity_hit_rate"),
 ]
 
 _NUM = r"(-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)"
