@@ -895,9 +895,10 @@ def measure_soak() -> dict:
 
 
 def measure_fleet() -> dict:
-    """extra.fleet leg (ISSUE 10): the same-seed mixed-bucket job
-    stream through the fleet gateway against 1 routed replica vs 2,
-    reporting the routing story's numbers:
+    """extra.fleet leg (ISSUE 10 + the ISSUE 11 obs A/B): the
+    same-seed mixed-bucket job stream through the fleet gateway
+    against 1 routed replica vs 2, reporting the routing story's
+    numbers:
 
       jobs/min (1 vs 2)    end-to-end completion rate at the gateway
       p50/p99 latency      submit-to-settled per job (includes the
@@ -910,6 +911,15 @@ def measure_fleet() -> dict:
                            timing fields) bit-equal to the SAME job
                            solved on a bare unrouted SolveService —
                            the failover/packing-neutrality contract
+      obs A/B (tt-obs v5)  the 2-replica leg re-run with the
+                           gateway's telemetry stream ON (`-o`):
+                           gateway overhead ms/job, the span/route/
+                           metrics record counts its log carried, the
+                           fleet.route.* counters scraped off its
+                           /metrics (via the shared obs/scrape
+                           parser), and the SAME records-identical
+                           assertion — the gateway observatory must
+                           be a pure observer of the job streams
 
     In-process replicas with private registries (the CPU test double
     for N worker processes); the 1-replica run is the routed baseline,
@@ -918,7 +928,8 @@ def measure_fleet() -> dict:
 
     from timetabling_ga_tpu.fleet.gateway import Gateway
     from timetabling_ga_tpu.fleet.replicas import (
-        http_json, in_process_replica)
+        http_json, http_text, in_process_replica)
+    from timetabling_ga_tpu.obs import scrape as obs_scrape
     from timetabling_ga_tpu.problem import dump_tim, random_instance
     from timetabling_ga_tpu.runtime import jsonl
     from timetabling_ga_tpu.runtime.config import FleetConfig, ServeConfig
@@ -941,7 +952,7 @@ def measure_fleet() -> dict:
                            pop_size=6, max_steps=16,
                            http="127.0.0.1:0")
 
-    def run_fleet(n_replicas):
+    def run_fleet(n_replicas, obs=False):
         reps, handles = [], []
         for r in range(n_replicas):
             rep, handle = in_process_replica(serve_cfg(), f"b{r}")
@@ -949,8 +960,10 @@ def measure_fleet() -> dict:
             handles.append(handle)
         fcfg = FleetConfig(listen="127.0.0.1:0",
                            replicas=[h.url for h in handles],
-                           probe_every=0.1, poll_every=0.05)
-        gw = Gateway(fcfg, handles).start()
+                           probe_every=0.1, poll_every=0.05,
+                           metrics_every=20)
+        gwbuf = io.StringIO() if obs else None
+        gw = Gateway(fcfg, handles, out=gwbuf).start()
 
         def settled():
             deadline = time.perf_counter() + 600
@@ -988,15 +1001,31 @@ def measure_fleet() -> dict:
                        for j in timed}
             states = {j.id: j.state for j in timed}
         stats = gw.router.stats()
+        route_counters = None
+        if obs:
+            # the routing counters as /metrics families, read back
+            # through the one shared exposition parser (obs/scrape.py)
+            fams = obs_scrape.parse_exposition(
+                http_text(gw.url + "/metrics"))
+            route_counters = {
+                o: obs_scrape.scalar(fams,
+                                     f"tt_fleet_route_{o}_total", 0.0)
+                for o in ("hit", "warm", "miss")}
         gw.request_drain()
         gw.drained.wait(60)
         gw.close()
         for rep in reps:
             rep.stop()
-        return wall, lats, stats, records, states
+        gw_records = ([json.loads(ln) for ln in
+                       gwbuf.getvalue().splitlines()]
+                      if obs else None)
+        return (wall, lats, stats, records, states, gw_records,
+                route_counters)
 
-    wall2, lat2, stats2, recs2, states2 = run_fleet(2)
-    wall1, lat1, stats1, recs1, states1 = run_fleet(1)
+    wall2, lat2, stats2, recs2, states2, _, _ = run_fleet(2)
+    wall2o, lat2o, stats2o, recs2o, states2o, gwrecs, route_ctr = \
+        run_fleet(2, obs=True)
+    wall1, lat1, stats1, recs1, states1, _, _ = run_fleet(1)
 
     # unrouted baseline: the same jobs (same ids, seeds, budgets,
     # serve shape) on a bare SolveService — per-job streams must match
@@ -1017,7 +1046,8 @@ def measure_fleet() -> dict:
             base.setdefault(job, []).append(rec)
     base = {j: jsonl.strip_timing(rs) for j, rs in base.items()}
     identical = all(recs2.get(j) == base.get(j)
-                    and recs1.get(j) == base.get(j) for j in base)
+                    and recs1.get(j) == base.get(j)
+                    and recs2o.get(j) == base.get(j) for j in base)
 
     def pct(vals, q):
         if not vals:
@@ -1042,13 +1072,27 @@ def measure_fleet() -> dict:
         "affinity_hits": stats2["affinity_hits"],
         "warmups": stats2["warmups"],
         "records_identical": bool(identical),
+        # --- gateway observatory A/B (tt-obs v5): same 2-replica
+        # stream with the gateway log ON ---
+        "jobs_per_min_2rep_obs": round(len(problems) / wall2o * 60, 2),
+        "gateway_overhead_ms_per_job": round(
+            (wall2o - wall2) / len(problems) * 1000, 2),
+        "gateway_span_records": sum(1 for r in gwrecs
+                                    if "spanEntry" in r),
+        "gateway_route_records": sum(1 for r in gwrecs
+                                     if "routeEntry" in r),
+        "gateway_metrics_records": sum(1 for r in gwrecs
+                                       if "metricsEntry" in r),
+        "gateway_route_counters": route_ctr,
         "note": "2 in-process replicas (private registries) behind "
                 "the gateway vs 1, same-seed 2-bucket 10-job stream; "
                 "records_identical strips timing fields and compares "
-                "every routed job's stream to a bare unrouted "
-                "SolveService run of the same jobs. On a serial CPU "
-                "box the replicas share cores, so fleet_speedup "
-                "reflects scheduling overlap, not hardware scaling.",
+                "every routed job's stream (obs-off AND obs-on legs) "
+                "to a bare unrouted SolveService run of the same "
+                "jobs. On a serial CPU box the replicas share cores, "
+                "so fleet_speedup reflects scheduling overlap, not "
+                "hardware scaling; gateway_overhead_ms_per_job is "
+                "run-to-run noise-bounded, not a precise cost.",
     }
     if not identical:
         out["error"] = "routed record stream diverged from unrouted"
@@ -1057,6 +1101,9 @@ def measure_fleet() -> dict:
           f"{out['fleet_speedup']}), affinity "
           f"{out['affinity_hit_rate']}, p50/p99 "
           f"{out['p50_latency_s_2rep']}/{out['p99_latency_s_2rep']}s, "
+          f"gateway obs {out['gateway_overhead_ms_per_job']} ms/job "
+          f"({out['gateway_span_records']} spans, "
+          f"{out['gateway_route_records']} routeEntries), "
           f"records identical: {identical}", file=sys.stderr)
     return out
 

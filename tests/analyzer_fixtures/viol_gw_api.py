@@ -1,0 +1,70 @@
+"""TT602/TT605 fixture: gateway telemetry discipline on *Api surfaces.
+
+Not imported or executed — parsed by tests/test_analysis.py (the test
+config adds this file to `fleet-modules`; `handler-api-suffixes`
+defaults to ["Api"]). The fleet fronts route every HTTP request into
+an `api` object (fleet/gateway.py GatewayApi, fleet/replicas.py
+ReplicaApi) whose methods run ON the handler thread but live in a
+class with no `do_*` methods — before tt-obs v5 the reachability walk
+could not see them, so a registry bump or an outbound scrape inside
+`accept_solve` passed the gate the handler discipline exists to
+enforce. The `*Api` suffix now marks these classes handler-path roots
+for both TT602 (registry mutation / blocking I/O) and TT605 (device
+work) — this fixture pins the new surface.
+"""
+import urllib.request
+
+from timetabling_ga_tpu.obs import metrics as obs_metrics
+
+
+class WrongGatewayApi:
+    """An api surface doing everything the dispatcher owns — each one
+    a regression the handler-thread discipline must catch."""
+
+    def __init__(self, gw, registry):
+        self._gw = gw
+        self._registry = registry
+
+    def accept_solve(self, payload, flow=0):
+        # counting admissions is DISPATCHER work: handlers only enqueue
+        self._registry.counter("fleet.jobs_accepted").inc()  # EXPECT TT602
+        self._gw.inbox.put(("submit", payload))
+        return 202, {"ok": True}
+
+    def fleet_view(self):
+        # outbound I/O on a handler thread: a slow replica now stalls
+        # every client reading /v1/fleet
+        body = urllib.request.urlopen("http://r0:1/metrics")  # EXPECT TT602
+        return 200, {"metrics": body.read().decode()}
+
+    def accept_drain(self):
+        self._drain_inline()
+        return 200, {"draining": True}
+
+    def _drain_inline(self):
+        # reachable via self._drain_inline() from accept_drain — still
+        # the handler path; driving the scheduler is DEVICE work
+        self._gw.svc.drive()                                 # EXPECT TT605
+
+
+class ReadOnlyViewApi:
+    """OK: the sanctioned shape — enqueue commands, read cached
+    state, mutate nothing shared."""
+
+    def __init__(self, gw):
+        self._gw = gw
+
+    def accept_solve(self, payload, flow=0):
+        self._gw.inbox.put(("submit", payload))
+        return 202, {"ok": True}
+
+    def fleet_view(self):
+        return 200, self._gw.fleet_snapshot()
+
+
+def dispatcher_side_is_fine(gw, registry):
+    # OK: not reachable from any handler or api class — the dispatcher
+    # thread is exactly where routing I/O and registry writes belong
+    registry.counter("fleet.jobs_routed").inc()
+    urllib.request.urlopen(gw.url + "/metrics")
+    gw.svc.drive()

@@ -48,7 +48,8 @@ def fixture_config() -> AnalyzerConfig:
                                                          "viol_quality.py"]
     cfg.sharded_modules = (list(cfg.sharded_modules)
                            + ["viol_collective.py", "viol_quality.py"])
-    cfg.fleet_modules = list(cfg.fleet_modules) + ["viol_fleet.py"]
+    cfg.fleet_modules = list(cfg.fleet_modules) + ["viol_fleet.py",
+                                                   "viol_gw_api.py"]
     return cfg
 
 
@@ -78,6 +79,9 @@ def analyze_fixture(fixture: str):
     #                        reduction paths
     "viol_fleet.py",       # TT605 device work / unbounded socket
     #                        reads on fleet handler paths
+    "viol_gw_api.py",      # TT602/TT605 on *Api handler-path roots
+    #                        (the fleet fronts' enqueue-or-read-only
+    #                        api surfaces — tt-obs v5)
 ])
 def test_rule_fires_at_expected_lines(fixture):
     """Each rule family fires exactly at the marked (rule, line) pairs —

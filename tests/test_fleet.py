@@ -643,9 +643,10 @@ def test_tt_submit_round_trip(tmp_path, capsys):
     rep, handle = in_process_replica(_serve_cfg(), "rs")
     gw = Gateway(_fleet_cfg([handle.url]), [handle]).start()
     try:
+        tail_path = os.path.join(tmp_path, "cli1.jsonl")
         rc = main_submit([gw.url, tim_path, "--id", "cli1", "-s", "9",
                           "--generations", "10", "--poll", "0.1",
-                          "--records"])
+                          "--records", "--records-out", tail_path])
         assert rc == 0
         out = json.loads(capsys.readouterr().out.strip())
         assert out["state"] == "done" and out["id"] == "cli1"
@@ -655,6 +656,11 @@ def test_tt_submit_round_trip(tmp_path, capsys):
         # and the stream matches the unrouted baseline
         baseline = _unrouted_streams([("cli1", p, 9, 10)])
         assert jsonl.strip_timing(out["records"]) == baseline["cli1"]
+        # --records-out wrote the SAME stream as JSONL lines (a
+        # tt stats / tt trace input)
+        with open(tail_path) as fh:
+            lines = [json.loads(ln) for ln in fh if ln.strip()]
+        assert lines == out["records"]
     finally:
         gw.request_drain()
         gw.drained.wait(30)

@@ -1,0 +1,677 @@
+"""tt-obs v5 (ISSUE 11): the fleet observatory — cross-process flow
+tracing, gateway /metrics parity, SLO-burn readiness.
+
+The acceptance properties pinned here:
+
+  1. CROSS-PROCESS FLOWS — a routed job's gateway spans (route /
+     submit / routed / settle) and its replica-side spans (admit /
+     pack / quantum / ...) share ONE flow id from the XFLOW_BASE range
+     (shipped as X-TT-Flow), and `export_stitched` over gateway +
+     replica logs renders one timeline whose flow chain crosses the
+     process boundary;
+  2. /METRICS PARITY — everything /v1/fleet shows is a real registry
+     family on the gateway's port (per-replica gauges, routing
+     counters, tick timing, job_seconds exemplars), parsed by the one
+     shared OpenMetrics parser (obs/scrape.py);
+  3. READINESS — the gateway answers the pinned /readyz JSON contract
+     with the new `slo_burn` and `dispatcher_stalled` reasons;
+  4. ISOLATION — a dead gateway log writer (`gw_writer`) or a hung
+     replica scrape (`gw_scrape`) never stalls the dispatcher thread
+     or job settlement;
+  5. IDENTITY — with the gateway's telemetry stream ON, every routed
+     job's record stream stays bit-identical (modulo timing records)
+     to the same job solved on a bare unrouted SolveService.
+"""
+
+import io
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from timetabling_ga_tpu.fleet.gateway import Gateway
+from timetabling_ga_tpu.fleet.replicas import (
+    ReplicaHandle, http_json, http_text, in_process_replica)
+from timetabling_ga_tpu.fleet.router import Router
+from timetabling_ga_tpu.obs import http as obs_http
+from timetabling_ga_tpu.obs import scrape as obs_scrape
+from timetabling_ga_tpu.obs.logstats import summarize
+from timetabling_ga_tpu.obs.metrics import MetricsRegistry
+from timetabling_ga_tpu.obs.spans import XFLOW_BASE
+from timetabling_ga_tpu.obs.trace_export import export_stitched
+from timetabling_ga_tpu.problem import dump_tim, random_instance
+from timetabling_ga_tpu.runtime import faults, jsonl
+from timetabling_ga_tpu.runtime.config import (
+    FleetConfig, ServeConfig, parse_fleet_args)
+from timetabling_ga_tpu.serve.service import SolveService
+
+_SHAPE = dict(n_events=12, n_rooms=3, n_features=2, n_students=8,
+              attend_prob=0.2)
+
+
+def _serve_cfg(**kw):
+    kw.setdefault("backend", "cpu")
+    kw.setdefault("lanes", 2)
+    kw.setdefault("quantum", 5)
+    kw.setdefault("pop_size", 4)
+    kw.setdefault("max_steps", 8)
+    kw.setdefault("http", "127.0.0.1:0")
+    return ServeConfig(**kw)
+
+
+def _fleet_cfg(urls, **kw):
+    kw.setdefault("listen", "127.0.0.1:0")
+    kw.setdefault("probe_every", 0.1)
+    kw.setdefault("poll_every", 0.05)
+    kw.setdefault("dead_after", 2)
+    return FleetConfig(replicas=list(urls), **kw)
+
+
+def _wait_done(gw, ids, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with gw.jobs_lock:
+            if all(j in gw.jobs and gw.jobs[j].terminal()
+                   and gw.jobs[j].records_final for j in ids):
+                return {j: gw.jobs[j] for j in ids}
+        time.sleep(0.05)
+    with gw.jobs_lock:
+        states = {j: getattr(gw.jobs.get(j), "state", "?")
+                  for j in ids}
+    raise AssertionError(f"jobs not settled after {timeout}s: {states}")
+
+
+def _records(buf) -> list:
+    return [json.loads(ln) for ln in buf.getvalue().splitlines()]
+
+
+def _spans(recs, **match):
+    out = []
+    for r in recs:
+        s = r.get("spanEntry")
+        if s is None:
+            continue
+        if all(s.get(k) == v for k, v in match.items()):
+            out.append(s)
+    return out
+
+
+# ----------------------------------------------------- scrape parser
+
+
+def test_scrape_parser_families_and_exemplars():
+    text = (
+        "# TYPE tt_serve_queue_depth gauge\n"
+        "tt_serve_queue_depth 3\n"
+        "# TYPE tt_compile_count counter\n"
+        "tt_compile_count_total 4\n"
+        "tt_compile_cache_hits_total 12\n"
+        "# TYPE tt_fleet_job_seconds histogram\n"
+        'tt_fleet_job_seconds_bucket{le="0.5"} 0\n'
+        'tt_fleet_job_seconds_bucket{le="+Inf"} 2 '
+        '# {job="j 1"} 0.93\n'
+        "tt_fleet_job_seconds_sum 1.5\n"
+        "tt_fleet_job_seconds_count 2\n"
+        'weird{label="a\\"b\\\\c"} 7\n'
+        "not a sample line at all\n"
+        "# EOF\n")
+    fams = obs_scrape.parse_exposition(text)
+    assert obs_scrape.scalar(fams, obs_scrape.QUEUE_DEPTH) == 3.0
+    assert obs_scrape.scalar(fams, obs_scrape.COMPILE_COUNT) == 4.0
+    assert obs_scrape.scalar(fams, "missing", 9.0) == 9.0
+    assert obs_scrape.hit_rate(fams) == pytest.approx(12 / 16)
+    # labeled lookup + exemplar-bearing line parses to its VALUE
+    assert obs_scrape.labeled(fams, "tt_fleet_job_seconds_bucket",
+                              le="+Inf") == 2.0
+    # escaped label values round-trip
+    assert fams["weird"][0][0]["label"] == 'a"b\\c'
+    assert fams["weird"][0][1] == 7.0
+    # empty/garbage degrade to empty dict, never raise
+    assert obs_scrape.parse_exposition("") == {}
+    assert obs_scrape.hit_rate({}) == 0.0
+    # exemplars come out of the SAME parser (one copy of the format
+    # knowledge — tools/bench_report.py --metrics consumes this)
+    ex = obs_scrape.parse_exemplars(text)
+    assert ex == [("tt_fleet_job_seconds_bucket", {"job": "j 1"},
+                   0.93)]
+    assert obs_scrape.parse_exemplars("") == []
+
+
+def test_scrape_parses_real_registry_exposition():
+    reg = MetricsRegistry()
+    reg.counter("compile.count").inc(2)
+    reg.gauge("serve.queue_depth").set(5)
+    reg.histogram("fleet.job_seconds").observe(
+        0.3, exemplar={"job": "j1"})
+    for text in (reg.to_prometheus(), reg.to_openmetrics()):
+        fams = obs_scrape.parse_exposition(text)
+        assert obs_scrape.scalar(fams, "tt_compile_count_total") == 2.0
+        assert obs_scrape.scalar(fams, obs_scrape.QUEUE_DEPTH) == 5.0
+        assert obs_scrape.labeled(
+            fams, "tt_fleet_job_seconds_bucket", le="+Inf") == 1.0
+
+
+# ----------------------------------------------- router /metrics unit
+
+
+class _FakeHandle:
+    def __init__(self, name, depth=0.0):
+        self.name = name
+        self.ready = True
+        self.dead = False
+        self.queue_depth = depth
+        self.compile_count = 0.0
+        self.compile_cache_hits = 0.0
+
+    def compile_hit_rate(self):
+        return 0.0
+
+
+class _FakeSet:
+    def __init__(self, handles):
+        self.handles = handles
+
+    def live(self):
+        return [h for h in self.handles if not h.dead]
+
+
+def test_router_route_counters_and_last_decision():
+    reg = MetricsRegistry()
+    r0, r1 = _FakeHandle("r0"), _FakeHandle("r1")
+    router = Router(_FakeSet([r0, r1]), registry=reg)
+    first = router.route(("A",))
+    assert router.last_decision["outcome"] == "warm"
+    assert router.last_decision["replica"] == first.name
+    assert router.last_decision["pins"] == 1
+    router.route(("A",))
+    assert router.last_decision["outcome"] == "hit"
+    # detour: the pinned home goes not-ready -> miss on the other
+    first.ready = False
+    router.route(("A",))
+    assert router.last_decision["outcome"] == "miss"
+    first.ready = True
+    c = reg.snapshot()["counters"]
+    assert c["fleet.route.warm"] == 1
+    assert c["fleet.route.hit"] == 1
+    assert c["fleet.route.miss"] == 1
+    # pin_counts follows pin moves and deaths
+    assert router.pin_counts[first.name] == 1
+    router.on_replica_dead(first.name)
+    assert router.pin_counts[first.name] == 0
+
+
+# ------------------------------------------------- readiness contract
+
+
+def test_readyz_dispatcher_stalled_reason():
+    reg = MetricsRegistry()
+    reg.gauge("fleet.tick_age_s").set(0.1)
+    reg.gauge("fleet.tick_stall_after").set(1.0)
+    ok, detail = obs_http.readiness(reg)
+    assert ok and detail["reasons"] == []
+    reg.gauge("fleet.tick_age_s").set(5.0)
+    ok, detail = obs_http.readiness(reg)
+    assert not ok and "dispatcher_stalled" in detail["reasons"]
+    # threshold 0 = watchdog off
+    reg.gauge("fleet.tick_stall_after").set(0.0)
+    ok, _ = obs_http.readiness(reg)
+    assert ok
+
+
+def test_readyz_slo_burn_reason():
+    reg = MetricsRegistry()
+    ok, detail = obs_http.readiness(reg)
+    assert ok
+    reg.gauge("fleet.slo_burn").set(1.0)
+    ok, detail = obs_http.readiness(reg)
+    assert not ok and "slo_burn" in detail["reasons"]
+    reg.gauge("fleet.slo_burn").set(0.0)   # burn cleared: reason live
+    ok, _ = obs_http.readiness(reg)
+    assert ok
+
+
+def test_dispatcher_death_flips_readyz_dispatcher_stalled():
+    """route:1:die ends the dispatcher on the first routing decision;
+    the tick-age watchdog then flips /readyz to `dispatcher_stalled`
+    under the pinned JSON contract — HA stacks route around a gateway
+    that accepts jobs it will never place."""
+    handle = ReplicaHandle("rx", "http://127.0.0.1:9")  # nothing there
+    gw = Gateway(_fleet_cfg([handle.url], faults="route:1:die",
+                            stall_after=0.4),
+                 [handle]).start()
+    try:
+        http_json("POST", gw.url + "/v1/solve",
+                  {"tim": "4 2 2 5\n", "id": "s1"})
+        deadline = time.monotonic() + 20
+        reasons = []
+        while time.monotonic() < deadline:
+            try:
+                urllib.request.urlopen(gw.url + "/readyz", timeout=5)
+            except urllib.error.HTTPError as e:
+                body = json.loads(e.read())
+                reasons = body["reasons"]
+                assert e.headers["Content-Type"] == "application/json"
+                if "dispatcher_stalled" in reasons:
+                    break
+            time.sleep(0.1)
+        assert "dispatcher_stalled" in reasons, reasons
+    finally:
+        gw.close()
+        faults.install(None)
+
+
+# ------------------------------------------------ stitched trace unit
+
+
+def _gw_rec(name, ts, dur, flow, **extra):
+    return {"spanEntry": dict(name=name, cat="fleet", ts=ts, dur=dur,
+                              depth=0, tid=0, flow=flow, **extra)}
+
+
+def test_export_stitched_cross_process_chain_and_remap():
+    xid = XFLOW_BASE + 7
+    gw_log = [
+        _gw_rec("routed", 0.0, 0.5, xid, job="a"),
+        _gw_rec("settle", 4.0, 0.0, xid, job="a"),
+        # a gateway-local chain that must NOT merge with the replica's
+        _gw_rec("poll", 1.0, 0.1, 3),
+        _gw_rec("poll2", 1.2, 0.1, 3),
+    ]
+    rep_log = [
+        _gw_rec("admit", 0.6, 0.1, xid, job="a"),
+        _gw_rec("quantum", 1.0, 2.0, xid, job="a"),
+        # replica-local chunk chain with the SAME local id 3
+        _gw_rec("dispatch", 0.5, 0.2, 3),
+        _gw_rec("process", 0.9, 0.2, 3),
+    ]
+    doc = export_stitched([("gw.jsonl", gw_log),
+                           ("rep.jsonl", rep_log)])
+    evs = doc["traceEvents"]
+    # process lanes are labeled
+    meta = [e for e in evs if e.get("ph") == "M"]
+    assert {(e["pid"], e["args"]["name"]) for e in meta} == {
+        (0, "gw.jsonl"), (1, "rep.jsonl")}
+    # the cross-process chain keeps its id and SPANS BOTH pids
+    xflow = [e for e in evs if e.get("ph") in ("s", "t", "f")
+             and e["id"] == xid]
+    assert {e["pid"] for e in xflow} == {0, 1}
+    assert [e["ph"] for e in sorted(xflow, key=lambda e: e["ts"])] \
+        == ["s", "t", "t", "f"]
+    # the two LOCAL id-3 chains stay separate (remapped per input)
+    local_ids = {e["id"] for e in evs if e.get("ph") in ("s", "t", "f")
+                 and e["id"] != xid}
+    assert len(local_ids) == 2
+    # --job filters across inputs and keeps the cross-process chain
+    jdoc = export_stitched([("gw.jsonl", gw_log),
+                            ("rep.jsonl", rep_log)], job="a")
+    jevs = jdoc["traceEvents"]
+    assert sorted(e["name"] for e in jevs if e.get("ph") == "X") == \
+        ["admit", "quantum", "routed", "settle"]
+    assert {e["id"] for e in jevs
+            if e.get("ph") in ("s", "t", "f")} == {xid}
+
+
+def test_single_log_export_unchanged_no_remap():
+    log = [_gw_rec("a", 0.0, 1.0, 3), _gw_rec("b", 1.0, 1.0, 3)]
+    from timetabling_ga_tpu.obs.trace_export import export_chrome_trace
+    doc = export_chrome_trace(log)
+    evs = doc["traceEvents"]
+    assert not any(e.get("ph") == "M" for e in evs)
+    assert {e["id"] for e in evs
+            if e.get("ph") in ("s", "t", "f")} == {3}
+    assert all(e["pid"] == 0 for e in evs)
+
+
+# ------------------------------------------- acceptance: fleet e2e
+
+
+def test_gateway_obs_end_to_end_flow_metrics_slo_identity():
+    """ISSUE 11 acceptance: a routed job traced end to end. The
+    gateway's log and the replica's log share the job's XFLOW flow id;
+    the stitched export draws the chain across the process boundary;
+    the gateway serves /metrics parity families and the contract
+    /readyz (slo_burn, with the burn faultEntry on the log); tt stats
+    over both logs shows the `routed` component and the placement
+    summary; and the job record streams stay identical to an unrouted
+    solve (modulo timing records)."""
+    rep, handle = in_process_replica(_serve_cfg(obs=True), "r0")
+    gwbuf = io.StringIO()
+    gw = Gateway(_fleet_cfg([handle.url], slo_p99=0.001,
+                            metrics_every=10),
+                 [handle], out=gwbuf).start()
+    jobs = [(f"fo-{i}", random_instance(700 + i, **_SHAPE), 40 + i, 8)
+            for i in range(2)]
+    try:
+        for jid, p, seed, gens in jobs:
+            http_json("POST", gw.url + "/v1/solve",
+                      {"tim": dump_tim(p), "id": jid, "seed": seed,
+                       "generations": gens})
+        settled = _wait_done(gw, [j[0] for j in jobs])
+        assert all(j.state == "done" for j in settled.values())
+
+        # --- /metrics parity, via the shared parser -----------------
+        fams = obs_scrape.parse_exposition(
+            http_text(gw.url + "/metrics"))
+        assert obs_scrape.scalar(
+            fams, "tt_fleet_jobs_done_total") == 2.0
+        assert (obs_scrape.scalar(fams, "tt_fleet_route_warm_total",
+                                  0.0)
+                + obs_scrape.scalar(fams, "tt_fleet_route_hit_total",
+                                    0.0)) >= 2.0
+        assert obs_scrape.scalar(
+            fams, "tt_fleet_replica_r0_ready") == 1.0
+        assert obs_scrape.scalar(
+            fams, "tt_fleet_replica_r0_pins") >= 1.0
+        assert obs_scrape.scalar(
+            fams, "tt_fleet_replica_r0_probe_seconds") is not None
+        assert obs_scrape.scalar(
+            fams, "tt_fleet_tick_seconds_count") > 0
+        assert obs_scrape.labeled(
+            fams, "tt_fleet_job_seconds_bucket", le="+Inf") == 2.0
+        # job-id exemplar on the e2e histogram (OpenMetrics form)
+        assert '# {job="fo-' in http_text(gw.url + "/metrics")
+
+        # --- /readyz: the SLO (0.001s) is burning -------------------
+        try:
+            urllib.request.urlopen(gw.url + "/readyz", timeout=5)
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            body = json.loads(e.read())
+        assert body["ready"] is False
+        assert "slo_burn" in body["reasons"]
+    finally:
+        gw.request_drain()
+        gw.drained.wait(30)
+        gw.close()
+        rep.stop(timeout=60)
+
+    gwrecs = _records(gwbuf)
+    reprecs = _records(rep.tail._stream)
+
+    # --- cross-process flow identity (THE acceptance pin) -----------
+    routed = _spans(gwrecs, name="routed", job="fo-0")
+    assert routed, "gateway emitted no routed span"
+    flow = routed[0]["flow"]
+    assert flow >= XFLOW_BASE
+    rep_admit = _spans(reprecs, name="admit", job="fo-0")
+    assert rep_admit and rep_admit[0]["flow"] == flow, \
+        "replica admit span does not continue the gateway's chain"
+    # every gateway phase span of the job rides the same chain
+    for name in ("route", "submit", "settle"):
+        ss = _spans(gwrecs, name=name, job="fo-0")
+        assert ss and all(s["flow"] == flow for s in ss)
+
+    # --- routeEntry placement records -------------------------------
+    routes = [r["routeEntry"] for r in gwrecs if "routeEntry" in r]
+    assert {r["job"] for r in routes} == {"fo-0", "fo-1"}
+    assert all(r["replica"] == "r0" for r in routes)
+    assert all(r["outcome"] in ("hit", "warm", "miss")
+               for r in routes)
+    assert all("compile_hit_rate" in r and "pins" in r
+               for r in routes)
+
+    # --- the SLO burn left a faultEntry on the gateway log ----------
+    burns = [r["faultEntry"] for r in gwrecs if "faultEntry" in r]
+    assert any(f["site"] == "slo_burn" and f["action"] == "burn"
+               for f in burns)
+    # --- periodic metricsEntry snapshots rode the log ---------------
+    assert any("metricsEntry" in r for r in gwrecs)
+
+    # --- stitched export crosses the process boundary ---------------
+    doc = export_stitched([("gateway.jsonl", gwrecs),
+                           ("replica.jsonl", reprecs)], job="fo-0")
+    evs = doc["traceEvents"]
+    chain = [e for e in evs if e.get("ph") in ("s", "t", "f")
+             and e["id"] == flow]
+    assert {e["pid"] for e in chain} == {0, 1}, \
+        "flow chain does not cross the process boundary"
+    names = {e["name"] for e in evs if e.get("ph") == "X"}
+    assert "routed" in names and "quantum" in names
+
+    # --- tt stats learns the gateway records ------------------------
+    text = summarize(gwrecs + reprecs)
+    assert "placements" in text and "r0: 2 placements" in text
+    line = next(x for x in text.splitlines()
+                if x.startswith("  fo-0: total "))
+    assert "routed" in line
+    assert "routed: p50" in text
+
+    # --- record identity: routed (gateway obs ON) == unrouted -------
+    buf = io.StringIO()
+    svc = SolveService(ServeConfig(backend="cpu", lanes=2, quantum=5,
+                                   pop_size=4, max_steps=8), out=buf)
+    for jid, p, seed, gens in jobs:
+        svc.submit(p, job_id=jid, seed=seed, generations=gens)
+    svc.drive()
+    svc.close()
+    base: dict = {}
+    for rec in _records(buf):
+        body = rec[next(iter(rec))]
+        if isinstance(body, dict) and body.get("job") is not None:
+            base.setdefault(body["job"], []).append(rec)
+    for jid, j in settled.items():
+        assert jsonl.strip_timing(j.records) == \
+            jsonl.strip_timing(base[jid]), f"stream diverged for {jid}"
+
+
+# ------------------------------------------- fault-site isolation
+
+
+def test_dead_gateway_writer_never_stalls_settlement():
+    """gw_writer:1:die kills the gateway's telemetry writer on its
+    first record: obs emission latches OFF and every job still routes,
+    solves, and settles — the dispatcher never waits on the log."""
+    rep, handle = in_process_replica(_serve_cfg(), "rw")
+    gwbuf = io.StringIO()
+    gw = Gateway(_fleet_cfg([handle.url], faults="gw_writer:1:die"),
+                 [handle], out=gwbuf).start()
+    try:
+        p = random_instance(711, **_SHAPE)
+        http_json("POST", gw.url + "/v1/solve",
+                  {"tim": dump_tim(p), "id": "w1", "seed": 5,
+                   "generations": 8})
+        settled = _wait_done(gw, ["w1"], timeout=90)
+        assert settled["w1"].state == "done"
+        assert not gw.writer.alive()           # the worker is dead
+        assert gw._obs_dead                    # emission latched off
+    finally:
+        gw.close()
+        faults.install(None)
+        rep.kill()
+
+
+def test_hung_replica_scrape_never_stalls_settlement(monkeypatch):
+    """gw_scrape:2:hang parks the PROBER thread mid-scrape (the first
+    scrape is the synchronous pre-start probe): routing runs on the
+    last-probed gauges and the job settles — nothing on the dispatch
+    or settlement path ever waits for the scrape."""
+    monkeypatch.setattr(faults, "HANG_S", 8.0)
+    rep, handle = in_process_replica(_serve_cfg(), "rh")
+    gw = Gateway(_fleet_cfg([handle.url], faults="gw_scrape:2:hang"),
+                 [handle]).start()
+    try:
+        p = random_instance(712, **_SHAPE)
+        t0 = time.monotonic()
+        http_json("POST", gw.url + "/v1/solve",
+                  {"tim": dump_tim(p), "id": "h1", "seed": 6,
+                   "generations": 8})
+        settled = _wait_done(gw, ["h1"], timeout=90)
+        assert settled["h1"].state == "done"
+        # settlement did not serialize behind the 8 s hang window in
+        # any blocking way — it completed while/despite the prober
+        # being parked (generous bound: solve time, not hang time)
+        assert time.monotonic() - t0 < 60
+    finally:
+        gw.close()
+        faults.install(None)
+        rep.kill()
+
+
+def test_gateway_ctor_failure_closes_writer():
+    """A taken listen port fails Gateway.__init__ AFTER the telemetry
+    writer started its worker thread — close() is unreachable, so the
+    constructor itself must drain and stop the writer (the
+    SolveService constructor-failure discipline)."""
+    import socket
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    handle = ReplicaHandle("rz", "http://127.0.0.1:9")
+    buf = io.StringIO()
+    import threading
+    before = threading.active_count()
+    try:
+        with pytest.raises(OSError):
+            Gateway(_fleet_cfg([handle.url],
+                               listen=f"127.0.0.1:{port}"),
+                    [handle], out=buf)
+        deadline = time.monotonic() + 5
+        while threading.active_count() > before \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert threading.active_count() <= before, \
+            "writer worker thread leaked past the failed constructor"
+    finally:
+        blocker.close()
+
+
+def test_gw_writer_site_is_separate_from_writer_site():
+    """A `writer` plan must not fire on a gw_writer-sited AsyncWriter
+    and vice versa — separate sites keep a gateway-log fault from
+    shifting a replica writer plan's invocation indices."""
+    faults.install("writer:1:die")
+    try:
+        buf = io.StringIO()
+        w = jsonl.AsyncWriter(buf, site="gw_writer")
+        w.write('{"a":1}\n')
+        w.drain()
+        assert w.alive()                       # plan did not fire
+        w.close()
+        assert buf.getvalue() == '{"a":1}\n'
+    finally:
+        faults.install(None)
+    faults.install("gw_writer:1:die")
+    try:
+        w = jsonl.AsyncWriter(io.StringIO(), site="gw_writer")
+        deadline = time.monotonic() + 5
+        w.write('{"a":1}\n')                   # worker dies dequeuing
+        while w.alive() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not w.alive()
+        with pytest.raises(RuntimeError):
+            w.write('{"b":2}\n')
+            w.drain()
+    finally:
+        faults.install(None)
+
+
+# ------------------------------------------------ stats breakdown fix
+
+
+def _serve_span(name, ts, dur, job):
+    return {"spanEntry": dict(name=name, cat="serve", ts=ts, dur=dur,
+                              depth=0, tid=0, job=job, flow=1)}
+
+
+def test_breakdown_routed_identity_across_clock_domains():
+    """The gateway leg enters the breakdown as a clock-safe duration
+    SUM: gateway timestamps (own epoch, here skewed +100s) are never
+    differenced against replica timestamps, `parked` does not absorb
+    the routed time, and the printed identity total = queued + routed
+    + packed + executing + parked holds (modulo finalize)."""
+    xid = XFLOW_BASE + 1
+    recs = [
+        # gateway log: epoch skewed far from the replica's
+        _gw_rec("routed", 100.0, 2.0, xid, job="a"),
+        _gw_rec("settle", 104.0, 0.0, xid, job="a"),
+        # replica log: its own epoch
+        _serve_span("admit", 0.0, 0.0, "a"),
+        _serve_span("pack", 0.5, 0.5, "a"),
+        _serve_span("quantum", 1.0, 1.0, "a"),
+        _serve_span("finalize", 2.0, 0.0, "a"),
+    ]
+    from timetabling_ga_tpu.obs.logstats import _job_breakdown
+    b = _job_breakdown([r["spanEntry"] for r in recs])["a"]
+    assert b["routed"] == pytest.approx(2.0)
+    # window = replica spans only (2.0s), NOT the 100s epoch skew
+    assert b["total"] == pytest.approx(4.0)      # 2.0 window + routed
+    assert b["queued"] == pytest.approx(0.5)
+    assert b["packed"] == pytest.approx(0.5)
+    assert b["executing"] == pytest.approx(1.0)
+    assert b["parked"] == pytest.approx(0.0)     # no double-count
+    assert b["total"] == pytest.approx(
+        b["queued"] + b["routed"] + b["packed"] + b["executing"]
+        + b["parked"])
+
+    # gateway-ONLY view: the routed span IS the window's work — still
+    # no double-count, identity still holds
+    g = _job_breakdown([r["spanEntry"] for r in recs
+                        if r["spanEntry"]["cat"] == "fleet"])["a"]
+    assert g["total"] == pytest.approx(4.0)
+    assert g["routed"] == pytest.approx(2.0)
+    assert g["parked"] == pytest.approx(2.0)     # placed→settled
+    assert g["total"] == pytest.approx(
+        g["queued"] + g["routed"] + g["packed"] + g["executing"]
+        + g["parked"])
+
+
+def test_breakdown_failover_windows_one_replica_log():
+    """A failed-over job has replica spans in TWO logs with unrelated
+    epochs: the window (and the replica-side tallies) come from the
+    leg that FINALIZED (`_src` provenance, stamped per input file by
+    main_stats); the dead replica's partial leg never mixes its
+    timestamps in. The gateway's routed spans — one per placement
+    round, non-overlapping — sum across rounds."""
+    from timetabling_ga_tpu.obs.logstats import _job_breakdown
+    xid = XFLOW_BASE + 2
+
+    def src(rec, i):
+        rec["spanEntry"]["_src"] = i
+        return rec["spanEntry"]
+
+    spans = [
+        # gateway log (src 0): first placement + failover re-placement
+        src(_gw_rec("routed", 0.0, 0.5, xid, job="a"), 0),
+        src(_gw_rec("routed", 10.0, 1.5, xid, job="a"), 0),
+        # dead replica r0 (src 1): partial leg, big epoch offset
+        src(_serve_span("admit", 900.0, 0.0, "a"), 1),
+        src(_serve_span("quantum", 900.5, 3.0, "a"), 1),
+        # surviving replica r1 (src 2): full replay, small epoch
+        src(_serve_span("admit", 1.0, 0.0, "a"), 2),
+        src(_serve_span("pack", 1.5, 0.5, "a"), 2),
+        src(_serve_span("quantum", 2.0, 2.0, "a"), 2),
+        src(_serve_span("finalize", 4.0, 0.0, "a"), 2),
+    ]
+    b = _job_breakdown(spans)["a"]
+    assert b["routed"] == pytest.approx(2.0)     # 0.5 + 1.5, summed
+    # window = the finalizing leg only (3.0s), never the 900s epoch
+    assert b["total"] == pytest.approx(3.0 + 2.0)
+    assert b["executing"] == pytest.approx(2.0)  # r1's quantum only
+    assert b["total"] == pytest.approx(
+        b["queued"] + b["routed"] + b["packed"] + b["executing"]
+        + b["parked"])
+
+
+# --------------------------------------------------------- CLI flags
+
+
+def test_parse_fleet_args_obs_flags():
+    cfg = parse_fleet_args(
+        ["--replica", "http://a:1", "-o", "gw.jsonl",
+         "--slo-p99", "2.5", "--slo-window", "50",
+         "--stall-after", "10", "--metrics-every", "20"])
+    assert cfg.output == "gw.jsonl"
+    assert cfg.slo_p99 == 2.5
+    assert cfg.slo_window == 50
+    assert cfg.stall_after == 10.0
+    assert cfg.metrics_every == 20
+    with pytest.raises(SystemExit):
+        parse_fleet_args(["--replica", "u", "--slo-p99", "-1"])
+    with pytest.raises(SystemExit):
+        parse_fleet_args(["--replica", "u", "--slo-window", "0"])
+    with pytest.raises(SystemExit):
+        parse_fleet_args(["--replica", "u", "--stall-after", "-1"])

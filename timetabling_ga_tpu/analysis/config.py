@@ -66,6 +66,15 @@ class AnalyzerConfig:
     # TT605 audits for inline device work and unbounded socket reads
     fleet_modules: list[str] = dataclasses.field(
         default_factory=lambda: ["fleet/"])
+    # class-name suffixes treated as handler-path ROOTS by the
+    # TT602/TT605 reachability walk, in addition to handler classes
+    # themselves: the fleet fronts route every request into an
+    # enqueue-or-read-only `api` object (GatewayApi / ReplicaApi —
+    # fleet/gateway.py handler discipline), whose methods run ON the
+    # handler thread but live in a class the do_*-method heuristic
+    # cannot see
+    handler_api_suffixes: list[str] = dataclasses.field(
+        default_factory=lambda: ["Api"])
 
     root: str = "."
 

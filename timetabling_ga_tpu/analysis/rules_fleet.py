@@ -70,8 +70,12 @@ def _is_unbounded_rfile_read(node: ast.Call) -> str | None:
 def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
     if not _in_scope(path, ctx):
         return []
+    # same roots as TT602: handler classes PLUS the *Api surfaces the
+    # handlers call into (handler-api-suffixes in pyproject)
+    suffixes = tuple(getattr(ctx.config, "handler_api_suffixes",
+                             ("Api",)))
     findings: list[Finding] = []
-    for where, fn in _reachable(tree):
+    for where, fn in _reachable(tree, suffixes):
         for node in ast.walk(fn):
             if not isinstance(node, ast.Call):
                 continue

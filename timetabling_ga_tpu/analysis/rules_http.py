@@ -22,8 +22,14 @@ Scope: classes that look like HTTP handlers — a base named
 `*HTTPRequestHandler`, or any `do_*` method (the `http.server` routing
 convention, so duck-typed handlers are covered too) — plus everything
 reachable from their methods within the module (`self.helper()` calls
-and bare-name calls to module functions). Cross-module calls are out
-of scope: the rule guards the handler modules themselves, and the
+and bare-name calls to module functions), PLUS classes whose name ends
+with a configured `handler-api-suffixes` entry (default `Api`): the
+fleet fronts route every request into an enqueue-or-read-only `api`
+object (`self.server.api.accept_solve(...)` — fleet/gateway.py
+GatewayApi, fleet/replicas.py ReplicaApi), whose methods run ON the
+handler thread but in a class the do_* heuristic cannot see, often in
+a different module from the handler. Cross-module calls are otherwise
+out of scope: the rule guards the handler modules themselves, and the
 registry's own module is exempt (it IS the lock-holding implementation
 the rule keeps handlers out of).
 
@@ -76,10 +82,14 @@ def _is_handler_class(cls: ast.ClassDef) -> bool:
                and n.name.startswith("do_") for n in cls.body)
 
 
-def _reachable(tree: ast.Module) -> list[tuple[str, ast.AST]]:
+def _reachable(tree: ast.Module, api_suffixes: tuple = ()
+               ) -> list[tuple[str, ast.AST]]:
     """Handler-reachable function bodies: every method of a handler
-    class, plus (transitively, intra-module) same-class methods called
-    as `self.x(...)` and module functions called by bare name."""
+    class — and of any class named `*<api_suffix>` (the fleet fronts'
+    enqueue-or-read-only api objects, called as `self.server.api.x()`
+    from handler threads) — plus (transitively, intra-module)
+    same-class methods called as `self.x(...)` and module functions
+    called by bare name."""
     mod_funcs = {n.name: n for n in tree.body
                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
     work: list[tuple[str, str, ast.AST]] = []   # (owner, name, node)
@@ -91,7 +101,9 @@ def _reachable(tree: ast.Module) -> list[tuple[str, ast.AST]]:
                    if isinstance(n, (ast.FunctionDef,
                                      ast.AsyncFunctionDef))}
         classes[node.name] = methods
-        if _is_handler_class(node):
+        if _is_handler_class(node) or any(
+                node.name.endswith(sfx) for sfx in api_suffixes
+                if sfx):
             for name, fn in methods.items():
                 work.append((node.name, name, fn))
     seen: set[tuple[str, str]] = {(o, n) for o, n, _ in work}
@@ -123,8 +135,10 @@ def _reachable(tree: ast.Module) -> list[tuple[str, ast.AST]]:
 def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
     if path.replace("\\", "/").endswith(_EXEMPT_SUFFIXES):
         return []
+    suffixes = tuple(getattr(ctx.config, "handler_api_suffixes",
+                             ("Api",)))
     findings: list[Finding] = []
-    for where, fn in _reachable(tree):
+    for where, fn in _reachable(tree, suffixes):
         for node in ast.walk(fn):
             if not isinstance(node, ast.Call):
                 continue

@@ -26,9 +26,16 @@ JSONL record stream, never a device.
     python -m timetabling_ga_tpu.cli trace --job j42 serve.jsonl
         one serve job's end-to-end timeline (admit -> pack -> quantum
         -> park -> resume), co-tenant noise filtered out
-    python -m timetabling_ga_tpu.cli stats run.jsonl
+    python -m timetabling_ga_tpu.cli trace --job j42 \
+            gateway.jsonl tt-fleet-r0.jsonl tt-fleet-r1.jsonl
+        several logs stitch into ONE timeline: a process lane per log
+        and flow arrows crossing the process boundary — a routed
+        job's gateway leg (route/submit/settle) connected to its
+        replica solve leg by the X-TT-Flow chain (tt-obs v5)
+    python -m timetabling_ga_tpu.cli stats run.jsonl [more.jsonl ...]
         summarize: best-so-far curves, recoveries, per-job latency
-        (for serve logs: queued/packed/executing/parked breakdown)
+        (for serve logs: queued/routed/packed/executing/parked
+        breakdown; for gateway logs: the routeEntry placement summary)
     python -m timetabling_ga_tpu.cli quality run.jsonl
         summarize the search-quality telemetry (--quality runs):
         diversity trend, operator hit rates, migration gain, stalls
@@ -46,9 +53,10 @@ bucket-affine router over replicas (`tt serve --http` workers), and
 the stdlib client that submits one instance and waits.
 
     python -m timetabling_ga_tpu.cli fleet --listen 127.0.0.1:8070 \
-        --spawn 2 -- --backend cpu --lanes 4
+        -o gateway.jsonl --slo-p99 30 --spawn 2 -- --backend cpu \
+        --lanes 4
     python -m timetabling_ga_tpu.cli submit http://127.0.0.1:8070 \
-        comp01.tim -s 42 --generations 200
+        comp01.tim -s 42 --generations 200 --records-out job.jsonl
 """
 
 from __future__ import annotations

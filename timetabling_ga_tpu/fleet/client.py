@@ -8,6 +8,12 @@ state as JSON on stdout:
         --generations 200 --priority 5
     tt submit URL instance.tim --no-wait        just the job id
     tt submit URL instance.tim --records        include the record tail
+    tt submit URL instance.tim --records-out job.jsonl
+        write the job's record tail as JSONL LINES to a file — the
+        same stream an unrouted solve emits, ready for `tt stats
+        job.jsonl` or `tt trace --job ID job.jsonl gateway.jsonl`
+        (the fleet observatory's stitched timeline) without shell
+        jq surgery on the JSON view
 
 Pure stdlib (urllib + json): it must run from any machine that can
 reach the fleet, with no solver stack installed. Exit status: 0 when
@@ -36,6 +42,8 @@ submit one instance to a fleet gateway (or a single replica) and wait:
   --poll <float>        poll interval, seconds (default 0.5)
   --timeout <float>     give up after this many seconds (default 3600)
   --records             print the job-tagged record tail too
+  --records-out <path>  write the record tail as JSONL lines to this
+                        file (tt stats / tt trace input)
   --no-wait             print the job id and exit without polling
   -h, --help            show this message and exit"""
 
@@ -87,6 +95,7 @@ def main_submit(argv) -> int:
     poll, timeout = 0.5, 3600.0
     wait = True
     records = False
+    records_out = None
     i = 0
     flag_types = {"--id": ("id", str), "--priority": ("priority", int),
                   "-s": ("seed", int),
@@ -100,6 +109,14 @@ def main_submit(argv) -> int:
         if a == "--records":
             records = True
             i += 1
+            continue
+        if a == "--records-out":
+            if i + 1 >= len(rest):
+                print("flag --records-out needs a value",
+                      file=sys.stderr)
+                return 2
+            records_out = rest[i + 1]
+            i += 2
             continue
         if a == "--no-wait":
             wait = False
@@ -149,6 +166,18 @@ def main_submit(argv) -> int:
     if not wait:
         print(json.dumps(view))
         return 0
+    if records_out is not None:
+        # the record tail AS A JSONL STREAM — byte-layout compatible
+        # with an unrouted solve's -o file, so tt stats / tt trace
+        # (incl. the stitched fleet timeline) read it directly
+        try:
+            with open(records_out, "w", encoding="utf-8") as fh:
+                for rec in view.get("records") or []:
+                    fh.write(json.dumps(rec, separators=(",", ":"))
+                             + "\n")
+        except OSError as e:
+            print(f"tt submit: {e}", file=sys.stderr)
+            return 2
     if not records:
         view = {k: v for k, v in view.items() if k != "records"}
     print(json.dumps(view))

@@ -45,10 +45,44 @@ now places it. A job's record stream is a pure function of its own
 emits records bit-identical to an unrouted solve of the same job
 (tests/test_fleet.py and bench extra.fleet pin it, modulo timing
 fields).
+
+Observability (tt-obs v5, README "Fleet observability"): `-o LOG`
+gives the gateway its own JSONL telemetry stream through an
+AsyncWriter (fault site `gw_writer` — a dead log writer disables
+emission and the dispatcher routes on, never stalls). The dispatcher's
+phases emit spans (route / submit / poll / failover / settle, plus a
+`routed` span measuring admit→placed), every placement emits a
+`routeEntry` (bucket, chosen replica, score inputs, hit/warm/miss),
+and metricsEntry snapshots ride every `--metrics-every` ticks. Each
+admitted job gets a CROSS-PROCESS flow id (obs/spans.py XFLOW_BASE
+range) shipped to its replica as an `X-TT-Flow` header, so
+`tt trace --job ID gateway.jsonl replica*.jsonl` stitches the job's
+whole life — gateway routing leg + replica solve leg — into one
+Perfetto timeline with process-labeled lanes and flow arrows crossing
+the process boundary.
+
+/metrics parity: everything `/v1/fleet` reports is derivable from the
+gateway's registry families on the same port — per-replica
+`fleet.replica.<name>.{ready,backlog,probe_seconds,compile_hit_rate,
+pins,restarts}` gauges, routing counters `fleet.route.{hit,warm,miss,
+repins}`, `fleet.jobs_{accepted,routed,done,failed,failed_over}`,
+dispatcher `fleet.tick_seconds` timing, `fleet.submit_retries`, and
+the `fleet.job_seconds` e2e histogram with job-id exemplars. The JSON
+view is a convenience snapshot (refreshed once per dispatcher tick,
+served from a lock-guarded copy — handlers never read router state
+the dispatcher is mutating); dashboards should scrape `/metrics`.
+
+Readiness for HA stacking: the gateway answers `/readyz` under the
+same pinned JSON contract as replicas (obs/http.py readiness), with
+gateway reasons `no_ready_replica`, `dispatcher_stalled` (watchdog
+over the dispatcher's tick age, `--stall-after`) and `slo_burn`
+(`--slo-p99` rolling-window p99 over e2e latencies; the burn's
+start/clear also emit faultEntry records on the gateway log).
 """
 
 from __future__ import annotations
 
+import collections
 import itertools
 import json
 import queue as queue_mod
@@ -59,9 +93,10 @@ import urllib.parse
 
 from timetabling_ga_tpu.obs import http as obs_http
 from timetabling_ga_tpu.obs import metrics as obs_metrics
+from timetabling_ga_tpu.obs.spans import NULL_TRACER, SpanTracer, XFLOW_BASE
 from timetabling_ga_tpu.problem import (
     DAYS_DEFAULT, SLOTS_PER_DAY_DEFAULT)
-from timetabling_ga_tpu.runtime import faults
+from timetabling_ga_tpu.runtime import faults, jsonl
 from timetabling_ga_tpu.runtime.config import (
     FleetConfig, ServeConfig, parse_fleet_args, parse_serve_args)
 from timetabling_ga_tpu.runtime.retry import retry_transient
@@ -194,7 +229,8 @@ class ApiHandler(obs_http._Handler):
             except ValueError as e:
                 self._reply_json(400, {"error": str(e)[:300]})
                 return
-            status, obj = self.server.api.accept_solve(payload)
+            status, obj = self.server.api.accept_solve(
+                payload, flow=self._flow_header())
             self._reply_json(status, obj)
         elif path == "/v1/drain":
             # consume any declared body BEFORE the 200: a keep-alive
@@ -215,6 +251,15 @@ class ApiHandler(obs_http._Handler):
             self._reply_json(status, obj)
         else:
             self._reply_json(404, {"error": f"no route {path!r}"})
+
+    def _flow_header(self) -> int:
+        """The gateway's cross-process flow id riding `X-TT-Flow`
+        (obs/spans.py XFLOW_BASE range), 0 when absent/garbage — pure
+        telemetry, so a bad value is ignored, never a 400."""
+        try:
+            return int(self.headers.get("X-TT-Flow") or 0)
+        except ValueError:
+            return 0
 
     def _discard_body(self) -> None:
         try:
@@ -293,6 +338,18 @@ class GatewayJob:
         self.submitted_t = now
         self.finished_t = None
         self.counted = False         # terminal counters bumped once
+        self.flow = 0                # cross-process causal flow id
+        #                              (obs/spans.py XFLOW_BASE range),
+        #                              minted by the dispatcher at first
+        #                              placement and shipped to the
+        #                              replica as X-TT-Flow — gateway
+        #                              and replica spans share it
+        self.routed_any = False      # a routed span was emitted: later
+        #                              placements (failover) measure
+        #                              from THEIR round's start, so the
+        #                              job's routed spans never overlap
+        #                              and their sum stays a real
+        #                              placement-time total
 
     def terminal(self) -> bool:
         return self.state in TERMINAL
@@ -315,7 +372,12 @@ class GatewayApi:
     def __init__(self, gw: "Gateway"):
         self._gw = gw
 
-    def accept_solve(self, payload: dict):
+    def accept_solve(self, payload: dict, flow: int = 0):
+        # `flow` (an upstream X-TT-Flow) is accepted for signature
+        # parity with ReplicaApi but ignored: the gateway is the ROOT
+        # allocator of cross-process chains — its dispatcher mints
+        # each job's flow at first placement
+        del flow
         gw = self._gw
         if gw.draining:
             return 503, {"error": "draining", "reasons": ["draining"]}
@@ -386,15 +448,13 @@ class GatewayApi:
         return 200, {"draining": True, "active": active}
 
     def fleet_view(self):
-        gw = self._gw
-        with gw.jobs_lock:
-            states: dict = {}
-            for j in gw.jobs.values():
-                states[j.state] = states.get(j.state, 0) + 1
-        return 200, {"replicas": [h.view()
-                                  for h in gw.replicas.all()],
-                     "router": gw.router.stats(),
-                     "jobs": states, "draining": gw.draining}
+        # served from the dispatcher's lock-guarded SNAPSHOT, refreshed
+        # once per tick — the handler thread never reads router/replica
+        # state the dispatcher is mutating (the live view used to walk
+        # `router._pins` mid-placement). The JSON is a convenience: the
+        # same numbers are real /metrics families (fleet.replica.*,
+        # fleet.route.*, fleet.jobs_* — module docstring maps them)
+        return 200, self._gw.fleet_snapshot()
 
 
 class Gateway:
@@ -402,7 +462,7 @@ class Gateway:
     owns routing, submission, polling, failover, and drain."""
 
     def __init__(self, cfg: FleetConfig, handles, owned: bool = False,
-                 now=None):
+                 now=None, out=None):
         # deterministic fault injection, mirroring SolveService: the
         # gateway/route sites fire under `tt fleet` too
         spec = faults.active_spec(cfg.faults)
@@ -422,6 +482,20 @@ class Gateway:
         #                              would be popped right back and
         #                              starve the poll/drain phases)
         self._terminal_order: list = []   # settled ids, eviction FIFO
+        # -- telemetry stream (tt-obs v5): `-o LOG` (or an explicit
+        # `out` stream) gives the gateway its own AsyncWriter + tracer;
+        # without one the tracer is the shared no-op and nothing emits
+        self._stream = out
+        self._close_stream = False
+        if self._stream is None and cfg.output:
+            self._stream = open(cfg.output, "w")
+            self._close_stream = True
+        self.writer = (jsonl.AsyncWriter(self._stream, site="gw_writer")
+                       if self._stream is not None else None)
+        self._obs_dead = False       # latched by _rec on a dead writer
+        self.tracer = (SpanTracer(self.writer, clock=self.now,
+                                  flow_base=XFLOW_BASE)
+                       if self.writer is not None else NULL_TRACER)
         # the serve flags spawned workers run with double as the
         # router's bucket spec — one parse, no drift
         serve_cfg = (parse_serve_args(cfg.serve_args)
@@ -438,8 +512,8 @@ class Gateway:
             probe_timeout=cfg.probe_timeout,
             dead_after=cfg.dead_after, max_restarts=cfg.max_restarts,
             on_death=self._on_death, boot_grace=cfg.boot_grace)
-        self.router = Router(self.replicas)
         self.registry = obs_metrics.MetricsRegistry()
+        self.router = Router(self.replicas, registry=self.registry)
         self.registry.gauge_fn(
             "fleet.replicas_ready",
             lambda: sum(1 for h in self.replicas.live() if h.ready))
@@ -448,13 +522,57 @@ class Gateway:
             lambda: sum(1 for j in list(self.jobs.values())
                         if not j.terminal()))
         self.registry.gauge("serve.backlog").set(cfg.backlog)
+        for h in handles:
+            self._bind_replica_gauges(h)
+        if self.writer is not None:
+            self.registry.gauge_fn("writer.queue_depth",
+                                   self.writer.qsize)
+        # dispatcher watchdog: tick age as a pull gauge + the
+        # configured threshold, so /readyz (obs/http.py readiness) can
+        # flip `dispatcher_stalled` from registry state alone
+        self._ticks = 0
+        self._last_tick = self.now()
+        self.registry.gauge_fn("fleet.tick_age_s",
+                               lambda: self.now() - self._last_tick)
+        self.registry.gauge("fleet.tick_stall_after").set(
+            cfg.stall_after)
+        # SLO monitor (--slo-p99): rolling window of e2e latencies,
+        # p99'd once per tick; transitions emit faultEntry records
+        self._slo_lat = collections.deque(maxlen=cfg.slo_window)
+        self._slo_burning = False
+        if cfg.slo_p99 > 0:
+            self.registry.gauge("fleet.slo_burn").set(0.0)
+        # /v1/fleet snapshot: refreshed by the dispatcher each tick,
+        # served by handlers under _view_lock (never the live state)
+        self._view_lock = threading.Lock()
+        self._view_cache: dict = {}
         self._thread = threading.Thread(
             target=self._dispatch_loop, name="tt-fleet-dispatch",
             daemon=True)
-        self.front = obs_http.ObsServer(
-            cfg.listen, registry=self.registry,
-            probes={"dispatcher": self._thread.is_alive},
-            handler=ApiHandler, api=GatewayApi(self), site="gateway")
+        try:
+            self.front = obs_http.ObsServer(
+                cfg.listen, registry=self.registry,
+                probes={"dispatcher": self._thread.is_alive},
+                handler=ApiHandler, api=GatewayApi(self),
+                site="gateway")
+        except BaseException:
+            # the listen port is taken: close() is unreachable, so the
+            # telemetry writer's worker thread (and the -o file handle
+            # it holds) must not outlive the gateway that never
+            # existed — the same constructor-failure discipline
+            # SolveService.__init__ applies (obs server there)
+            if self.writer is not None:
+                try:
+                    self.writer.close(raise_error=False)
+                except Exception:
+                    pass
+                if self._close_stream:
+                    try:
+                        self._stream.close()
+                    except Exception:
+                        pass
+            raise
+        self._refresh_view()
 
     # -- lifecycle ------------------------------------------------------
 
@@ -480,8 +598,122 @@ class Gateway:
         self._stop = True
         self.inbox.put(("wake",))
         self._thread.join(timeout=5.0)
+        if self.writer is not None:
+            # final registry snapshot, then drain the telemetry log —
+            # raise_error=False: a latched writer error must not mask
+            # the caller's own teardown
+            self._rec(jsonl.metrics_entry, self.writer,
+                      self.registry.snapshot(), ts=self.tracer.now())
+            try:
+                self.writer.close(raise_error=False)
+            except Exception:
+                pass
+            if self._close_stream:
+                try:
+                    self._stream.close()
+                except Exception:
+                    pass
         self.front.close()
         self.replicas.close()
+
+    # -- telemetry plumbing (tt-obs v5) ---------------------------------
+
+    def _rec(self, fn, *args, **kw) -> None:
+        """Guarded record emission (routeEntry / metricsEntry /
+        faultEntry / tracer.record): the `gw_writer` isolation
+        contract — a dead gateway log writer latches obs OFF and the
+        dispatcher routes on; it never stalls placement or
+        settlement."""
+        if self.writer is None or self._obs_dead:
+            return
+        try:
+            fn(*args, **kw)
+        except Exception:
+            self._obs_dead = True
+            self.tracer.enabled = False
+
+    def _bind_replica_gauges(self, h) -> None:
+        """Per-replica /metrics families (ROADMAP item 3's gateway
+        parity): the same numbers `/v1/fleet` shows, as pull gauges
+        over the handle's probe state. A None field (never probed)
+        reads as NaN — Gauge.value degrades, never raises."""
+        base = f"fleet.replica.{h.name}"
+        reg = self.registry
+        reg.gauge_fn(f"{base}.ready",
+                     lambda h=h: 0.0 if h.dead else float(h.ready))
+        reg.gauge_fn(f"{base}.backlog",
+                     lambda h=h: float(h.queue_depth))
+        reg.gauge_fn(f"{base}.probe_seconds",
+                     lambda h=h: float(h.probe_seconds))
+        reg.gauge_fn(f"{base}.compile_hit_rate",
+                     lambda h=h: float(h.compile_hit_rate()))
+        reg.gauge_fn(f"{base}.pins",
+                     lambda h=h: float(
+                         self.router.pin_counts.get(h.name, 0)))
+        reg.gauge_fn(f"{base}.restarts",
+                     lambda h=h: float(h.restarts))
+
+    def _refresh_view(self) -> None:
+        """Rebuild the /v1/fleet snapshot ON the dispatcher (the only
+        thread mutating router/job state) and publish it under the
+        view lock — fleet_view handlers read the copy, racing
+        nothing."""
+        with self.jobs_lock:
+            states: dict = {}
+            for j in self.jobs.values():
+                states[j.state] = states.get(j.state, 0) + 1
+        view = {"replicas": [h.view() for h in self.replicas.all()],
+                "router": self.router.stats(),
+                "jobs": states, "draining": self.draining}
+        with self._view_lock:
+            self._view_cache = view
+
+    def fleet_snapshot(self) -> dict:
+        with self._view_lock:
+            return self._view_cache
+
+    def _slo_tick(self) -> None:
+        """--slo-p99 rolling-window monitor: p99 over the last
+        `--slo-window` settled jobs' e2e latencies, once per tick. A
+        burn start/clear flips the `fleet.slo_burn` gauge (the /readyz
+        `slo_burn` reason) and emits a faultEntry on the gateway log —
+        the moment the fleet stops meeting its latency objective is an
+        EVENT, not just a dashboard drift."""
+        if self.cfg.slo_p99 <= 0 or not self._slo_lat:
+            return
+        lats = sorted(self._slo_lat)
+        p99 = lats[min(len(lats) - 1, int(0.99 * len(lats)))]
+        self.registry.gauge("fleet.slo_p99_s").set(p99)
+        burning = p99 > self.cfg.slo_p99
+        if burning == self._slo_burning:
+            return
+        self._slo_burning = burning
+        self.registry.gauge("fleet.slo_burn").set(
+            1.0 if burning else 0.0)
+        if burning:
+            self.registry.counter("fleet.slo_burns").inc()
+        self._rec(jsonl.fault_entry, self.writer, "slo_burn",
+                  "burn" if burning else "clear",
+                  f"rolling p99 {p99:.3f}s vs SLO "
+                  f"{self.cfg.slo_p99:.3f}s", 0, 0, 0,
+                  self.tracer.now(), window=len(lats),
+                  p99_s=round(p99, 6))
+
+    def _tick_done(self, t0: float) -> None:
+        """End-of-tick bookkeeping: loop timing, the watchdog's tick
+        stamp, the SLO check, the /v1/fleet snapshot refresh, and the
+        periodic metricsEntry."""
+        now = self.now()
+        self.registry.histogram("fleet.tick_seconds").observe(
+            now - t0)
+        self._last_tick = now
+        self._ticks += 1
+        self._slo_tick()
+        self._refresh_view()
+        if (self.writer is not None and self.cfg.metrics_every > 0
+                and self._ticks % self.cfg.metrics_every == 0):
+            self._rec(jsonl.metrics_entry, self.writer,
+                      self.registry.snapshot(), ts=self.tracer.now())
 
     # -- the dispatcher thread: ALL outbound I/O lives here -------------
 
@@ -494,6 +726,7 @@ class Gateway:
                     cmd = self.inbox.get(timeout=self.cfg.poll_every)
                 except queue_mod.Empty:
                     cmd = None
+                t0 = self.now()   # tick timing excludes the idle wait
                 while cmd is not None:
                     self._handle(cmd)
                     try:
@@ -508,6 +741,7 @@ class Gateway:
                 for job_id in retries:
                     self._handle(("submit", job_id))
                 self._drain_tick()
+                self._tick_done(t0)
         except SystemExit:
             # injected `route`/`gateway` die: ends THIS thread only —
             # /healthz's dispatcher probe goes false, replicas run on
@@ -527,6 +761,13 @@ class Gateway:
                     return
                 if job.place_attempts == 0:   # not a requeue retry
                     self.registry.counter("fleet.jobs_accepted").inc()
+                if not job.flow:
+                    # the job's CROSS-PROCESS flow id, minted once on
+                    # the dispatcher (handlers only enqueue): every
+                    # gateway span of this job and — via the
+                    # X-TT-Flow header — every replica-side span
+                    # shares it
+                    job.flow = self.tracer.new_flow()
                 if job.place_started is None:
                     job.place_started = self.now()
                 self._place(job)
@@ -544,7 +785,10 @@ class Gateway:
         try:
             job.bucket = bucket_key_from_counts(*job.counts,
                                                 spec=self.spec)
-            handle = self.router.route(job.bucket, exclude=exclude)
+            with self.tracer.span("route", cat="fleet", job=job.id,
+                                  flow=job.flow):
+                handle = self.router.route(job.bucket,
+                                           exclude=exclude)
         except NoReplicaError as e:
             self._fail(job, str(e))
             return
@@ -552,6 +796,16 @@ class Gateway:
             self._fail(job, f"routing fault: {e}")
             return
         job.place_attempts += 1
+        # one routeEntry per placement decision: the affinity outcome
+        # and the exact score inputs the router read (last_decision is
+        # same-thread fresh — no other thread routes)
+        decision = self.router.last_decision
+        self._rec(jsonl.route_entry, self.writer, job.id, job.bucket,
+                  handle.name, decision.get("outcome", "?"),
+                  backlog=decision.get("backlog"),
+                  pins=decision.get("pins"),
+                  compile_hit_rate=decision.get("compile_hit_rate"),
+                  attempt=job.place_attempts, flow=job.flow)
 
         def send():
             # DATA-plane timeout: the payload can be a multi-MB
@@ -559,16 +813,21 @@ class Gateway:
             # Any attempt after the first is an idempotent RESEND
             # (the earlier one may have landed and lost its reply) —
             # only then is a replica's 409 'already have it' success.
+            if job.sent_any:
+                self.registry.counter("fleet.submit_retries").inc()
             idem = job.sent_any
             job.sent_any = True
             return handle.post_job(job.payload,
                                    timeout=self.cfg.io_timeout,
-                                   idempotent=idem)
+                                   idempotent=idem, flow=job.flow)
 
         try:
-            retry_transient(send, attempts=self.cfg.route_retries,
-                            wait_s=self.cfg.retry_wait_s, backoff=2.0,
-                            max_wait_s=2.0)
+            with self.tracer.span("submit", cat="fleet", job=job.id,
+                                  flow=job.flow, replica=handle.name):
+                retry_transient(send,
+                                attempts=self.cfg.route_retries,
+                                wait_s=self.cfg.retry_wait_s,
+                                backoff=2.0, max_wait_s=2.0)
         except Exception as e:
             from timetabling_ga_tpu.runtime.retry import is_transient
             started = (job.place_started if job.place_started
@@ -596,6 +855,21 @@ class Gateway:
         job.replica = handle.name
         job.state = "routed"
         self.registry.counter("fleet.jobs_routed").inc()
+        # the `routed` span: admit-at-gateway → accepted-by-replica
+        # for the FIRST placement, failover-instant → re-accepted for
+        # every later one (place_started, reset by _reassign) — so a
+        # failed-over job's routed spans never overlap and
+        # tally("routed") in the tt stats breakdown stays a true
+        # placement-time total. Measured on the gateway's own clock
+        # (submitted_t/place_started are the tracer's clock domain).
+        start = (job.place_started if job.routed_any
+                 and job.place_started is not None
+                 else job.submitted_t)
+        job.routed_any = True
+        self._rec(self.tracer.record, "routed", start,
+                  max(0.0, self.now() - start), cat="fleet",
+                  job=job.id, flow=job.flow, replica=handle.name,
+                  attempt=job.place_attempts)
 
     def _cancel(self, job_id: str) -> None:
         with self.jobs_lock:
@@ -636,6 +910,22 @@ class Gateway:
         by_replica: dict = {}
         for job in jobs:
             by_replica.setdefault(job.replica, []).append(job)
+        if not by_replica:
+            return
+        # the poll span uses the record() form and is emitted ONLY
+        # when the round observed a state change or settlement — a
+        # steady-state gateway polling an idle fleet must not fill its
+        # log with empty poll brackets at 5 Hz
+        t0 = self.now()
+        changed = self._poll_replicas(by_replica)
+        if changed:
+            self._rec(self.tracer.record, "poll", t0,
+                      self.now() - t0, cat="fleet",
+                      replicas=len(by_replica), jobs=len(jobs),
+                      updates=changed)
+
+    def _poll_replicas(self, by_replica: dict) -> int:
+        changed = 0
         for name, group in by_replica.items():
             handle = self.replicas.get(name)
             if handle is None or handle.dead:
@@ -654,11 +944,13 @@ class Gateway:
                     # prober sees a healthy process and will never
                     # declare it dead
                     self._reassign(job)
+                    changed += 1
                     continue
                 state = info.get("state")
                 if not state or state not in TERMINAL:
-                    if state:
+                    if state and state != job.state:
                         job.state = state
+                        changed += 1
                     continue
                 # the replica reports terminal — but the gateway view
                 # must not SAY so until the record tail is cached, or
@@ -682,6 +974,8 @@ class Gateway:
                     job.state = state
                     job.records_truncated = truncated or not complete
                     self._settle(job)
+                    changed += 1
+        return changed
 
     def _on_death(self, handle, respawned: bool) -> None:
         """ReplicaSet prober callback (PROBER thread): only enqueue —
@@ -708,8 +1002,11 @@ class Gateway:
             victims = [j for j in self.jobs.values()
                        if j.replica == name
                        and not (j.terminal() and j.records_final)]
-        for job in victims:
-            self._reassign(job)
+        with self.tracer.span("failover", cat="fleet", replica=name,
+                              jobs=len(victims),
+                              flow=[j.flow for j in victims if j.flow]):
+            for job in victims:
+                self._reassign(job)
 
     def _reassign(self, job: GatewayJob) -> None:
         """One job's failover: discard the lost copy's partial
@@ -747,9 +1044,16 @@ class Gateway:
             name = ("fleet.jobs_done" if job.state == "done"
                     else "fleet.jobs_failed")
             self.registry.counter(name).inc()
+            latency = job.finished_t - job.submitted_t
             self.registry.histogram("fleet.job_seconds").observe(
-                job.finished_t - job.submitted_t,
-                exemplar={"job": job.id})
+                latency, exemplar={"job": job.id})
+            self._slo_lat.append(latency)
+            # the settle point on the job's chain: the instant state
+            # and records publish together (zero-duration marker span)
+            self._rec(self.tracer.record, "settle", self.now(), 0.0,
+                      cat="fleet", job=job.id, flow=job.flow,
+                      state=job.state, replica=job.replica,
+                      latency_s=round(latency, 6))
         self._terminal_order.append(job.id)
         while len(self._terminal_order) > self.cfg.retain_terminal:
             evicted = self._terminal_order.pop(0)

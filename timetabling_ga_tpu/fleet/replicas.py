@@ -53,7 +53,8 @@ import urllib.request
 
 from timetabling_ga_tpu.fleet.gateway import TERMINAL, ApiHandler
 from timetabling_ga_tpu.obs import http as obs_http
-from timetabling_ga_tpu.runtime import jsonl
+from timetabling_ga_tpu.obs import scrape as obs_scrape
+from timetabling_ga_tpu.runtime import faults, jsonl
 from timetabling_ga_tpu.runtime.config import FleetConfig, ServeConfig
 
 # per-job record-tail bound on a replica: GET /v1/jobs/<id> serves at
@@ -82,17 +83,18 @@ class FleetHTTPError(RuntimeError):
 
 
 def http_json(method: str, url: str, obj=None, timeout: float = 5.0,
-              ok: tuple = (200, 202)):
+              ok: tuple = (200, 202), headers=None):
     """One JSON-in/JSON-out HTTP call (stdlib urllib). 4xx/5xx bodies
     are parsed too; statuses outside `ok` raise FleetHTTPError with
-    the parsed detail attached."""
+    the parsed detail attached. `headers` adds request headers (the
+    gateway ships a job's cross-process flow id as `X-TT-Flow`)."""
     data = None
-    headers = {}
+    hdrs = dict(headers or {})
     if obj is not None:
         data = json.dumps(obj).encode()
-        headers["Content-Type"] = "application/json"
+        hdrs["Content-Type"] = "application/json"
     req = urllib.request.Request(url, data=data, method=method,
-                                 headers=headers)
+                                 headers=hdrs)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             status = resp.status
@@ -266,7 +268,7 @@ class ReplicaApi:
     def __init__(self, replica: "Replica"):
         self._r = replica
 
-    def accept_solve(self, payload: dict):
+    def accept_solve(self, payload: dict, flow: int = 0):
         r = self._r
         if r.draining:
             return 503, {"error": "draining", "reasons": ["draining"]}
@@ -278,7 +280,10 @@ class ReplicaApi:
             if job_id in r.index or job_id in r.svc.queue:
                 return 409, {"error": "duplicate job id", "id": job_id}
             r.index[job_id] = {"state": "accepted"}
-        r.inbox.put(("submit", job_id, dict(payload, id=job_id)))
+        # `flow` is the gateway's X-TT-Flow header (0 = none): the
+        # drive loop threads it into Job.flow so every replica-side
+        # span of this job CONTINUES the gateway's causal chain
+        r.inbox.put(("submit", job_id, dict(payload, id=job_id), flow))
         return 202, {"id": job_id, "state": "accepted"}
 
     def job_view(self, job_id: str, with_records: bool = True):
@@ -506,6 +511,7 @@ class Replica:
         kind = cmd[0]
         if kind == "submit":
             job_id, payload = cmd[1], cmd[2]
+            flow = cmd[3] if len(cmd) > 3 else 0
             try:
                 problem = payload_problem(payload)
                 self.svc.submit(
@@ -513,7 +519,8 @@ class Replica:
                     priority=int(payload.get("priority", 0)),
                     seed=payload.get("seed"),
                     generations=payload.get("generations"),
-                    deadline_s=payload.get("deadline"))
+                    deadline_s=payload.get("deadline"),
+                    flow=flow)
                 with self.index_lock:
                     self.index.pop(job_id, None)
             except Exception as e:
@@ -613,6 +620,10 @@ class ReplicaHandle:
         self.backlog = None
         self.compile_count = 0.0
         self.compile_cache_hits = 0.0
+        self.probe_seconds = None    # last successful probe's round
+        #                              trip (/readyz + /metrics) — the
+        #                              gateway's fleet.replica.* probe
+        #                              latency gauge
 
     # -- probe ----------------------------------------------------------
 
@@ -621,6 +632,7 @@ class ReplicaHandle:
         replica is unreachable (a 503 /readyz is a HEALTHY not-ready
         answer). The metrics families parsed are exactly the router's
         inputs: the backlog gauge and the compile hit-rate counters."""
+        t0 = time.monotonic()
         try:
             detail = http_json("GET", self.url + "/readyz",
                                timeout=timeout, ok=(200, 503))
@@ -633,26 +645,30 @@ class ReplicaHandle:
             self._scrape_metrics(timeout)
         except Exception:
             pass                     # gauges go stale, probe still ok
+        self.probe_seconds = time.monotonic() - t0
         return True
 
     def _scrape_metrics(self, timeout: float) -> None:
-        text = http_text(self.url + "/metrics", timeout=timeout)
-        for line in text.splitlines():
-            if line.startswith("#") or " " not in line:
-                continue
-            name, _, value = line.partition(" ")
-            try:
-                v = float(value.split()[0])
-            except ValueError:
-                continue
-            if name == "tt_serve_queue_depth":
-                self.queue_depth = v
-            elif name == "tt_serve_backlog":
-                self.backlog = v
-            elif name == "tt_compile_count_total":
-                self.compile_count = v
-            elif name == "tt_compile_cache_hits_total":
-                self.compile_cache_hits = v
+        # fault-injection point (runtime/faults.py `gw_scrape` site):
+        # fires on the ReplicaSet PROBER thread — a `hang` parks only
+        # the prober (routing continues on the last-probed gauges), a
+        # `die` is absorbed as one failed scrape so the prober lives on
+        # (tests/test_fleet_obs.py pins the isolation)
+        try:
+            faults.maybe_fail("gw_scrape")
+        except SystemExit:
+            return                   # gauges stale, prober survives
+        families = obs_scrape.parse_exposition(
+            http_text(self.url + "/metrics", timeout=timeout))
+        self.queue_depth = obs_scrape.scalar(
+            families, obs_scrape.QUEUE_DEPTH, self.queue_depth)
+        self.backlog = obs_scrape.scalar(
+            families, obs_scrape.BACKLOG, self.backlog)
+        self.compile_count = obs_scrape.scalar(
+            families, obs_scrape.COMPILE_COUNT, self.compile_count)
+        self.compile_cache_hits = obs_scrape.scalar(
+            families, obs_scrape.COMPILE_HITS,
+            self.compile_cache_hits)
 
     def compile_hit_rate(self) -> float:
         total = self.compile_count + self.compile_cache_hits
@@ -661,7 +677,7 @@ class ReplicaHandle:
     # -- verbs ----------------------------------------------------------
 
     def post_job(self, payload: dict, timeout: float = 5.0,
-                 idempotent: bool = False):
+                 idempotent: bool = False, flow: int = 0):
         # 409 (duplicate id) is SUCCESS only for a RESEND (failover
         # resubmission, or a retry whose first attempt landed but
         # lost its response): the job is already there, the placement
@@ -671,8 +687,14 @@ class ReplicaHandle:
         # adopting the old job would hand the client someone else's
         # result.
         ok = (200, 202, 409) if idempotent else (200, 202)
+        # the job's cross-process flow id (obs/spans.py XFLOW_BASE
+        # range, minted by the gateway's tracer) rides a header, not
+        # the payload: the payload is the replayable solve REQUEST and
+        # must stay byte-stable across failover resends, while the
+        # flow is pure telemetry
+        headers = {"X-TT-Flow": str(int(flow))} if flow else None
         return http_json("POST", self.url + "/v1/solve", payload,
-                         timeout=timeout, ok=ok)
+                         timeout=timeout, ok=ok, headers=headers)
 
     def list_jobs(self, timeout: float = 5.0):
         """{id: {"state", ...}} for every job the replica knows —

@@ -63,13 +63,30 @@ class NoReplicaError(RuntimeError):
 
 
 class Router:
-    """Bucket -> replica placement with affinity, scoring, failover."""
+    """Bucket -> replica placement with affinity, scoring, failover.
 
-    def __init__(self, replica_set):
+    `registry` (optional, the gateway's MetricsRegistry) receives the
+    routing counters as real families — `fleet.route.{hit,warm,miss}`
+    and `fleet.route.repins` — so the affinity story `/v1/fleet` tells
+    in JSON is also on `/metrics` (the JSON view is derivable from the
+    metric families; fleet/gateway.py docstring documents the
+    mapping). `last_decision` is the most recent placement's score
+    inputs (outcome, backlog, pin count, measured compile-hit rate):
+    the gateway reads it right after `route()` — same thread, no race
+    — to emit the `routeEntry` record."""
+
+    def __init__(self, replica_set, registry=None):
         self._set = replica_set
+        self._metrics = registry
         self._pins: dict = {}        # bucket -> replica name
         self._warm: dict = {}        # replica name -> set of buckets
         self._seen: set = set()      # buckets routed at least once
+        self.pin_counts: dict = {}   # replica name -> pinned buckets
+        #                              (maintained at every pin move so
+        #                              the per-replica `pins` gauge is
+        #                              an atomic dict read, never an
+        #                              iteration racing this thread)
+        self.last_decision: dict = {}
         self.routed = 0
         self.hits = 0                # landed on an already-warm home
         self.warmups = 0             # a bucket's fleet-wide first land
@@ -113,28 +130,51 @@ class Router:
             fallback = min(pool, key=self._score)
             if not any(h.name == pinned
                        for h in self._set.live()):
-                self._pins[bucket] = fallback.name
+                self._set_pin(bucket, fallback.name)
                 self.repins += 1
+                if self._metrics is not None:
+                    self._metrics.counter("fleet.route.repins").inc()
             return self._account(bucket, fallback)
         handle = min(pool, key=self._score)
-        self._pins[bucket] = handle.name
+        self._set_pin(bucket, handle.name)
         return self._account(bucket, handle)
+
+    def _set_pin(self, bucket: tuple, name: str) -> None:
+        old = self._pins.get(bucket)
+        if old == name:
+            return
+        if old is not None:
+            self.pin_counts[old] = max(0, self.pin_counts.get(old, 1)
+                                       - 1)
+        self._pins[bucket] = name
+        self.pin_counts[name] = self.pin_counts.get(name, 0) + 1
 
     def _account(self, bucket: tuple, handle):
         """Affinity bookkeeping for one placement (module docstring:
-        hit / warm-up / miss)."""
+        hit / warm-up / miss) + the routing counters and the
+        `last_decision` score-input snapshot the gateway's routeEntry
+        record reads."""
         warm = bucket in self._warm.setdefault(handle.name, set())
         self.routed += 1
         if warm:
+            outcome = "hit"
             self.hits += 1
         elif bucket in self._seen:
-            self.misses += 1       # known bucket forced onto a cold
-            #                        replica — the affinity failure mode
+            outcome = "miss"       # known bucket forced onto a cold
+            self.misses += 1       # replica — the affinity failure mode
             self._warm[handle.name].add(bucket)
         else:
-            self.warmups += 1      # unavoidable once-per-bucket compile
+            outcome = "warm"       # unavoidable once-per-bucket compile
+            self.warmups += 1
             self._warm[handle.name].add(bucket)
         self._seen.add(bucket)
+        if self._metrics is not None:
+            self._metrics.counter(f"fleet.route.{outcome}").inc()
+        self.last_decision = {
+            "outcome": outcome, "replica": handle.name,
+            "backlog": handle.queue_depth,
+            "pins": self.pin_counts.get(handle.name, 0),
+            "compile_hit_rate": round(handle.compile_hit_rate(), 4)}
         return handle
 
     def _score(self, handle) -> tuple:
@@ -162,6 +202,7 @@ class Router:
         self._warm.pop(name, None)
         for bucket in [b for b, r in self._pins.items() if r == name]:
             del self._pins[bucket]
+        self.pin_counts[name] = 0
 
     # -- accounting -----------------------------------------------------
 

@@ -104,7 +104,14 @@ def readiness(registry) -> tuple[bool, dict]:
       - `serve.draining` >= 1 (a fleet drain is in flight — the
         replica finishes its parked jobs but admits nothing new, so
         the router must stop sending work; fleet/replicas.py sets the
-        gauge from the drive loop when a `/v1/drain` lands).
+        gauge from the drive loop when a `/v1/drain` lands);
+      - gateway-only (fleet/gateway.py, tt-obs v5): `no_ready_replica`
+        (zero ready replicas behind the front), `dispatcher_stalled`
+        (the dispatcher's tick age exceeded `--stall-after` — it
+        accepts jobs it will never place) and `slo_burn` (the
+        `--slo-p99` rolling-window latency monitor is over its bound)
+        — the gateway answers the SAME pinned contract as replicas,
+        so HA stacks and meta-gateways route around it identically.
 
     Absent gauges (an engine run has no serve queue; a serve process
     may never have set the ladder; no memory poller on CPU) are simply
@@ -145,6 +152,24 @@ def readiness(registry) -> tuple[bool, dict]:
     fleet_ready = gauges.get("fleet.replicas_ready")
     if fleet_ready is not None and fleet_ready < 1:
         reasons.append("no_ready_replica")
+    # gateway dispatcher watchdog (fleet/gateway.py, tt-obs v5):
+    # `fleet.tick_age_s` is a pull gauge over the dispatcher's last
+    # loop tick, `fleet.tick_stall_after` the configured threshold
+    # (--stall-after; 0/absent disables). A dead or wedged dispatcher
+    # still ACCEPTS jobs it will never place — an HA stack must see
+    # that on the same /readyz contract replicas answer.
+    tick_age = gauges.get("fleet.tick_age_s")
+    stall_after = gauges.get("fleet.tick_stall_after")
+    if (tick_age is not None and stall_after is not None
+            and stall_after > 0 and tick_age >= stall_after):
+        reasons.append("dispatcher_stalled")
+    # gateway SLO monitor (--slo-p99): the rolling-window p99 over
+    # e2e job latencies is over its bound — stop sending latency-
+    # sensitive traffic here until the burn clears (the gauge flips
+    # back when the window's p99 recovers, so the reason is live)
+    slo_burn = gauges.get("fleet.slo_burn")
+    if slo_burn is not None and slo_burn >= 1:
+        reasons.append("slo_burn")
     return not reasons, {"ready": not reasons, "reasons": reasons,
                          "queue_depth": depth, "backlog": bound,
                          "degrade_level": level,

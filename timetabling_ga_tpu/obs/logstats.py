@@ -13,6 +13,9 @@ job-tagged spanEntry records additionally yield a per-job WALL-TIME
 BREAKDOWN — where each job's latency went:
 
   queued      admission to its first pack (waiting for a lane)
+  routed      the fleet gateway's placement leg (admit-at-gateway →
+              accepted-by-replica: the `routed` span a gateway log
+              carries per placed job — fleet/gateway.py, tt-obs v5)
   packed      pack / resume / park spans it rode (the per-quantum
               host-side cost of the park/resume serving model)
   executing   its quantum spans (device time advancing the job)
@@ -20,8 +23,19 @@ BREAKDOWN — where each job's latency went:
               a host snapshot while co-tenants ran
 
 with p50/p99 across jobs per component — the numbers that say whether
-a slow service needs more lanes (queued), bigger quanta (packed), or
-faster kernels (executing).
+a slow service needs more lanes (queued), a faster gateway (routed),
+bigger quanta (packed), or faster kernels (executing). Several inputs
+concatenate (`tt stats gateway.jsonl replica*.jsonl` summarizes a
+fleet's whole log set); each log's timestamps live in its OWN tracer
+epoch, so the breakdown windows a job over its replica-side spans
+only and adds the gateway leg as the clock-safe `routed` duration sum
+(see `_job_breakdown`) — timestamps from different logs are never
+differenced.
+
+Gateway logs additionally yield a per-replica PLACEMENT summary from
+the routeEntry records (tt-obs v5): placements per replica with the
+router's hit/warm/miss affinity outcomes — `tt stats` answers "where
+did my bucket land and was it warm" without a Perfetto round trip.
 
 Stdlib-only and device-free, like the trace exporter.
 """
@@ -39,9 +53,11 @@ def _key(proc_id, job):
     return f"job {job}" if job is not None else f"island {proc_id}"
 
 
-# span taxonomy feeding the per-job breakdown (scheduler.py span names)
+# span taxonomy feeding the per-job breakdown (scheduler.py span names
+# + the gateway's placement leg, fleet/gateway.py)
 _EXEC_SPANS = ("quantum",)
 _PACKED_SPANS = ("pack", "resume", "park")   # init nests inside pack
+_ROUTED_SPANS = ("routed",)                  # gateway admit→placed
 
 
 def _pctl(vals, q):
@@ -58,7 +74,20 @@ def _job_breakdown(spans) -> dict:
     spend that wall time inside the span — concurrency, not
     attribution error. `parked` is the remainder between admission and
     the job's last span: time spent as a host snapshot while
-    co-tenants held the lanes."""
+    co-tenants held the lanes.
+
+    Clock discipline for fleet log sets: each log's `ts` is seconds
+    since ITS tracer epoch, so gateway and replica timestamps must
+    never be differenced. The time WINDOW (t0/end → total, queued,
+    parked) is therefore computed from the replica-side spans alone
+    (everything not `cat="fleet"`); the gateway leg enters as the
+    `routed` component — a span-duration SUM, clock-safe by
+    construction — added on top of the window, so `total ≈ e2e` and
+    the printed identity `total = queued + routed + packed +
+    executing + parked` holds (modulo the unprinted finalize sliver).
+    A gateway-only log (no replica spans for the job) falls back to
+    its own window, where the routed span IS inside and is subtracted
+    from the remainder instead."""
     per: dict = {}
     for s in spans:
         j = s.get("job")
@@ -68,26 +97,52 @@ def _job_breakdown(spans) -> dict:
             per.setdefault(jid, []).append(s)
     out: dict = {}
     for jid, ss in sorted(per.items()):
-        ss = sorted(ss, key=lambda s: float(s.get("ts", 0.0)))
-        t0 = float(ss[0].get("ts", 0.0))
+        base = [s for s in ss if s.get("cat") != "fleet"] or ss
+        in_window = base is ss       # gateway-only: routed inside
+        # one SOURCE log for the window: a failed-over job has replica
+        # spans in TWO logs with unrelated epochs (`_src` is stamped
+        # by main_stats per input file). The authoritative leg is the
+        # one that finalized — the dead replica's partial leg is the
+        # copy the gateway's failover discarded; fall back to the
+        # largest leg when no finalize survived. The replica-side
+        # tallies (executing/packed/finalize) come from the same leg,
+        # so the components describe the run the job's record stream
+        # actually is; only `routed` sums across sources (the gateway
+        # leg lives in its own log by construction).
+        by_src: dict = {}
+        for s in base:
+            by_src.setdefault(s.get("_src", 0), []).append(s)
+        if len(by_src) > 1:
+            base = next(
+                (grp for grp in by_src.values()
+                 if any(s.get("name") == "finalize" for s in grp)),
+                max(by_src.values(), key=len))
+        t0 = min(float(s.get("ts", 0.0)) for s in base)
         end = max(float(s.get("ts", 0.0))
-                  + max(0.0, float(s.get("dur", 0.0))) for s in ss)
-        total = max(0.0, end - t0)
+                  + max(0.0, float(s.get("dur", 0.0))) for s in base)
+        base_total = max(0.0, end - t0)
 
-        def tally(names, ss=ss):
+        def tally(names, ss=base):
             return sum(max(0.0, float(s.get("dur", 0.0))) for s in ss
                        if s.get("name") in names)
 
         executing = tally(_EXEC_SPANS)
         packed = tally(_PACKED_SPANS)
-        first_work = next(
-            (float(s.get("ts", 0.0)) for s in ss
-             if s.get("name") in _EXEC_SPANS + _PACKED_SPANS), end)
+        routed = tally(_ROUTED_SPANS, ss)   # the gateway leg: every
+        #                                     placement round, summed
+        work = _EXEC_SPANS + _PACKED_SPANS \
+            + (_ROUTED_SPANS if in_window else ())
+        first_work = min(
+            (float(s.get("ts", 0.0)) for s in base
+             if s.get("name") in work), default=end)
         queued = max(0.0, first_work - t0)
         fin = tally(("finalize",))
-        parked = max(0.0, total - queued - packed - executing - fin)
-        out[jid] = {"total": total, "queued": queued, "packed": packed,
-                    "executing": executing, "parked": parked}
+        rest = max(0.0, base_total - queued - packed - executing
+                   - fin - (routed if in_window else 0.0))
+        total = base_total if in_window else base_total + routed
+        out[jid] = {"total": total, "queued": queued,
+                    "routed": routed, "packed": packed,
+                    "executing": executing, "parked": rest}
     return out
 
 
@@ -99,6 +154,7 @@ def summarize(records) -> str:
     faults: list = []
     jobs: dict = {}         # job id -> lifecycle events
     spans: list = []        # spanEntry bodies (per-job breakdown)
+    routes: list = []       # routeEntry bodies (placement summary)
     compiles: list = []     # costEntry bodies (compile accounting)
     quality_recs: list = []  # whole records (obs/quality.py summarize)
     counts: dict = {}
@@ -122,6 +178,8 @@ def summarize(records) -> str:
         elif kind == "spanEntry":
             if body.get("job") is not None:
                 spans.append(body)
+        elif kind == "routeEntry":
+            routes.append(body)
         elif kind == "costEntry":
             compiles.append(body)
         elif kind == "qualityEntry":
@@ -202,20 +260,51 @@ def summarize(records) -> str:
 
     breakdown = _job_breakdown(spans)
     if breakdown:
+        # the `routed` column only appears when some job actually has
+        # a gateway placement span — plain serve logs keep the old shape
+        with_routed = any(b["routed"] > 0 for b in breakdown.values())
         lines.append(f"== job latency breakdown ({len(breakdown)} "
                      f"jobs, from spans)")
         for jid, b in breakdown.items():
+            routed_s = (f"routed {b['routed']:.2f} + "
+                        if with_routed else "")
             lines.append(
                 f"  {jid}: total {b['total']:.2f}s = "
-                f"queued {b['queued']:.2f} + packed {b['packed']:.2f} "
+                f"queued {b['queued']:.2f} + {routed_s}"
+                f"packed {b['packed']:.2f} "
                 f"+ executing {b['executing']:.2f} "
                 f"+ parked {b['parked']:.2f}")
-        for comp in ("total", "queued", "packed", "executing",
-                     "parked"):
+        comps = ("total", "queued") \
+            + (("routed",) if with_routed else ()) \
+            + ("packed", "executing", "parked")
+        for comp in comps:
             vals = sorted(b[comp] for b in breakdown.values())
             lines.append(f"  {comp}: p50 {_pctl(vals, 0.5):.2f}s "
                          f"p99 {_pctl(vals, 0.99):.2f}s "
                          f"max {vals[-1]:.2f}s")
+
+    if routes:
+        # gateway placement summary (routeEntry, tt-obs v5): per
+        # replica, how many placements landed there and how warm —
+        # the affinity story per replica, straight off the log
+        lines.append(f"== placements ({len(routes)} routeEntry "
+                     f"records)")
+        by_rep: dict = {}
+        for r in routes:
+            by_rep.setdefault(r.get("replica", "?"), []).append(r)
+        for rep, rs in sorted(by_rep.items()):
+            outcomes: dict = {}
+            buckets = set()
+            for r in rs:
+                o = r.get("outcome", "?")
+                outcomes[o] = outcomes.get(o, 0) + 1
+                if r.get("bucket") is not None:
+                    buckets.add(tuple(r["bucket"]))
+            ostr = " ".join(f"{k}:{v}" for k, v in
+                            sorted(outcomes.items()))
+            lines.append(f"  {rep}: {len(rs)} placements "
+                         f"({ostr}) over {len(buckets)} "
+                         f"bucket{'s' if len(buckets) != 1 else ''}")
 
     if compiles:
         # cost observatory (obs/cost.py): per-program compile count,
@@ -264,24 +353,39 @@ def summarize(records) -> str:
 
 
 def main_stats(argv) -> int:
-    """`tt stats <log.jsonl>` entry point."""
-    inp = None
+    """`tt stats <log.jsonl> [more.jsonl ...]` entry point."""
+    inputs: list = []
     for a in argv:
         if a in ("-h", "--help"):
-            print("usage: tt stats <log.jsonl>\n\n"
+            print("usage: tt stats <log.jsonl> [more.jsonl ...]\n\n"
                   "summarize a JSONL record stream: best-so-far curves, "
                   "time-to-feasible, recoveries and fault sites, per-job "
-                  "latency (serve+obs logs: queued/packed/executing/"
-                  "parked breakdown, p50/p99 across jobs), last metrics "
-                  "snapshot")
+                  "latency (serve+obs logs: queued/routed/packed/"
+                  "executing/parked breakdown, p50/p99 across jobs), "
+                  "gateway placement summary (routeEntry), last metrics "
+                  "snapshot. Several inputs concatenate — `tt stats "
+                  "gateway.jsonl replica*.jsonl` reads a fleet's whole "
+                  "log set")
             return 0
-        if inp is None:
-            inp = a
-        else:
+        if a.startswith("-"):
             raise SystemExit(f"unknown argument: {a}")
-    if inp is None:
-        raise SystemExit("usage: tt stats <log.jsonl>")
-    print(summarize(read_jsonl(inp)))
+        inputs.append(a)
+    if not inputs:
+        raise SystemExit("usage: tt stats <log.jsonl> [more.jsonl ...]")
+    records: list = []
+    for idx, path in enumerate(inputs):
+        batch = read_jsonl(path)
+        if len(inputs) > 1:
+            # stamp span provenance: each log's timestamps live in
+            # its own tracer epoch, and _job_breakdown must window a
+            # job inside ONE log (a failed-over job has spans in two
+            # replica logs whose epochs are unrelated)
+            for rec in batch:
+                body = rec.get("spanEntry")
+                if isinstance(body, dict):
+                    body["_src"] = idx
+        records.extend(batch)
+    print(summarize(records))
     return 0
 
 

@@ -1,0 +1,138 @@
+"""Shared OpenMetrics/Prometheus text-exposition parser.
+
+Before this module every consumer of a `/metrics` scrape grew its own
+regex: the fleet prober split lines by the first space
+(fleet/replicas.py `_scrape_metrics`), and each new dashboard tool was
+about to add a third copy. One parser, unit-tested once, consumed by:
+
+  - the ReplicaSet prober (fleet/replicas.py): refreshes each handle's
+    router inputs — the backlog gauge and the compile-hit counters —
+    from one parse per probe;
+  - the router (fleet/router.py): scores on exactly the families named
+    here (`QUEUE_DEPTH`, `COMPILE_COUNT`, `COMPILE_HITS`), read back
+    off the handle fields the prober filled;
+  - `tools/bench_report.py --metrics FILE`: renders a saved exposition
+    snapshot (`curl gateway:PORT/metrics > snap.txt`) as a table — the
+    fleet dashboard with no Prometheus installed;
+  - the bench `extra.fleet` obs leg: counts the gateway's span/route
+    records against its own scraped families.
+
+Handles both expositions our registry emits (obs/metrics.py): the
+Prometheus 0.0.4 text format and OpenMetrics 1.0 with exemplars
+(`name{le="0.5"} 3 # {job="j42"} 0.93`) and the `# EOF` trailer.
+Unparseable lines are skipped, never fatal — a scrape must degrade,
+not raise (the prober treats a failed parse as stale gauges).
+
+Stdlib-only and device-free, like the rest of obs/.
+"""
+
+from __future__ import annotations
+
+import re
+
+# the metric families the fleet router scores on (fleet/router.py):
+# kept here, next to the parser, so the prober and any future scrape
+# consumer name them identically
+QUEUE_DEPTH = "tt_serve_queue_depth"
+BACKLOG = "tt_serve_backlog"
+COMPILE_COUNT = "tt_compile_count_total"
+COMPILE_HITS = "tt_compile_cache_hits_total"
+
+# one sample line: name, optional {labels}, value, optional exemplar
+# (OpenMetrics: " # {labels} value [timestamp]")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[^\s#]+)"
+    r"(?:\s+#\s+\{(?P<exlabels>[^}]*)\}\s+(?P<exvalue>\S+).*)?"
+    r"\s*$")
+
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    """Label-value unescaping (the inverse of obs/metrics.py
+    `_escape_label`): backslash, double quote, newline."""
+    return (v.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def parse_labels(block: str) -> dict:
+    """`le="0.5",job="j 42"` -> {"le": "0.5", "job": "j 42"}."""
+    return {m.group(1): _unescape(m.group(2))
+            for m in _LABEL_RE.finditer(block or "")}
+
+
+def parse_exposition(text: str) -> dict:
+    """Exposition text -> {sample_name: [(labels_dict, value), ...]}.
+
+    Sample names are the WIRE names (`tt_serve_queue_depth`,
+    `tt_compile_count_total`, `tt_fleet_job_seconds_bucket`) — one
+    entry per sample line, in document order. Comment lines (`# TYPE`,
+    `# HELP`, `# EOF`) and anything unparseable are skipped."""
+    out: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        out.setdefault(m.group("name"), []).append(
+            (parse_labels(m.group("labels")), value))
+    return out
+
+
+def parse_exemplars(text: str) -> list:
+    """OpenMetrics bucket exemplars: [(sample_name, labels_dict,
+    value), ...] in document order — the (job/dispatch, latency)
+    pairs a p99 spike joins back to. Same regex as parse_exposition,
+    so there is exactly one copy of the format knowledge."""
+    out = []
+    for line in text.splitlines():
+        m = _SAMPLE_RE.match(line.strip())
+        if m is None or m.group("exvalue") is None:
+            continue
+        try:
+            v = float(m.group("exvalue"))
+        except ValueError:
+            continue
+        out.append((m.group("name"),
+                    parse_labels(m.group("exlabels")), v))
+    return out
+
+
+def scalar(families: dict, name: str, default=None):
+    """First unlabeled (or only) sample of `name`, or `default` — the
+    gauge/counter read every router input is."""
+    samples = families.get(name)
+    if not samples:
+        return default
+    for labels, value in samples:
+        if not labels:
+            return value
+    return samples[0][1]
+
+
+def labeled(families: dict, name: str, **want):
+    """First sample of `name` whose labels include all of `want`
+    (e.g. `labeled(fams, "tt_fleet_job_seconds_bucket", le="+Inf")`),
+    or None."""
+    for labels, value in families.get(name, ()):
+        if all(labels.get(k) == v for k, v in want.items()):
+            return value
+    return None
+
+
+def hit_rate(families: dict) -> float:
+    """Measured compile-hit rate from the families the router scrapes
+    (obs/cost.py accounting): hits / (count + hits), 0.0 when the
+    process has never compiled."""
+    count = scalar(families, COMPILE_COUNT, 0.0) or 0.0
+    hits = scalar(families, COMPILE_HITS, 0.0) or 0.0
+    total = count + hits
+    return hits / total if total > 0 else 0.0

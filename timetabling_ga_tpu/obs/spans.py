@@ -35,6 +35,19 @@ a serve job's life — admit → pack → quantum → park → resume → finali
 — shares the job's flow id so `tt trace --job ID` shows one end-to-end
 timeline.
 
+Cross-PROCESS flows (tt-obs v5, the fleet observatory): a tracer built
+with `flow_base=XFLOW_BASE` allocates ids in a disjoint range reserved
+for chains that cross process boundaries. The fleet gateway is the one
+allocator in that range: it mints a flow per admitted job and ships it
+to the owning replica as an `X-TT-Flow` header on POST /v1/solve; the
+replica threads it into `Job.flow`, so every replica-side span of the
+job CONTINUES the gateway's chain. When `tt trace` stitches several
+logs (gateway + N replicas) into one timeline, ids at/above XFLOW_BASE
+are kept verbatim (they are globally unique by construction — only one
+process mints them) while each log's local ids are remapped into a
+per-log namespace, so two replicas' unrelated chunk chains can never
+merge by id collision (obs/trace_export.py export_stitched).
+
 Clock discipline: all timestamps are `time.monotonic()` offsets from
 the tracer's construction epoch — monotone, NTP-immune, and cheap.
 Spans are HOST-side only: a wall-clock read inside a jitted function
@@ -55,6 +68,13 @@ import contextlib
 import threading
 import time
 
+# flow ids at/above this value are CROSS-PROCESS chains (module
+# docstring): allocated only by the one process that owns the chain's
+# root (the fleet gateway), shipped over the wire, and kept verbatim
+# when `tt trace` stitches multiple logs. Local (per-process) flows
+# stay far below it.
+XFLOW_BASE = 1 << 32
+
 
 class SpanTracer:
     """Emits spanEntry records onto a (writer-wrapped) stream.
@@ -64,7 +84,7 @@ class SpanTracer:
     `enabled=False` (or out=None) makes every call a no-op."""
 
     def __init__(self, out=None, enabled: bool = True,
-                 clock=time.monotonic):
+                 clock=time.monotonic, flow_base: int = 0):
         self.enabled = bool(enabled) and out is not None
         self._out = out
         self._clock = clock
@@ -72,6 +92,10 @@ class SpanTracer:
         self._local = threading.local()
         self._tids: dict[int, int] = {}
         self._tid_lock = threading.Lock()
+        # flow ids are flow_base + n: 0 for ordinary per-process
+        # tracers, XFLOW_BASE for the one tracer whose chains cross
+        # process boundaries (the fleet gateway's)
+        self._flow_base = int(flow_base)
         self._next_flow = 0
 
     # -- flows ----------------------------------------------------------
@@ -88,7 +112,7 @@ class SpanTracer:
             return 0
         with self._tid_lock:
             self._next_flow += 1
-            return self._next_flow
+            return self._flow_base + self._next_flow
 
     # -- clocks ---------------------------------------------------------
 

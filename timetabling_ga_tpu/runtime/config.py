@@ -861,13 +861,53 @@ class FleetConfig:
     #                                  (runtime/retry.py schedule)
     retry_wait_s: float = 0.2        # base wait of that schedule
     backlog: int = 256               # gateway job-table admission bound
-    faults: Optional[str] = None     # fault plan (gateway/route sites)
+    faults: Optional[str] = None     # fault plan (gateway/route/
+    #                                  gw_writer/gw_scrape sites)
+    # ---- fleet observability (tt-obs v5, README "Fleet
+    # observability"): the gateway's own telemetry stream + readiness
+    output: Optional[str] = None     # -o LOG: the gateway's JSONL
+    #                                  telemetry stream (spanEntry
+    #                                  dispatcher-phase spans with
+    #                                  cross-process flow ids,
+    #                                  routeEntry per placement,
+    #                                  periodic metricsEntry, faultEntry
+    #                                  SLO events) through an
+    #                                  AsyncWriter — `tt trace
+    #                                  gateway.jsonl replica*.jsonl`
+    #                                  stitches it with replica logs.
+    #                                  None = no gateway records
+    metrics_every: int = 50          # dispatcher ticks between
+    #                                  metricsEntry snapshots on the
+    #                                  gateway log (0 = only the final
+    #                                  snapshot at close)
+    slo_p99: float = 0.0             # --slo-p99 SECONDS: rolling-window
+    #                                  p99 bound over e2e job latencies
+    #                                  (submit→settled at the gateway);
+    #                                  while the measured p99 exceeds
+    #                                  it, /readyz reports `slo_burn`
+    #                                  and a faultEntry records the
+    #                                  burn's start. 0 = no SLO monitor
+    slo_window: int = 100            # settled jobs in the rolling
+    #                                  window the p99 is measured over
+    stall_after: float = 60.0        # dispatcher watchdog: seconds
+    #                                  since the last dispatcher tick
+    #                                  before /readyz reports
+    #                                  `dispatcher_stalled` (a dead or
+    #                                  wedged dispatcher still accepts
+    #                                  jobs it will never place — HA
+    #                                  stacks must route around it).
+    #                                  0 disables the watchdog
     serve_args: list = dataclasses.field(default_factory=list)
     #                                  verbatim worker flags (after --)
 
 
 _FLEET_FLAG_MAP = {
     "--listen": ("listen", str),
+    "-o": ("output", str),
+    "--metrics-every": ("metrics_every", int),
+    "--slo-p99": ("slo_p99", float),
+    "--slo-window": ("slo_window", int),
+    "--stall-after": ("stall_after", float),
     "--spawn": ("spawn", int),
     "--backend": ("backend", str),
     "--probe-every": ("probe_every", float),
@@ -951,6 +991,17 @@ def parse_fleet_args(argv) -> FleetConfig:
         raise SystemExit("--retry-wait must be > 0 seconds")
     if cfg.backlog < 1:
         raise SystemExit("--backlog must be >= 1")
+    if cfg.metrics_every < 0:
+        raise SystemExit("--metrics-every must be >= 0 dispatcher "
+                         "ticks (0 = only the final snapshot)")
+    if cfg.slo_p99 < 0:
+        raise SystemExit("--slo-p99 must be >= 0 seconds (0 disables "
+                         "the SLO monitor)")
+    if cfg.slo_window < 1:
+        raise SystemExit("--slo-window must be >= 1 settled jobs")
+    if cfg.stall_after < 0:
+        raise SystemExit("--stall-after must be >= 0 seconds (0 "
+                         "disables the dispatcher watchdog)")
     # the worker flags must themselves parse (a typo would otherwise
     # only surface as N crashed spawns); the parsed copy also gives
     # the gateway its bucket spec, so router and workers agree

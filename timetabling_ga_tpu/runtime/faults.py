@@ -49,11 +49,17 @@ drain; tests/test_obs.py pins it), `mem_poll` (once per device-memory
 sample on the cost observatory's poller thread), `profile` (on the
 profiler-capture worker around each start/stop — same isolation
 contract as the listener sites; tests/test_cost.py pins it),
-`gateway` (the fleet gateway's HTTP accept loop at startup) and
+`gateway` (the fleet gateway's HTTP accept loop at startup),
 `route` (once per routing decision on the gateway dispatcher thread —
 both fleet sites share the listener sites' isolation contract:
 tests/test_fleet.py pins that a wedged gateway never stalls replica
-dispatch or writer drain).
+dispatch or writer drain), `gw_writer` (the gateway's OWN telemetry
+AsyncWriter worker, once per dequeued item — a dead gateway log
+writer must never stall the dispatcher or job settlement; the gateway
+disables its obs emission and routes on) and `gw_scrape` (once per
+replica /metrics scrape on the prober thread — a hung scrape parks
+only the prober; routing continues on the last-probed gauges and job
+settlement never waits on it; tests/test_fleet_obs.py pins both).
 
 The plan is installed per engine.run call (`install`), which resets the
 per-site counters — invocation indices are deterministic within one
@@ -96,8 +102,17 @@ ACTIONS = ("unavailable", "hang", "die", "truncate", "error")
 # dispatch/serve/writer path: a wedged gateway makes the FRONT
 # unreachable, but every replica keeps dispatching and draining its
 # writer untouched (tests/test_fleet.py pins it).
+# `gw_writer` fires on the gateway's telemetry AsyncWriter worker
+# (once per dequeued item — the `writer` site's gateway twin, separate
+# so a gateway-log fault cannot shift a replica writer plan's indices)
+# and `gw_scrape` once per replica /metrics scrape on the ReplicaSet
+# prober thread (fleet/replicas.py). Isolation contract: a dead
+# gateway writer disables obs emission and the dispatcher routes on; a
+# hung scrape parks only the prober — job settlement never waits on
+# either (tests/test_fleet_obs.py pins it).
 SITES = ("dispatch", "fetch", "writer", "ckpt", "init", "obs_listen",
-         "scrape", "mem_poll", "profile", "gateway", "route")
+         "scrape", "mem_poll", "profile", "gateway", "route",
+         "gw_writer", "gw_scrape")
 
 
 class FaultInjected(Exception):

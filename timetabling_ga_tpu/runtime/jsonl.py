@@ -72,8 +72,15 @@ class AsyncWriter:
 
     _STOP = object()
 
-    def __init__(self, stream: IO, maxsize: int = 1024):
+    def __init__(self, stream: IO, maxsize: int = 1024,
+                 site: str = "writer"):
         self._stream = stream
+        # which fault-injection site this writer's worker fires
+        # (runtime/faults.py): "writer" for the engine/serve record
+        # stream, "gw_writer" for the fleet gateway's telemetry log —
+        # separate sites so a test killing the gateway's writer cannot
+        # shift the invocation indices of an in-process replica's plan
+        self._site = site
         self._q: queue.Queue = queue.Queue(maxsize=maxsize)
         self._records = 0      # lines enqueued (obs: writer.records)
         self._error: BaseException | None = None
@@ -93,7 +100,7 @@ class AsyncWriter:
             # worker-death scenario the death-aware enqueue/drain below
             # must turn into a raised error, not a deadlock
             try:
-                faults.maybe_fail("writer")
+                faults.maybe_fail(self._site)
             except SystemExit:
                 return
             try:
@@ -423,6 +430,31 @@ def cost_entry(stream: IO, program: str, **extra) -> None:
     _write(stream, {"costEntry": rec})
 
 
+def route_entry(stream: IO, job: str, bucket, replica: str,
+                outcome: str, **extra) -> None:
+    """Observability EXTENSION record (tt-obs v5, the fleet
+    observatory; emitted only when the gateway runs with `-o LOG`):
+    one line per placement decision on the gateway's dispatcher —
+
+      {"routeEntry":{"job":"j42","bucket":[64,8,8,64,5,9],
+                     "replica":"r0","outcome":"hit","backlog":1.0,
+                     "pins":2,"compile_hit_rate":0.93,"attempt":1}}
+
+    `outcome` is the router's affinity classification (hit / warm /
+    miss — fleet/router.py docstring); the extra fields carry the
+    score inputs the decision read (backlog gauge, pin count, measured
+    compile-hit rate). Gateway-side telemetry, not protocol output:
+    strip_timing drops the whole record, so the job record streams'
+    identity contract (routed vs unrouted, gateway obs on vs off)
+    holds by construction."""
+    rec = {"job": str(job),
+           "bucket": list(bucket) if bucket is not None else None,
+           "replica": str(replica), "outcome": str(outcome)}
+    for k, v in extra.items():
+        rec[k] = v
+    _write(stream, {"routeEntry": rec})
+
+
 def phase_record(stream: IO, name: str, trial: int, seconds: float,
                  **extra) -> None:
     """Observability EXTENSION record (not in the reference protocol;
@@ -455,7 +487,7 @@ TIMING_FIELDS = {"logEntry": ("time",), "solution": ("totalTime",),
 # observatory's (streams identical with it on or off MODULO
 # qualityEntry/timing records — tests/test_quality.py).
 TIMING_RECORDS = ("phase", "faultEntry", "spanEntry", "metricsEntry",
-                  "costEntry", "qualityEntry")
+                  "costEntry", "qualityEntry", "routeEntry")
 
 
 def strip_timing(records: List[dict]) -> List[dict]:

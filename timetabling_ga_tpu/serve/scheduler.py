@@ -155,8 +155,13 @@ class Scheduler:
         gets its causal flow id here — every span of its life (admit →
         pack → quantum → park → resume → finalize) carries it, so
         `tt trace --job ID` renders one connected timeline across
-        lanes, parks, and co-tenants."""
-        job.flow = self.tracer.new_flow()
+        lanes, parks, and co-tenants. A job that ARRIVED with a flow
+        (the fleet gateway's X-TT-Flow header, threaded through
+        SolveService.submit) keeps it: the replica-side spans then
+        continue the gateway's cross-process chain instead of opening
+        a local one."""
+        if not job.flow:
+            job.flow = self.tracer.new_flow()
         with self.tracer.span("admit", cat="serve", job=job.id,
                               flow=job.flow):
             jsonl.job_entry(self.out, job.id, "admitted",

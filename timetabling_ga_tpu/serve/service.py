@@ -169,9 +169,14 @@ class SolveService:
         return self._registry
 
     def submit(self, problem, job_id=None, priority: int = 0,
-               seed=None, generations=None, deadline_s=None) -> str:
+               seed=None, generations=None, deadline_s=None,
+               flow: int = 0) -> str:
         """Admit one job; returns its id. Raises AdmissionError when
-        the backlog is full or the id is taken (admission control)."""
+        the backlog is full or the id is taken (admission control).
+        `flow` (optional) is an inherited causal flow id — the fleet
+        gateway's X-TT-Flow, so a routed job's replica-side spans
+        continue the gateway's chain; 0 lets the scheduler allocate a
+        local one at admit."""
         if job_id is None:
             self._auto_id += 1
             job_id = f"job-{self._auto_id}"
@@ -181,7 +186,7 @@ class SolveService:
                   generations=int(self.cfg.generations
                                   if generations is None
                                   else generations),
-                  deadline_s=deadline_s)
+                  deadline_s=deadline_s, flow=int(flow or 0))
         # prepare (pad + place) BEFORE the queue takes the job: a
         # failing instance is rejected here with the queue untouched —
         # no half-admitted job can reach the scheduler

@@ -23,9 +23,16 @@ round, plus the multichip dry-run status — the at-a-glance answer to
 
     python tools/bench_report.py               # tables on stdout
     python tools/bench_report.py --json        # raw extracted dicts
+    python tools/bench_report.py --metrics snap.txt
+        render a saved /metrics exposition snapshot (e.g. `curl
+        gateway:8070/metrics > snap.txt`) as a table, via the shared
+        OpenMetrics parser (obs/scrape.py) — the fleet dashboard with
+        no Prometheus installed: gauges/counters one per line,
+        histograms as count/sum + their exemplars
 
 Stdlib-only and device-free: reading the history must work anywhere
-the repo is checked out.
+the repo is checked out (the --metrics mode imports only
+timetabling_ga_tpu.obs.scrape, itself stdlib-only).
 """
 
 from __future__ import annotations
@@ -66,6 +73,8 @@ _METRICS = [
     ("fleet p50 s", "fleet", "p50_latency_s_2rep"),
     ("fleet p99 s", "fleet", "p99_latency_s_2rep"),
     ("fleet affinity", "fleet", "affinity_hit_rate"),
+    ("fleet jobs/min obs", "fleet", "jobs_per_min_2rep_obs"),
+    ("gateway obs ms/job", "fleet", "gateway_overhead_ms_per_job"),
 ]
 
 _NUM = r"(-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)"
@@ -192,9 +201,48 @@ def report(root: str = REPO) -> str:
     return "\n".join(lines)
 
 
+def metrics_report(path: str) -> str:
+    """Render a saved exposition snapshot (Prometheus 0.0.4 or
+    OpenMetrics 1.0 text) as a readable table — the shared parser
+    (obs/scrape.py) is the only consumer-side knowledge of the
+    format. Histogram families collapse to their _count/_sum samples
+    plus any bucket exemplars (the job/dispatch a latency spike joins
+    back to)."""
+    sys.path.insert(0, REPO)
+    from timetabling_ga_tpu.obs import scrape as obs_scrape
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    families = obs_scrape.parse_exposition(text)
+    lines = [f"# metrics snapshot: {os.path.basename(path)} "
+             f"({len(families)} sample families)"]
+    for name in sorted(families):
+        if name.endswith("_bucket"):
+            continue               # buckets fold into _count/_sum
+        for labels, value in families[name]:
+            lbl = ("{" + ",".join(f"{k}={v}" for k, v in
+                                  sorted(labels.items())) + "}"
+                   if labels else "")
+            lines.append(f"  {name}{lbl} = {_fmt(value)}")
+    exemplars = obs_scrape.parse_exemplars(text)
+    if exemplars:
+        lines.append("  exemplars:")
+        for name, labels, v in exemplars:
+            lbl = ",".join(f"{k}={w}" for k, w in
+                           sorted(labels.items()))
+            lines.append(f"    {name} <- {{{lbl}}} {_fmt(v)}")
+    return "\n".join(lines)
+
+
 def main(argv) -> int:
     as_json = "--json" in argv
     argv = [a for a in argv if a != "--json"]
+    if "--metrics" in argv:
+        i = argv.index("--metrics")
+        if i + 1 >= len(argv):
+            print("--metrics needs a snapshot file", file=sys.stderr)
+            return 2
+        print(metrics_report(argv[i + 1]))
+        return 0
     root = argv[0] if argv else REPO
     if as_json:
         rounds = [load_bench_round(p) for p in
