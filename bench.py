@@ -1108,6 +1108,144 @@ def measure_fleet() -> dict:
     return out
 
 
+def measure_resume() -> dict:
+    """extra.resume leg (ISSUE 12): kill-mid-stream failover A/B —
+    replay (`--snapshot-hwm 0`, the pre-ISSUE-12 behavior) vs resume
+    (snapshot shipping on, the default). Both legs run the same job
+    stream through a gateway + 2 in-process replicas, kill one replica
+    once a job on it has real progress, and run the stream to
+    completion. Reported per leg:
+
+      jobs/min             end-to-end completion rate at the gateway
+      p50/p99 e2e          submit-to-settled per job
+      wasted_gens_ratio    generations EXECUTED fleet-wide beyond the
+                           submitted budgets, over the budgets — the
+                           replay bill (a resumed job re-runs at most
+                           one quantum; a replayed one re-runs
+                           everything its dead replica had done)
+      resume_hits/replays  the gateway's fleet.resume.* counters
+
+    plus a records-identical assertion on the RESUME leg: every job's
+    settled stream (prefix + continuation) must equal the same job on
+    a bare unrouted SolveService, modulo timing/fault records."""
+    import io
+
+    from timetabling_ga_tpu.fleet.gateway import Gateway
+    from timetabling_ga_tpu.fleet.replicas import (
+        http_json, in_process_replica)
+    from timetabling_ga_tpu.problem import dump_tim, random_instance
+    from timetabling_ga_tpu.runtime import jsonl
+    from timetabling_ga_tpu.runtime.config import FleetConfig, ServeConfig
+    from timetabling_ga_tpu.serve.service import SolveService
+
+    problems = [random_instance(7000 + i, n_events=28, n_rooms=3,
+                                n_features=4, n_students=24,
+                                attend_prob=0.08) for i in range(6)]
+    tims = [dump_tim(p) for p in problems]
+    gens = 80
+
+    def serve_cfg():
+        return ServeConfig(backend="cpu", lanes=2, quantum=5,
+                           pop_size=6, max_steps=16,
+                           http="127.0.0.1:0")
+
+    def leg(resume: bool):
+        reps, handles = [], []
+        for r in range(2):
+            rep, handle = in_process_replica(serve_cfg(), f"x{r}")
+            reps.append(rep)
+            handles.append(handle)
+        fcfg = FleetConfig(
+            listen="127.0.0.1:0", replicas=[h.url for h in handles],
+            probe_every=0.1, poll_every=0.05, dead_after=2,
+            snapshot_hwm=(FleetConfig().snapshot_hwm if resume
+                          else 0))
+        gw = Gateway(fcfg, handles).start()
+        t0 = time.perf_counter()
+        for i, tim in enumerate(tims):
+            http_json("POST", gw.url + "/v1/solve",
+                      {"tim": tim, "id": f"k{i}", "seed": i,
+                       "generations": gens})
+        # kill a replica once one of its jobs has observable progress
+        victim = None
+        deadline = time.perf_counter() + 300
+        while victim is None and time.perf_counter() < deadline:
+            for rep in reps:
+                for job in list(rep.svc.queue._jobs.values()):
+                    if job.gens_done >= gens // 2:
+                        victim = rep
+                        break
+                if victim:
+                    break
+            time.sleep(0.01)
+        if victim is not None:
+            victim.kill()
+        deadline = time.perf_counter() + 600
+        while time.perf_counter() < deadline:
+            with gw.jobs_lock:
+                if gw.jobs and all(j.terminal() and j.records_final
+                                   for j in gw.jobs.values()):
+                    break
+            time.sleep(0.05)
+        wall = time.perf_counter() - t0
+        executed = sum(
+            int(rep.svc.registry.counter("serve.gens").value)
+            for rep in reps)
+        budget = gens * len(tims)
+        with gw.jobs_lock:
+            jobs = list(gw.jobs.values())
+            done = sum(1 for j in jobs if j.state == "done")
+            lats = sorted(j.finished_t - j.submitted_t for j in jobs
+                          if j.finished_t is not None)
+            records = {j.id: jsonl.strip_timing(j.records)
+                       for j in jobs}
+        hits = int(gw.registry.counter("fleet.resume.hits").value)
+        replays = int(gw.registry.counter("fleet.resume.replays")
+                      .value)
+        gw.close()
+        for rep in reps:
+            rep.kill()
+
+        def pct(vals, q):
+            return (round(vals[min(len(vals) - 1,
+                                   int(q * len(vals)))], 3)
+                    if vals else None)
+
+        return {"jobs_done": done, "killed": victim is not None,
+                "jobs_per_min": round(60.0 * done / wall, 1),
+                "p50_s": pct(lats, 0.5), "p99_s": pct(lats, 0.99),
+                "wasted_gens_ratio": round(
+                    max(0, executed - budget) / budget, 4),
+                "resume_hits": hits, "resume_replays": replays,
+                }, records
+
+    replay_leg, _ = leg(resume=False)
+    resume_leg, resume_records = leg(resume=True)
+
+    # records-identical assertion on the resumed streams
+    buf = io.StringIO()
+    svc = SolveService(ServeConfig(backend="cpu", lanes=2, quantum=5,
+                                   pop_size=6, max_steps=16), out=buf)
+    for i, p in enumerate(problems):
+        svc.submit(p, job_id=f"k{i}", seed=i, generations=gens)
+    svc.drive()
+    svc.close()
+    base: dict = {}
+    for line in buf.getvalue().splitlines():
+        rec = json.loads(line)
+        body = rec[next(iter(rec))]
+        if isinstance(body, dict) and body.get("job") is not None:
+            base.setdefault(body["job"], []).append(rec)
+    base = {j: jsonl.strip_timing(rs) for j, rs in base.items()}
+    identical = all(resume_records.get(j) == base[j] for j in base)
+
+    return {"replay": replay_leg, "resume": resume_leg,
+            "records_identical": bool(identical),
+            "wasted_gens_saved_ratio": round(
+                replay_leg["wasted_gens_ratio"]
+                - resume_leg["wasted_gens_ratio"], 4)}
+
+
 def measure_scrape() -> dict:
     """extra.scrape leg (ISSUE 6): the pull front's cost on a live
     serve stream.
@@ -1382,7 +1520,24 @@ def measure_quality(problem, pop: int = 256, gens: int = 600) -> dict:
     return out
 
 
-def main() -> None:
+def main(argv=None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+    # fault injection for the bench harness itself (carried ROADMAP
+    # item): `bench.py --faults site:nth:action,...` re-installs the
+    # plan BEFORE EVERY LEG (install resets the per-site counters, so
+    # each leg sees deterministic invocation indices regardless of
+    # which legs ran before it); the per-leg recoveries /
+    # faults_injected deltas below then show exactly which legs
+    # absorbed an injected sick window inside their measurement
+    faults_spec = None
+    if "--faults" in args:
+        i = args.index("--faults")
+        if i + 1 >= len(args):
+            raise SystemExit("bench.py --faults needs a plan "
+                             "(runtime/faults.py site:nth:action)")
+        faults_spec = args[i + 1]
+        from timetabling_ga_tpu.runtime.faults import FaultPlan
+        FaultPlan.parse(faults_spec)       # fail fast on a typo
     problem = _instance()
     # retry the headline through device sick windows (shared policy,
     # timetabling_ga_tpu/runtime/retry.py) instead of zeroing the round
@@ -1416,6 +1571,7 @@ def main() -> None:
             ("serve", measure_serve),
             ("soak", measure_soak),
             ("fleet", measure_fleet),
+            ("resume", measure_resume),
             ("scrape", measure_scrape),
             ("scale_2000ev", measure_scale),
             ("ls_shootout", lambda: measure_ls_shootout(problem)),
@@ -1430,6 +1586,9 @@ def main() -> None:
         # the measurement) must be visible in the trajectory.
         from timetabling_ga_tpu.runtime.engine import run_counters
         try:
+            if faults_spec:
+                from timetabling_ga_tpu.runtime import faults as _f
+                _f.install(faults_spec)
             before = run_counters()
             result, attempts = retry_transient(fn, attempts=3,
                                                wait_s=60.0)
@@ -1445,6 +1604,10 @@ def main() -> None:
             print(f"# {name} failed: {e}", file=sys.stderr)
             extra[name] = {"error": str(e)[:200],
                            "attempts": getattr(e, "tt_attempts", 1)}
+    if faults_spec:
+        from timetabling_ga_tpu.runtime import faults as _f
+        _f.install(None)
+        extra["faults_spec"] = faults_spec
     extra["cpu_native_evals_per_sec"] = round(cpu, 1)
     extra["cpu_threads"] = os.cpu_count() or 1
     # whole-round robustness totals (per-leg deltas above attribute them)

@@ -183,3 +183,73 @@ def test_sanitized_build_runs_clean_on_fixtures():
         lines = [json.loads(x) for x in out.stdout.splitlines()]
         kinds = [next(iter(x)) for x in lines]
         assert kinds.count("runEntry") == 2
+
+
+@pytest.mark.slow
+def test_tsan_build_runs_clean_on_fixtures():
+    """`make -C native tsan` builds the ThreadSanitizer-instrumented
+    binary (carried ROADMAP item: the OpenMP breeding/evaluation loops
+    are the one concurrency surface ASan cannot audit) and a short
+    end-to-end solve emits the JSONL protocol with no ACTIONABLE race
+    reports.
+
+    Toolchain caveat, measured on this box: GCC's libgomp is not
+    TSan-instrumented, so TSan cannot observe the happens-before edges
+    of OpenMP barriers/joins — a multi-threaded run reports "races"
+    between user frames whose synchronization lives entirely inside
+    libgomp (e.g. the post-parallel-region sort the implicit barrier
+    provably orders; both-stacks-restored variants occur too, so no
+    report-shape heuristic separates them from real omp races).
+    HONEST COVERAGE on this toolchain is therefore: the multi-threaded
+    leg enforces run-completion + protocol and that no report is free
+    of libgomp involvement (a race among threads we create directly
+    would be); real race enforcement comes from the single-threaded
+    control (any report fails) and from toolchains with an
+    instrumented OpenMP runtime (clang + archer), where every omp
+    report becomes trustworthy and this filter keeps enforcing
+    zero."""
+    build = subprocess.run(["make", "-C", os.path.join(REPO, "native"),
+                            "tsan"],
+                           capture_output=True, text=True, timeout=300)
+    if build.returncode != 0 and "sanitize" in (build.stdout
+                                                + build.stderr):
+        pytest.skip("toolchain lacks -fsanitize=thread")
+    assert build.returncode == 0, build.stdout + build.stderr
+    binary = os.path.join(REPO, "native", "tt_cpu_tsan")
+    assert os.path.exists(binary)
+    inst = os.path.join(REPO, "fixtures", "comp01s.tim")
+
+    def argv(threads):
+        # -c is the binary's OpenMP thread count (num_threads), so
+        # the control run must ask for 1 there, not via OMP_NUM_THREADS
+        return [binary, "-i", inst, "-s", "3", "-c", str(threads),
+                "--pop-size", "8", "--generations", "5", "-t", "10"]
+
+    # control: single-threaded — NO report is environmental here
+    env1 = dict(os.environ, TSAN_OPTIONS="exitcode=66")
+    out1 = subprocess.run(argv(1), capture_output=True, text=True,
+                          timeout=600, env=env1)
+    assert out1.returncode == 0, (
+        f"single-thread TSan run failed\n{out1.stderr[-4000:]}")
+    assert "WARNING: ThreadSanitizer" not in out1.stderr, (
+        f"single-thread race report\n{out1.stderr[-4000:]}")
+    kinds = [next(iter(json.loads(x)))
+             for x in out1.stdout.splitlines()]
+    assert kinds.count("runEntry") == 2
+
+    # multi-threaded: on an uninstrumented-libgomp toolchain every
+    # report INVOLVING an omp thread is untrustworthy both ways
+    # (docstring) — enforce only what remains enforceable: reports
+    # with no libgomp involvement at all (races among threads the
+    # binary creates directly) fail; everything else is environmental
+    env4 = dict(os.environ, TSAN_OPTIONS="exitcode=0")
+    out4 = subprocess.run(argv(4), capture_output=True, text=True,
+                          timeout=600, env=env4)
+    reports = [r for r in out4.stderr.split("==================")
+               if "WARNING: ThreadSanitizer" in r]
+    real = [r for r in reports if "libgomp" not in r]
+    assert not real, (
+        f"actionable TSan report(s)\n{real[0][-4000:]}")
+    kinds = [next(iter(json.loads(x)))
+             for x in out4.stdout.splitlines()]
+    assert kinds.count("runEntry") == 2

@@ -1,0 +1,698 @@
+"""Resume, don't replay (ISSUE 12): snapshot-shipping failover +
+per-job serve-path fault recovery.
+
+The acceptance properties pinned here:
+
+  1. WIRE FORMAT — the per-job snapshot serialization round-trips;
+     fingerprint mismatches and truncated/corrupted bytes are both
+     REJECTED with named fields/fingerprints (serve/snapshot.py);
+  2. RESUME IDENTITY — a job resumed from a shipped snapshot emits,
+     prefix + continuation, a record stream identical to an
+     uninterrupted solve modulo timing/fault records, duplicate-free
+     by the restored `emitted` floor;
+  3. SERVE-PATH FAULT RECOVERY — a transient fault during a stacked
+     quantum requeues ONLY the dispatch's jobs from their park
+     snapshots (streams still identical to an uninjected run); a
+     non-transient/budget-exhausted job fails ALONE with a terminal
+     jobEntry, co-tenants bit-identical;
+  4. ISOLATION — a hung snapshot export parks one handler thread
+     only; a die during resume admission demotes to replay; neither
+     stalls the drive loop, other jobs, or writer drain (fault sites
+     quantum / snapshot_ship / resume);
+  5. FLEET ACCEPTANCE — gateway + 2 replicas, kill one observed
+     mid-job: the job completes on the survivor having re-run at most
+     one quantum (never from gen 0), `fleet.resume.hits` >= 1 on
+     /metrics, and every stream equals the unrouted baseline;
+  6. PREEMPT DRAIN — /v1/drain?mode=preempt parks + ships within the
+     deadline; a gateway-driven preempt is lossless scale-down.
+"""
+
+import io
+import json
+import time
+
+import pytest
+
+from timetabling_ga_tpu.fleet.gateway import Gateway
+from timetabling_ga_tpu.fleet.replicas import (
+    http_json, http_text, in_process_replica)
+from timetabling_ga_tpu.problem import dump_tim, random_instance
+from timetabling_ga_tpu.runtime import faults, jsonl
+from timetabling_ga_tpu.runtime.config import (
+    FleetConfig, ServeConfig, parse_fleet_args, parse_serve_args)
+from timetabling_ga_tpu.serve import snapshot as snapshot_mod
+from timetabling_ga_tpu.serve.service import SolveService
+
+_SHAPE_A = dict(n_events=12, n_rooms=3, n_features=2, n_students=8,
+                attend_prob=0.2)
+_SHAPE_B = dict(n_events=40, n_rooms=4, n_features=2, n_students=30,
+                attend_prob=0.1)
+
+_PA = random_instance(71, **_SHAPE_A)
+_PB = random_instance(72, **_SHAPE_B)
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    """Every test leaves the process without an installed plan."""
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+def _serve_cfg(**kw):
+    kw.setdefault("backend", "cpu")
+    kw.setdefault("lanes", 2)
+    kw.setdefault("quantum", 5)
+    kw.setdefault("pop_size", 4)
+    kw.setdefault("max_steps", 8)
+    return ServeConfig(**kw)
+
+
+def _fleet_cfg(urls, **kw):
+    kw.setdefault("listen", "127.0.0.1:0")
+    kw.setdefault("probe_every", 0.1)
+    kw.setdefault("poll_every", 0.05)
+    kw.setdefault("dead_after", 2)
+    return FleetConfig(replicas=list(urls), **kw)
+
+
+def _job_records(text, job_id):
+    out = []
+    for line in text.splitlines():
+        rec = json.loads(line)
+        body = rec[next(iter(rec))]
+        if isinstance(body, dict) and body.get("job") == str(job_id):
+            out.append(rec)
+    return out
+
+
+def _baseline(jobs, **cfg_kw):
+    """{id: strip_timing(records)} for `jobs` on a bare service."""
+    buf = io.StringIO()
+    svc = SolveService(_serve_cfg(**cfg_kw), out=buf)
+    for jid, problem, seed, gens in jobs:
+        svc.submit(problem, job_id=jid, seed=seed, generations=gens)
+    svc.drive()
+    svc.close()
+    return {jid: jsonl.strip_timing(_job_records(buf.getvalue(), jid))
+            for jid, *_ in jobs}
+
+
+# ---------------------------------------------------------- wire format
+
+
+def test_wire_roundtrip_and_rejections():
+    buf = io.StringIO()
+    svc = SolveService(_serve_cfg(), out=buf)
+    svc.submit(_PA, job_id="w", seed=5, generations=20)
+    svc.step()
+    job = svc.queue.get("w")
+    ship = job.ship
+    assert ship is not None and ship.gens_done > 0
+    wire = ship.pack()
+    svc.close()
+
+    # JSON-safe: the wire object must survive the /v1 protocol
+    wire = json.loads(json.dumps(wire))
+    expect = snapshot_mod.wire_fingerprint(job.bucket, 4, 5)
+    assert wire["fingerprint"] == expect
+    state, meta = snapshot_mod.unpack_state(
+        wire, expect_fingerprint=expect)
+    assert (state.slots == ship.state.slots).all()
+    assert (state.penalty == ship.state.penalty).all()
+    assert meta == {"gens_done": ship.gens_done,
+                    "chunks": ship.chunks, "emitted": ship.emitted,
+                    "best": ship.best}
+
+    # fingerprint mismatch: NAMED fingerprints, SnapshotMismatch
+    other = snapshot_mod.wire_fingerprint(job.bucket, 8, 5)
+    with pytest.raises(snapshot_mod.SnapshotMismatch) as ei:
+        snapshot_mod.verify_wire(wire, expect_fingerprint=other)
+    assert expect in str(ei.value) and other in str(ei.value)
+
+    # truncated bytes: named field, SnapshotCorrupt
+    cut = dict(wire, npz=wire["npz"][: len(wire["npz"]) // 2])
+    with pytest.raises(snapshot_mod.SnapshotCorrupt) as ei:
+        snapshot_mod.verify_wire(cut)
+    assert "npz" in str(ei.value)
+
+    # CRC mismatch (bit rot at the right length): named field
+    with pytest.raises(snapshot_mod.SnapshotCorrupt) as ei:
+        snapshot_mod.verify_wire(dict(wire, crc=wire["crc"] ^ 1))
+    assert "CRC" in str(ei.value)
+
+    # missing field + foreign version
+    with pytest.raises(snapshot_mod.SnapshotCorrupt) as ei:
+        snapshot_mod.verify_wire({k: v for k, v in wire.items()
+                                  if k != "gens_done"})
+    assert "gens_done" in str(ei.value)
+    with pytest.raises(snapshot_mod.SnapshotMismatch):
+        snapshot_mod.verify_wire(dict(wire, v=99))
+
+
+# -------------------------------------------------------- resume (serve)
+
+
+def test_resumed_stream_identity():
+    """Prefix (shipped records) + continuation (resumed service) ==
+    uninterrupted stream, modulo timing/fault records — ISSUE 12's
+    duplicate-free seam, at the serve level."""
+    jobs = [("r", _PA, 3, 20)]
+    base = _baseline(jobs)
+
+    buf1 = io.StringIO()
+    svc1 = SolveService(_serve_cfg(), out=buf1)
+    svc1.submit(_PA, job_id="r", seed=3, generations=20)
+    svc1.step()
+    svc1.step()
+    ship = svc1.queue.get("r").ship
+    wire = json.loads(json.dumps(ship.pack()))
+    prefix = list(ship.records)
+    assert ship.gens_done == 10
+    svc1.close()
+
+    buf2 = io.StringIO()
+    svc2 = SolveService(_serve_cfg(), out=buf2)
+    svc2.submit(_PA, job_id="r", seed=3, generations=20,
+                snapshot=wire)
+    job = svc2.queue.get("r")
+    assert job.state == "parked" and job.gens_done == 10
+    svc2.drive()
+    svc2.close()
+    cont = _job_records(buf2.getvalue(), "r")
+    # the only seam is the faultEntry (site=fleet action=resume),
+    # which strip_timing drops
+    seams = [r for r in cont if "faultEntry" in r]
+    assert any(r["faultEntry"]["site"] == "fleet"
+               and r["faultEntry"]["action"] == "resume"
+               for r in seams)
+    assert jsonl.strip_timing(prefix + cont) == base["r"]
+    assert svc2.queue.get("r").result["resumed_at"] == 10
+
+
+def test_bad_snapshot_demotes_to_replay():
+    """A corrupt / mismatched / die-injected resume falls back to a
+    fresh solve — never an error, never a stalled drive loop — and
+    the fresh stream matches the plain baseline."""
+    base = _baseline([("d", _PA, 3, 10)])
+
+    buf1 = io.StringIO()
+    svc1 = SolveService(_serve_cfg(), out=buf1)
+    svc1.submit(_PA, job_id="seed", seed=3, generations=10)
+    svc1.step()
+    wire = svc1.queue.get("seed").ship.pack()
+    svc1.close()
+
+    for case, bad in (
+            ("corrupt", dict(wire, npz=wire["npz"][:40])),
+            ("foreign", dict(wire, fingerprint="j1|b9|p9|s9")),
+            ("die", dict(wire))):
+        buf = io.StringIO()
+        svc = SolveService(_serve_cfg(), out=buf)
+        if case == "die":
+            faults.install("resume:1:die")
+        svc.submit(_PA, job_id="d", seed=3, generations=10,
+                   snapshot=bad)
+        faults.install(None)
+        job = svc.queue.get("d")
+        assert job.state == "pending", case     # demoted, not parked
+        svc.drive()
+        svc.close()
+        recs = _job_records(buf.getvalue(), "d")
+        assert jsonl.strip_timing(recs) == base["d"], case
+        assert any(r["faultEntry"]["site"] == "resume"
+                   and r["faultEntry"]["action"] == "replay"
+                   for r in recs if "faultEntry" in r), case
+        assert svc.registry.counter(
+            "serve.jobs_resume_rejected").value >= 1, case
+
+
+# ------------------------------------------- serve-path fault recovery
+
+
+def test_quantum_fault_requeues_from_snapshots():
+    """A transient fault during a stacked quantum requeues only the
+    affected jobs from their park snapshots: every job still
+    completes, and every stream — affected and co-tenant — is
+    bit-identical to an uninjected run (strip_timing domain)."""
+    jobs = [("qa", _PA, 3, 15), ("qb", _PB, 4, 15)]
+    base = _baseline(jobs)
+
+    buf = io.StringIO()
+    svc = SolveService(_serve_cfg(), out=buf)
+    faults.install("quantum:2:unavailable")
+    for jid, p, seed, gens in jobs:
+        svc.submit(p, job_id=jid, seed=seed, generations=gens)
+    svc.drive()
+    faults.install(None)
+    svc.close()
+    assert svc.registry.counter("serve.job_recoveries").value >= 1
+    for jid, *_ in jobs:
+        assert svc.queue.get(jid).state == "done"
+        assert jsonl.strip_timing(
+            _job_records(buf.getvalue(), jid)) == base[jid], jid
+
+
+def test_quantum_fault_budget_exhausted_fails_alone():
+    """A non-transient quantum fault (or an exhausted per-job
+    recovery budget) fails THAT dispatch's jobs with a terminal
+    jobEntry; jobs of the other bucket run on bit-identically."""
+    jobs = [("fa", _PA, 3, 15), ("fb", _PB, 4, 15)]
+    base = _baseline(jobs)
+
+    buf = io.StringIO()
+    svc = SolveService(_serve_cfg(), out=buf)
+    faults.install("quantum:1:error")
+    for jid, p, seed, gens in jobs:
+        svc.submit(p, job_id=jid, seed=seed, generations=gens)
+    svc.drive()
+    faults.install(None)
+    svc.close()
+    states = {jid: svc.queue.get(jid).state for jid, *_ in jobs}
+    failed = [j for j, s in states.items() if s == "failed"]
+    assert len(failed) == 1, states         # one bucket's dispatch
+    survivor = next(j for j, s in states.items() if s == "done")
+    assert jsonl.strip_timing(
+        _job_records(buf.getvalue(), survivor)) == base[survivor]
+    fail_recs = _job_records(buf.getvalue(), failed[0])
+    assert any(r["jobEntry"]["event"] == "failed"
+               and "quantum fault" in r["jobEntry"].get("reason", "")
+               for r in fail_recs if "jobEntry" in r)
+    # exhausted budget path: repeated transients past the per-job cap
+    buf2 = io.StringIO()
+    svc2 = SolveService(_serve_cfg(max_job_recoveries=1), out=buf2)
+    faults.install("quantum:1:unavailable,quantum:2:unavailable")
+    svc2.submit(_PA, job_id="fx", seed=3, generations=15)
+    svc2.drive()
+    faults.install(None)
+    svc2.close()
+    assert svc2.queue.get("fx").state == "failed"
+    assert svc2.queue.get("fx").recoveries == 2
+
+
+# -------------------------------------------------------- fault isolation
+
+
+def test_snapshot_ship_hang_parks_handler_only(monkeypatch):
+    """A hung snapshot export (`snapshot_ship:1:hang`) parks ONE
+    replica handler thread: the fetch times out client-side, the
+    drive loop keeps solving, a later export works, and the writer
+    drains on stop."""
+    monkeypatch.setattr(faults, "HANG_S", 30.0)
+    rep, handle = in_process_replica(
+        _serve_cfg(http="127.0.0.1:0"), "hang0")
+    try:
+        http_json("POST", rep.url + "/v1/solve",
+                  {"tim": dump_tim(_PA), "id": "h", "seed": 3,
+                   "generations": 400})
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if rep.svc.queue.get("h").ship is not None:
+                    break
+            except KeyError:
+                pass
+            time.sleep(0.02)
+        faults.install("snapshot_ship:1:hang")
+        with pytest.raises(Exception):
+            handle.get_job("h", timeout=0.5, with_records=False,
+                           snapshot=True)
+        # the drive loop never stalled: progress continues
+        g0 = rep.svc.queue.get("h").gens_done
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if rep.svc.queue.get("h").gens_done > g0:
+                break
+            time.sleep(0.02)
+        assert rep.svc.queue.get("h").gens_done > g0
+        # the next export (invocation 2) works
+        view = handle.get_job("h", timeout=10.0, with_records=False,
+                              snapshot=True)
+        assert view.get("snapshot") is not None
+        faults.install(None)
+        # writer drains: graceful stop completes the stream
+        rep.svc.cancel("h")
+        rep.stop(timeout=60)
+        assert rep.drained.wait(5)
+    finally:
+        faults.install(None)
+        rep.kill()
+
+
+# ------------------------------------------------------- preempt drain
+
+
+def test_preempt_drain_ships_and_honors_deadline():
+    """/v1/drain?mode=preempt parks every active job as `preempted`
+    with its snapshot published; the replica exits once every ship
+    unit is fetched — or at --preempt-grace when nobody fetches."""
+    # nobody fetches: the deadline bounds the wait
+    rep, handle = in_process_replica(
+        _serve_cfg(http="127.0.0.1:0", preempt_grace=1.5), "pd0")
+    http_json("POST", rep.url + "/v1/solve",
+              {"tim": dump_tim(_PA), "id": "p1", "seed": 3,
+               "generations": 5000})
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            if rep.svc.queue.get("p1").ship is not None:
+                break
+        except KeyError:
+            pass
+        time.sleep(0.02)
+    t0 = time.monotonic()
+    http_json("POST", rep.url + "/v1/drain?mode=preempt", {},
+              ok=(200,))
+    assert rep.drained.wait(30)
+    assert time.monotonic() - t0 < 15       # grace 1.5s + slack
+    rep.kill()
+
+    # fetched: exit is prompt, the view shows `preempted` + snapshot
+    rep2, handle2 = in_process_replica(
+        _serve_cfg(http="127.0.0.1:0", preempt_grace=60.0), "pd1")
+    http_json("POST", rep2.url + "/v1/solve",
+              {"tim": dump_tim(_PA), "id": "p2", "seed": 3,
+               "generations": 5000})
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            if rep2.svc.queue.get("p2").ship is not None:
+                break
+        except KeyError:
+            pass
+        time.sleep(0.02)
+    http_json("POST", rep2.url + "/v1/drain?mode=preempt", {},
+              ok=(200,))
+    deadline = time.monotonic() + 30
+    view = {}
+    while time.monotonic() < deadline:
+        view = handle2.get_job("p2", timeout=5.0,
+                               with_records=False, snapshot=True)
+        if view.get("state") == "preempted":
+            break
+        time.sleep(0.05)
+    assert view.get("state") == "preempted"
+    assert view.get("snapshot") is not None
+    assert any("jobEntry" in r for r in view.get("snapshot_records",
+                                                 []))
+    # the fetch above marked the unit served: prompt exit, way before
+    # the 60s grace
+    assert rep2.drained.wait(30)
+    rep2.kill()
+    # a bad mode is a 400
+    rep3, _ = in_process_replica(
+        _serve_cfg(http="127.0.0.1:0"), "pd2")
+    from timetabling_ga_tpu.fleet.replicas import FleetHTTPError
+    with pytest.raises(FleetHTTPError):
+        http_json("POST", rep3.url + "/v1/drain?mode=bogus", {},
+                  ok=(200,))
+    rep3.kill()
+
+
+# ----------------------------------------------------- gateway caching
+
+
+def test_gateway_snapshot_cache_eviction_and_replay_fallback():
+    """Under a tiny --snapshot-hwm every cached snapshot evicts
+    (oldest-progress-first, counted) and a subsequent kill falls back
+    to the REPLAY failover — still completing with an identical
+    stream, just from gen 0 (`fleet.resume.replays`)."""
+    jobs = [("e0", _PA, 3, 60)]
+    rep0, h0 = in_process_replica(
+        _serve_cfg(http="127.0.0.1:0"), "e0r")
+    rep1, h1 = in_process_replica(
+        _serve_cfg(http="127.0.0.1:0"), "e1r")
+    gw = Gateway(_fleet_cfg([h0.url, h1.url], snapshot_hwm=1),
+                 [h0, h1]).start()
+    try:
+        for jid, p, seed, gens in jobs:
+            http_json("POST", gw.url + "/v1/solve",
+                      {"tim": dump_tim(p), "id": jid, "seed": seed,
+                       "generations": gens})
+        deadline = time.monotonic() + 90
+        killed = None
+        reps = {"e0r": rep0, "e1r": rep1}
+        while time.monotonic() < deadline:
+            if gw.registry.counter("fleet.resume.evictions").value \
+                    >= 1:
+                with gw.jobs_lock:
+                    j = gw.jobs.get("e0")
+                    owner, snap = j.replica, j.snap
+                assert snap is None       # evicted, nothing cached
+                if owner in reps:
+                    killed = owner
+                    reps[owner].kill()
+                    break
+            time.sleep(0.02)
+        assert killed, "no eviction observed"
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            v = http_json("GET", gw.url + "/v1/jobs/e0", ok=(200,))
+            if v["state"] in ("done", "failed"):
+                break
+            time.sleep(0.1)
+        assert v["state"] == "done"
+        assert gw.registry.counter("fleet.resume.replays").value >= 1
+        assert gw.registry.counter("fleet.resume.hits").value == 0
+        assert jsonl.strip_timing(v["records"]) \
+            == _baseline(jobs)["e0"]
+    finally:
+        gw.close()
+        rep0.kill()
+        rep1.kill()
+
+
+def test_remote_rejection_demotes_without_duplicates():
+    """A survivor whose serve config cannot validate the attached
+    snapshot (different pop size → foreign fingerprint) replays from
+    gen 0 — the gateway detects the fresh stream by its `admitted`
+    jobEntry, DROPS the now-redundant prefix (fleet.resume.demoted),
+    and the settled stream stays duplicate-free."""
+    rep0, h0 = in_process_replica(
+        _serve_cfg(http="127.0.0.1:0"), "m0")
+    rep1, h1 = in_process_replica(
+        _serve_cfg(http="127.0.0.1:0", pop_size=8), "m1")
+    gw = Gateway(_fleet_cfg([h0.url, h1.url]), [h0, h1]).start()
+    try:
+        http_json("POST", gw.url + "/v1/solve",
+                  {"tim": dump_tim(_PA), "id": "mx", "seed": 3,
+                   "generations": 1200})
+        # wait until the job runs on m0 with a cached snapshot
+        deadline = time.monotonic() + 90
+        ok = False
+        while time.monotonic() < deadline:
+            with gw.jobs_lock:
+                j = gw.jobs.get("mx")
+                ok = j.replica == "m0" and j.snap_gens >= 10
+            if ok:
+                break
+            time.sleep(0.01)
+        if not ok:
+            pytest.skip("job landed on the mismatched replica first")
+        rep0.kill()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            v = http_json("GET", gw.url + "/v1/jobs/mx", ok=(200,))
+            if v["state"] in ("done", "failed"):
+                break
+            time.sleep(0.1)
+        assert v["state"] == "done"
+        assert v["result"]["resumed_at"] == 0       # replayed
+        assert gw.registry.counter("fleet.resume.demoted").value >= 1
+        events = [r["jobEntry"]["event"] for r in v["records"]
+                  if "jobEntry" in r]
+        assert events.count("admitted") == 1, events
+        assert events.count("started") == 1, events
+        assert events.count("done") == 1, events
+    finally:
+        gw.close()
+        rep0.kill()
+        rep1.kill()
+
+
+# ------------------------------------------------- fleet acceptance e2e
+
+
+def test_fleet_acceptance_kill_resumes_not_replays():
+    """ISSUE 12 acceptance: gateway + 2 replicas, kill one observed
+    mid-job. The job completes on the survivor having re-run AT MOST
+    one quantum's generations (never from gen 0), its stream is
+    duplicate-free and identical to an uninterrupted solve modulo
+    timing/fault records, and fleet.resume.hits >= 1 on /metrics."""
+    jobs = [("ra", _PA, 3, 2000), ("rb", _PB, 4, 40)]
+    rep0, h0 = in_process_replica(
+        _serve_cfg(http="127.0.0.1:0"), "a0")
+    rep1, h1 = in_process_replica(
+        _serve_cfg(http="127.0.0.1:0"), "a1")
+    gw = Gateway(_fleet_cfg([h0.url, h1.url]), [h0, h1]).start()
+    reps = {"a0": rep0, "a1": rep1}
+    try:
+        for jid, p, seed, gens in jobs:
+            http_json("POST", gw.url + "/v1/solve",
+                      {"tim": dump_tim(p), "id": jid, "seed": seed,
+                       "generations": gens})
+        # kill ra's owner at a moment the gateway's cached snapshot is
+        # in sync with the replica's progress (within one quantum), so
+        # the re-run bound is deterministic
+        deadline = time.monotonic() + 120
+        killed = None
+        while time.monotonic() < deadline:
+            with gw.jobs_lock:
+                j = gw.jobs.get("ra")
+                owner, snap_gens = j.replica, j.snap_gens
+            if owner in reps and snap_gens >= 10:
+                try:
+                    gens_now = reps[owner].svc.queue.get(
+                        "ra").gens_done
+                except KeyError:
+                    gens_now = None
+                if gens_now is not None and snap_gens \
+                        >= gens_now - 5:
+                    killed = owner
+                    reps[owner].kill()
+                    break
+            time.sleep(0.005)
+        assert killed, "never reached a synced kill point"
+
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            views = {jid: http_json(
+                "GET", f"{gw.url}/v1/jobs/{jid}", ok=(200,))
+                for jid, *_ in jobs}
+            if all(v["state"] in ("done", "failed")
+                   for v in views.values()):
+                break
+            time.sleep(0.1)
+        assert all(v["state"] == "done" for v in views.values()), \
+            {j: v["state"] for j, v in views.items()}
+
+        # resumed, not replayed: the survivor restarted from the
+        # shipped snapshot, re-running at most the one quantum that
+        # was in flight at the kill
+        res = views["ra"]["result"]
+        assert res["resumed_at"] > 0
+        dead_gens = reps[killed].svc.queue.get("ra").gens_done
+        assert dead_gens - res["resumed_at"] <= 5       # one quantum
+        assert gw.registry.counter("fleet.resume.hits").value >= 1
+        metrics = http_text(gw.url + "/metrics")
+        assert "tt_fleet_resume_hits_total 1" in metrics
+
+        # duplicate-free + identical to the unrouted baseline
+        base = _baseline(jobs)
+        for jid, v in views.items():
+            events = [r["jobEntry"]["event"] for r in v["records"]
+                      if "jobEntry" in r]
+            assert events.count("done") == 1, (jid, events)
+            assert sum(1 for r in v["records"] if "solution" in r) \
+                == 1, jid
+            assert jsonl.strip_timing(v["records"]) == base[jid], jid
+    finally:
+        gw.close()
+        rep0.kill()
+        rep1.kill()
+
+
+def test_gateway_preempt_scale_down_lossless():
+    """Targeted POST /v1/drain?mode=preempt&replica=NAME: the
+    preempted replica ships + drains, its job resumes on the survivor
+    from the preempt fence (zero re-run), and the settled stream is
+    identical to an unrouted solve."""
+    jobs = [("px", _PA, 3, 1500)]
+    rep0, h0 = in_process_replica(
+        _serve_cfg(http="127.0.0.1:0", preempt_grace=30.0), "s0")
+    rep1, h1 = in_process_replica(
+        _serve_cfg(http="127.0.0.1:0", preempt_grace=30.0), "s1")
+    gw = Gateway(_fleet_cfg([h0.url, h1.url]), [h0, h1]).start()
+    reps = {"s0": rep0, "s1": rep1}
+    try:
+        http_json("POST", gw.url + "/v1/solve",
+                  {"tim": dump_tim(_PA), "id": "px", "seed": 3,
+                   "generations": 1500})
+        deadline = time.monotonic() + 90
+        owner = None
+        while time.monotonic() < deadline:
+            with gw.jobs_lock:
+                j = gw.jobs.get("px")
+                owner, snap_gens = j.replica, j.snap_gens
+            if owner in reps and snap_gens >= 10:
+                break
+            time.sleep(0.01)
+        assert owner in reps
+        ack = http_json(
+            "POST",
+            f"{gw.url}/v1/drain?mode=preempt&replica={owner}", {},
+            ok=(202,))
+        assert ack == {"preempting": owner}
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            v = http_json("GET", gw.url + "/v1/jobs/px", ok=(200,))
+            if v["state"] in ("done", "failed"):
+                break
+            time.sleep(0.1)
+        assert v["state"] == "done"
+        assert v["replica"] != owner            # moved, not restarted
+        assert reps[owner].drained.wait(30)     # replica exited clean
+        assert gw.registry.counter("fleet.resume.hits").value >= 1
+        # LOSSLESS: resumed exactly at the preempt fence — the dead
+        # incarnation's committed progress equals the resume point
+        assert v["result"]["resumed_at"] \
+            == reps[owner].svc.queue.get("px").gens_done
+        assert jsonl.strip_timing(v["records"]) \
+            == _baseline(jobs)["px"]
+    finally:
+        gw.close()
+        rep0.kill()
+        rep1.kill()
+
+
+# ----------------------------------------------------- flags & plumbing
+
+
+def test_new_flags_parse_and_validate():
+    cfg = parse_fleet_args(["--replica", "http://a:1",
+                            "--snapshot-hwm", "1024"])
+    assert cfg.snapshot_hwm == 1024
+    with pytest.raises(SystemExit):
+        parse_fleet_args(["--replica", "u", "--snapshot-hwm", "-1"])
+    scfg = parse_serve_args(["--max-job-recoveries", "3",
+                             "--preempt-grace", "2.5",
+                             "--preempt-on-term"])
+    assert scfg.max_job_recoveries == 3
+    assert scfg.preempt_grace == 2.5
+    assert scfg.preempt_on_term is True
+    with pytest.raises(SystemExit):
+        parse_serve_args(["--max-job-recoveries", "-1"])
+    with pytest.raises(SystemExit):
+        parse_serve_args(["--preempt-grace", "-1"])
+    # the new fault sites are part of the closed, validated set
+    plan = faults.FaultPlan.parse(
+        "quantum:1:unavailable,snapshot_ship:2:hang,resume:1:die")
+    assert plan is not None
+    with pytest.raises(faults.FaultPlanError):
+        faults.FaultPlan.parse("quantums:1:die")
+
+
+def test_tt_stats_recovered_component(tmp_path, capsys):
+    """A resumed job's serve log (obs on) yields a `recovered`
+    latency component in the tt stats breakdown."""
+    from timetabling_ga_tpu.obs.logstats import main_stats
+
+    buf1 = io.StringIO()
+    svc1 = SolveService(_serve_cfg(obs=True), out=buf1)
+    svc1.submit(_PA, job_id="t", seed=3, generations=20)
+    svc1.step()
+    wire = svc1.queue.get("t").ship.pack()
+    svc1.close()
+
+    log = tmp_path / "resumed.jsonl"
+    with open(log, "w") as fh:
+        svc2 = SolveService(_serve_cfg(obs=True), out=fh)
+        svc2.submit(_PA, job_id="t", seed=3, generations=20,
+                    snapshot=wire)
+        svc2.drive()
+        svc2.close()
+    assert main_stats([str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "recovered" in out
+    assert "job latency breakdown" in out

@@ -44,6 +44,12 @@ submit one instance to a fleet gateway (or a single replica) and wait:
   --records             print the job-tagged record tail too
   --records-out <path>  write the record tail as JSONL lines to this
                         file (tt stats / tt trace input)
+  --snapshot <path>     warm-start the job from a wire snapshot JSON
+                        file (serve/snapshot.py — README "Fleet
+                        resume"): the job resumes at the snapshot's
+                        progress instead of generation 0; the file is
+                        a GET /v1/jobs/<id>?snapshot=1 view's
+                        "snapshot" object, or the object itself
   --no-wait             print the job id and exit without polling
   -h, --help            show this message and exit"""
 
@@ -116,6 +122,26 @@ def main_submit(argv) -> int:
                       file=sys.stderr)
                 return 2
             records_out = rest[i + 1]
+            i += 2
+            continue
+        if a == "--snapshot":
+            if i + 1 >= len(rest):
+                print("flag --snapshot needs a value",
+                      file=sys.stderr)
+                return 2
+            try:
+                with open(rest[i + 1], "r", encoding="utf-8") as fh:
+                    snap = json.load(fh)
+            except (OSError, ValueError) as e:
+                print(f"tt submit: bad snapshot file: {e}",
+                      file=sys.stderr)
+                return 2
+            # accept either the bare wire object or a saved
+            # ?snapshot=1 job view wrapping one
+            if isinstance(snap, dict) and "snapshot" in snap \
+                    and "npz" not in snap:
+                snap = snap["snapshot"]
+            payload["snapshot"] = snap
             i += 2
             continue
         if a == "--no-wait":

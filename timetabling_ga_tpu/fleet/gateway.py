@@ -35,16 +35,33 @@ outbound HTTP (routing, submission, status polls, failover) and every
 mutation of router state, so a scrape storm or a wedged handler can
 never stall placement, and placement races cannot exist.
 
-Failover: the ReplicaSet's prober declares a replica dead after
-`--dead-after` consecutive failed probes (or a reaped worker process);
-the dispatcher then forgets the dead replica's pins, discards its
-unfinished jobs' partial record tails, and resubmits each job —
-idempotent by job id, same payload, same seed — wherever the router
-now places it. A job's record stream is a pure function of its own
-(seed, chunk) lane RNG (serve/scheduler.py), so the replayed solve
-emits records bit-identical to an unrouted solve of the same job
-(tests/test_fleet.py and bench extra.fleet pin it, modulo timing
-fields).
+Failover — RESUME, don't replay (README "Fleet resume"): the
+ReplicaSet's prober declares a replica dead after `--dead-after`
+consecutive failed probes (or a reaped worker process); the dispatcher
+then forgets the dead replica's pins and resubmits each unfinished job
+wherever the router now places it. Under `--snapshot-hwm` (default on)
+the dispatcher has been CACHING each in-flight job's latest park-fence
+snapshot (`?snapshot=1`, published by the owning replica at every
+quantum park; fingerprint-validated stdlib-only via
+serve/snapshot.verify_wire), so the resubmission carries the wire
+snapshot: the survivor admits the job already PARKED at the shipped
+progress — at most one quantum re-runs, never hours — and the shipped
+record prefix joins the gateway's accumulated `prefix` so the settled
+stream is whole, duplicate-free (the restored `emitted` floor), and
+identical to an unrouted solve modulo timing/fault records
+(tests/test_resume.py pins it). Jobs whose snapshot was never cached,
+was evicted (oldest-progress-first under the byte budget —
+`fleet.resume.evictions`), or failed validation fall back to the
+replay failover, exactly as before: idempotent by job id, same
+payload, same seed, records bit-identical to an unrouted solve. The
+resume story is on /metrics as `fleet.resume.{hits,replays,fetches,
+fetch_errors,rejected,evictions,demoted}` + `fleet.resume.{bytes,
+cached}` gauges (`demoted` = the replica refused an attached snapshot
+and replayed; the gateway detects the fresh stream by its `admitted`
+jobEntry and drops the now-redundant prefix). `POST /v1/drain?mode=preempt&replica=NAME` (and SIGTERM on a
+`--preempt-on-term` spot worker) is the cooperative form: the replica
+parks + ships everything within `--preempt-grace` and its jobs resume
+elsewhere — lossless scale-down.
 
 Observability (tt-obs v5, README "Fleet observability"): `-o LOG`
 gives the gateway its own JSONL telemetry stream through an
@@ -100,6 +117,7 @@ from timetabling_ga_tpu.runtime import faults, jsonl
 from timetabling_ga_tpu.runtime.config import (
     FleetConfig, ServeConfig, parse_fleet_args, parse_serve_args)
 from timetabling_ga_tpu.runtime.retry import retry_transient
+from timetabling_ga_tpu.serve import snapshot as snapshot_mod
 from timetabling_ga_tpu.serve.bucket import (
     BucketSpec, bucket_key_from_counts)
 from timetabling_ga_tpu.fleet.router import NoReplicaError, Router
@@ -114,7 +132,12 @@ MAX_BODY = 32 * 1024 * 1024
 TERMINAL = ("done", "failed", "cancelled", "shed", "rejected")
 
 _PAYLOAD_KEYS = ("id", "tim", "problem", "priority", "seed",
-                 "generations", "deadline", "n_days", "slots_per_day")
+                 "generations", "deadline", "n_days", "slots_per_day",
+                 # a warm-start wire snapshot (serve/snapshot.py): the
+                 # gateway attaches one at resume-on-failover, and a
+                 # client may submit one directly (incremental
+                 # re-solve warm starts ride the same seam)
+                 "snapshot")
 
 
 # ---------------------------------------------------------------- protocol
@@ -197,7 +220,15 @@ class ApiHandler(obs_http._Handler):
                           for p in query.split("&") if "=" in p)
             status, obj = self.server.api.job_view(
                 self._job_id(path),
-                with_records=params.get("records") != "0")
+                with_records=params.get("records") != "0",
+                with_snapshot=params.get("snapshot") == "1")
+            if status is None:
+                # an injected `snapshot_ship` die: absorbed as a
+                # dropped connection (the `scrape` site's discipline —
+                # a SystemExit escaping the handler thread would trip
+                # process-wide excepthook machinery)
+                self.close_connection = True
+                return
             self._reply_json(status, obj)
         elif path == "/v1/jobs":
             # bulk state-only view: the gateway's steady-state poll is
@@ -238,7 +269,12 @@ class ApiHandler(obs_http._Handler):
             # leftover payload bytes (the >=400 path closes the
             # connection instead — _reply)
             self._discard_body()
-            status, obj = self.server.api.accept_drain()
+            path_, _, query = self.path.partition("?")
+            params = dict(p.split("=", 1)
+                          for p in query.split("&") if "=" in p)
+            status, obj = self.server.api.accept_drain(
+                mode=params.get("mode", "graceful"),
+                replica=params.get("replica"))
             self._reply_json(status, obj)
         else:
             self._reply_json(404, {"error": f"no route {path!r}"})
@@ -350,6 +386,28 @@ class GatewayJob:
         #                              job's routed spans never overlap
         #                              and their sum stays a real
         #                              placement-time total
+        # -- resume, don't replay (README "Fleet resume") ----------------
+        self.prefix: list = []       # records of PREVIOUS incarnations
+        #                              (accumulated at each resume):
+        #                              the settled stream is
+        #                              prefix + the final replica's
+        #                              tail — whole and duplicate-free
+        self.snap = None             # newest fingerprint-valid wire
+        #                              snapshot fetched from the owner
+        self.snap_records: list = []  # the record prefix shipped WITH
+        #                              that snapshot (one consistent
+        #                              park-fence pair)
+        self.snap_gens = 0           # progress of the cached snapshot
+        #                              (fetch throttle + the
+        #                              oldest-progress-first eviction
+        #                              key)
+        self.snap_bytes = 0          # cache accounting vs
+        #                              --snapshot-hwm
+        self.snap_truncated = False  # the shipped prefix was capped —
+        #                              identity honestly disclaimed
+        self.prefix_truncated = False  # some attached prefix was
+        #                              capped: the settled stream must
+        #                              carry records_truncated
 
     def terminal(self) -> bool:
         return self.state in TERMINAL
@@ -410,12 +468,22 @@ class GatewayApi:
         gw.inbox.put(("submit", job_id))
         return 202, {"id": job_id, "state": "accepted"}
 
-    def job_view(self, job_id: str, with_records: bool = True):
+    def job_view(self, job_id: str, with_records: bool = True,
+                 with_snapshot: bool = False):
         with self._gw.jobs_lock:
             job = self._gw.jobs.get(job_id)
             if job is None:
                 return 404, {"error": f"unknown job {job_id!r}"}
-            return 200, job.view(with_records=with_records)
+            view = job.view(with_records=with_records)
+            if with_snapshot and job.snap is not None:
+                # protocol parity with the replica front: the gateway
+                # re-serves its cached snapshot, so a client (or a
+                # meta-gateway) can pull a warm start for a job even
+                # after its replica died
+                view["snapshot"] = job.snap
+                view["snapshot_records"] = list(job.snap_records)
+                view["snapshot_truncated"] = job.snap_truncated
+            return 200, view
 
     def jobs_view(self):
         """Bulk state-only view (protocol parity with the replica
@@ -438,8 +506,32 @@ class GatewayApi:
         gw.inbox.put(("cancel", job_id))
         return 202, {"id": job_id, "cancelling": True}
 
-    def accept_drain(self):
+    def accept_drain(self, mode: str = "graceful", replica=None):
         gw = self._gw
+        if mode not in ("graceful", "preempt"):
+            return 400, {"error": f"unknown drain mode {mode!r} "
+                                  f"(graceful | preempt)"}
+        if mode == "preempt" and replica is None:
+            # a gateway-wide preempt would strand every job (nothing
+            # left to resume ON); the supported form names the one
+            # replica being scaled down — refuse loudly rather than
+            # silently running the graceful full drain instead
+            return 400, {"error": "gateway preempt needs a target: "
+                                  "?mode=preempt&replica=NAME"}
+        if replica is not None:
+            # targeted scale-down: POST /v1/drain?mode=preempt&
+            # replica=NAME preempts ONE replica — it parks + ships
+            # every job it owns, the dispatcher resumes them
+            # elsewhere, and the fleet keeps serving (README "Fleet
+            # resume"). Only enqueue here (TT605); the dispatcher owns
+            # the outbound drain call.
+            if mode != "preempt":
+                return 400, {"error": "replica= drains require "
+                                      "mode=preempt"}
+            if gw.replicas.get(replica) is None:
+                return 404, {"error": f"unknown replica {replica!r}"}
+            gw.inbox.put(("preempt", replica))
+            return 202, {"preempting": replica}
         gw.draining = True
         gw.inbox.put(("drain",))
         with gw.jobs_lock:
@@ -500,6 +592,10 @@ class Gateway:
         # router's bucket spec — one parse, no drift
         serve_cfg = (parse_serve_args(cfg.serve_args)
                      if cfg.serve_args else ServeConfig())
+        # kept whole: the snapshot cache validates shipped snapshots
+        # against the fleet's (bucket, pop_size, seed) fingerprint —
+        # the same parse the workers run with, so it cannot drift
+        self.serve_cfg = serve_cfg
         self.spec = BucketSpec(
             event_floor=serve_cfg.bucket_events,
             room_floor=serve_cfg.bucket_rooms,
@@ -536,6 +632,12 @@ class Gateway:
                                lambda: self.now() - self._last_tick)
         self.registry.gauge("fleet.tick_stall_after").set(
             cfg.stall_after)
+        # snapshot cache accounting (README "Fleet resume"): live
+        # gauges so the resume story is on /metrics before any
+        # failover ever needs it
+        if cfg.snapshot_hwm > 0:
+            self.registry.gauge("fleet.resume.bytes").set(0.0)
+            self.registry.gauge("fleet.resume.cached").set(0.0)
         # SLO monitor (--slo-p99): rolling window of e2e latencies,
         # p99'd once per tick; transitions emit faultEntry records
         self._slo_lat = collections.deque(maxlen=cfg.slo_window)
@@ -593,6 +695,13 @@ class Gateway:
     def request_drain(self) -> None:
         self.draining = True
         self.inbox.put(("drain",))
+
+    def preempt_replica(self, name: str) -> None:
+        """Targeted lossless scale-down (README "Fleet resume"):
+        preempt ONE replica — it parks + ships every job it owns, the
+        dispatcher resumes them on the surviving fleet. Same path as
+        POST /v1/drain?mode=preempt&replica=NAME."""
+        self.inbox.put(("preempt", name))
 
     def close(self) -> None:
         self._stop = True
@@ -777,6 +886,18 @@ class Gateway:
             self.registry.gauge("serve.draining").set(1.0)
         elif kind == "failover":
             self._failover(cmd[1])
+        elif kind == "preempt":
+            # targeted scale-down: tell ONE replica to park + ship.
+            # The poll loop then sees its jobs turn `preempted`,
+            # refreshes their snapshots, and resumes them elsewhere —
+            # lossless scale-down (README "Fleet resume")
+            handle = self.replicas.get(cmd[1])
+            if handle is not None and not handle.dead:
+                try:
+                    handle.drain(timeout=self.cfg.probe_timeout,
+                                 mode="preempt")
+                except Exception:
+                    pass       # prober/failover own an unreachable one
         # "wake" and anything else: just a loop tick
 
     def _place(self, job: GatewayJob, exclude: tuple = ()) -> None:
@@ -947,10 +1068,27 @@ class Gateway:
                     changed += 1
                     continue
                 state = info.get("state")
+                if state == "preempted":
+                    # the replica parked + published this job and is
+                    # counting down its --preempt-grace: grab the
+                    # final snapshot NOW (best effort — a stale cached
+                    # one still resumes, just further back) and
+                    # re-place the job on the surviving fleet
+                    self._fetch_snapshot(job, handle, final=True)
+                    self._reassign(job)
+                    changed += 1
+                    continue
                 if not state or state not in TERMINAL:
                     if state and state != job.state:
                         job.state = state
                         changed += 1
+                    gens = info.get("gens")
+                    if (self.cfg.snapshot_hwm > 0 and gens is not None
+                            and int(gens) > job.snap_gens):
+                        # progress since the cached snapshot: refresh
+                        # the cache from the owner's latest park fence
+                        if self._fetch_snapshot(job, handle):
+                            changed += 1
                     continue
                 # the replica reports terminal — but the gateway view
                 # must not SAY so until the record tail is cached, or
@@ -970,12 +1108,143 @@ class Gateway:
                 truncated = bool(full.get("records_truncated"))
                 job.extra_polls += 1
                 if complete or truncated or job.extra_polls >= 50:
-                    job.records = records
+                    # a resumed job's stream = the accumulated prefix
+                    # (records of every previous incarnation through
+                    # its shipped fence) + this final incarnation's
+                    # tail — whole, duplicate-free (the restored
+                    # `emitted` floor), and identical to an
+                    # uninterrupted solve modulo timing/fault records.
+                    # EXCEPT when the replica REJECTED the attached
+                    # snapshot and demoted to a fresh replay (version
+                    # skew, foreign fingerprint on a static fleet, an
+                    # injected `resume` fault): its tail is then a
+                    # complete from-gen-0 stream — detectable by the
+                    # `admitted` jobEntry a resumed continuation never
+                    # re-emits — and prepending the prefix would
+                    # duplicate it wholesale
+                    prefix = list(job.prefix)
+                    prefix_trunc = job.prefix_truncated
+                    if prefix and any(
+                            rec.get("jobEntry", {}).get("event")
+                            == "admitted" for rec in records):
+                        prefix = []
+                        prefix_trunc = False
+                        self.registry.counter(
+                            "fleet.resume.demoted").inc()
+                    job.records = prefix + records
                     job.state = state
-                    job.records_truncated = truncated or not complete
+                    job.records_truncated = (truncated or not complete
+                                             or prefix_trunc)
                     self._settle(job)
                     changed += 1
         return changed
+
+    # -- the snapshot cache: resume, don't replay -----------------------
+
+    def _fetch_snapshot(self, job: GatewayJob, handle,
+                        final: bool = False) -> bool:
+        """Refresh one in-flight job's cached ship unit from its
+        owner (`?snapshot=1` — dispatcher thread, data-plane timeout).
+        Only a FINGERPRINT-VALID snapshot (bucket + pop size + seed,
+        verified stdlib-only via serve/snapshot.verify_wire) enters
+        the cache; anything else counts `fleet.resume.rejected` and
+        the job keeps its previous snapshot (or falls back to replay
+        at failover). `final` marks the preempt-drain grab — fetch
+        errors there are expected when the grace deadline races us."""
+        if self.cfg.snapshot_hwm <= 0:
+            return False
+        try:
+            # --snapshot-timeout, NOT --io-timeout: this runs on the
+            # one dispatcher thread and is an optimization — a hung
+            # replica export must cost seconds, not a 30 s io budget
+            # times its in-flight jobs (which would starve routing/
+            # polling/failover and trip the dispatcher_stalled
+            # watchdog); a failed fetch keeps the previous cache
+            view = handle.get_job(
+                job.id, timeout=self.cfg.snapshot_timeout,
+                with_records=False, snapshot=True)
+        except Exception:
+            self.registry.counter("fleet.resume.fetch_errors").inc()
+            return False
+        wire = view.get("snapshot")
+        if not wire:
+            return False
+        self.registry.counter("fleet.resume.fetches").inc()
+        try:
+            # full fingerprint pre-validation only when the gateway
+            # OWNS the worker flags (`--spawn N -- ...` — then its
+            # parsed serve config IS the workers', no drift possible);
+            # a static `--replica URL` fleet's serve config is not the
+            # gateway's to know, so the check there is structural
+            # (version/CRC/byte-count) + bucket consistency, and the
+            # REPLICA's resume admission stays the authoritative
+            # fingerprint gate either way (a bad snapshot demotes to
+            # replay on arrival, never corrupts a stream)
+            expect = None
+            if self.cfg.serve_args:
+                seed = int((job.payload or {}).get(
+                    "seed", self.serve_cfg.seed))
+                expect = snapshot_mod.wire_fingerprint(
+                    job.bucket, self.serve_cfg.pop_size, seed)
+            snapshot_mod.verify_wire(wire, expect_fingerprint=expect)
+            if (job.bucket is not None
+                    and list(wire.get("bucket", ()))
+                    != list(job.bucket)):
+                raise snapshot_mod.SnapshotMismatch(
+                    f"snapshot bucket {wire.get('bucket')} != routed "
+                    f"bucket {list(job.bucket)}")
+        except Exception as e:
+            self.registry.counter("fleet.resume.rejected").inc()
+            self._rec(jsonl.fault_entry, self.writer, "snapshot_ship",
+                      "reject", e, 0, 0, 0, self.tracer.now(),
+                      job=job.id)
+            return False
+        gens = int(wire.get("gens_done", 0))
+        if not final and gens < job.snap_gens:
+            return False               # never replace newer with older
+        records = list(view.get("snapshot_records") or ())
+        # the replica declares the prefix's byte size (it computed it
+        # once, on its handler); the fallback re-measure covers a
+        # mixed-version fleet
+        rec_bytes = view.get("snapshot_records_bytes")
+        if rec_bytes is None:
+            rec_bytes = sum(len(json.dumps(r)) for r in records)
+        # the (snap, snap_records, ...) tuple is read by job_view
+        # handlers under jobs_lock: mutate it under the same lock so a
+        # client can never see fence N's snapshot with fence N+1's
+        # records (the replica-side ShipUnit consistency, kept here)
+        with self.jobs_lock:
+            job.snap = wire
+            job.snap_records = records
+            job.snap_gens = gens
+            job.snap_truncated = bool(view.get("snapshot_truncated"))
+            job.snap_bytes = int(wire.get("bytes", 0)) + int(rec_bytes)
+        self._evict_snapshots()
+        return True
+
+    def _evict_snapshots(self) -> None:
+        """Hold the cache under `--snapshot-hwm`: evict OLDEST-
+        PROGRESS first (the snapshot whose loss wastes the least
+        re-run). An evicted job fails over by replay — counted, never
+        silent (`fleet.resume.evictions`; the jobs fall into
+        `fleet.resume.replays` if their failover comes)."""
+        with self.jobs_lock:
+            cached = [j for j in self.jobs.values()
+                      if j.snap is not None]
+            total = sum(j.snap_bytes for j in cached)
+            while total > self.cfg.snapshot_hwm and cached:
+                victim = min(cached, key=lambda j: (j.snap_gens,
+                                                    j.submitted_t))
+                cached.remove(victim)
+                total -= victim.snap_bytes
+                victim.snap = None
+                victim.snap_records = []
+                victim.snap_bytes = 0
+                victim.snap_gens = 0
+                self.registry.counter("fleet.resume.evictions").inc()
+        self.registry.gauge("fleet.resume.bytes").set(float(total))
+        self.registry.gauge("fleet.resume.cached").set(
+            float(len(cached)))
 
     def _on_death(self, handle, respawned: bool) -> None:
         """ReplicaSet prober callback (PROBER thread): only enqueue —
@@ -1009,14 +1278,57 @@ class Gateway:
                 self._reassign(job)
 
     def _reassign(self, job: GatewayJob) -> None:
-        """One job's failover: discard the lost copy's partial
-        records and replay the payload through a fresh routing — or
-        honor a pending cancel (the replica that would have solved
-        the rest is gone anyway)."""
+        """One job's failover or preemption re-placement: RESUME when
+        a fingerprint-valid snapshot is cached — the payload resends
+        with the wire snapshot attached, the new replica admits it
+        parked at the shipped progress, and the shipped record prefix
+        joins this job's accumulated `prefix` so the settled stream is
+        whole and duplicate-free (README "Fleet resume"). Without a
+        cached snapshot the job REPLAYS exactly as before — unless a
+        previously attached payload snapshot survives, which resumes
+        from that older fence (deterministic lanes re-emit the lost
+        middle identically, so the accumulated prefix stays valid).
+        A pending cancel is honored either way (the replica that
+        would have solved the rest is gone anyway)."""
         if job.cancel_requested:
             job.state = "cancelled"
             self._settle(job)
             return
+        if job.snap is not None:
+            # resume: consume the cached unit into payload + prefix
+            # (under jobs_lock — job_view handlers read these fields).
+            # A ship unit whose records carry an `admitted` jobEntry
+            # came from an incarnation that REPLAYED from gen 0 (its
+            # own resume was demoted) — those records are a complete
+            # stream and REPLACE the accumulated prefix; appending
+            # would duplicate every record the replay re-emitted.
+            with self.jobs_lock:
+                job.payload = dict(job.payload, snapshot=job.snap)
+                fresh = any(
+                    rec.get("jobEntry", {}).get("event") == "admitted"
+                    for rec in job.snap_records)
+                job.prefix = (list(job.snap_records) if fresh
+                              else list(job.prefix)
+                              + list(job.snap_records))
+                job.prefix_truncated = (job.snap_truncated
+                                        if fresh
+                                        else job.prefix_truncated
+                                        or job.snap_truncated)
+                job.snap = None
+                job.snap_records = []
+                job.snap_bytes = 0
+                # snap_gens is kept: it is the new incarnation's
+                # starting progress — the fetch throttle's baseline
+            self._evict_snapshots()    # republish the byte gauges
+            self.registry.counter("fleet.resume.hits").inc()
+            self._rec(self.tracer.record, "resume", self.now(), 0.0,
+                      cat="fleet", job=job.id, flow=job.flow,
+                      gens=job.snap_gens)
+        elif (job.payload or {}).get("snapshot") is None:
+            job.snap_gens = 0
+            job.prefix = []
+            job.prefix_truncated = False
+            self.registry.counter("fleet.resume.replays").inc()
         job.records = []
         job.records_final = False
         job.records_truncated = False
@@ -1039,6 +1351,14 @@ class Gateway:
             job.finished_t = self.now()
         job.payload = None
         job.counts = None
+        job.prefix = []
+        if job.snap is not None:
+            # a settled job needs no warm start; drop its cache share
+            with self.jobs_lock:
+                job.snap = None
+                job.snap_records = []
+                job.snap_bytes = 0
+            self._evict_snapshots()    # republish the byte gauges
         if not job.counted:
             job.counted = True
             name = ("fleet.jobs_done" if job.state == "done"
@@ -1063,6 +1383,11 @@ class Gateway:
     def _fail(self, job: GatewayJob, reason: str) -> None:
         job.state = "failed"
         job.error = reason
+        if job.prefix and not job.records:
+            # what progress the dead incarnations did emit stays
+            # visible on the failed view (honest partial stream)
+            job.records = list(job.prefix)
+            job.records_truncated = True
         self._settle(job)
 
     def _drain_tick(self) -> None:

@@ -286,7 +286,8 @@ class ReplicaApi:
         r.inbox.put(("submit", job_id, dict(payload, id=job_id), flow))
         return 202, {"id": job_id, "state": "accepted"}
 
-    def job_view(self, job_id: str, with_records: bool = True):
+    def job_view(self, job_id: str, with_records: bool = True,
+                 with_snapshot: bool = False):
         r = self._r
         try:
             job = r.svc.queue.get(job_id)
@@ -309,6 +310,33 @@ class ReplicaApi:
             # skips it and fetches the tail once, at terminal
             view["records"] = r.tail.tail(job_id)
             view["records_truncated"] = r.tail.truncated(job_id)
+        if with_snapshot and job is not None:
+            # `?snapshot=1`: publish the job's latest park-fence ship
+            # unit (serve/snapshot.py ShipUnit — one consistent
+            # state+record-prefix pair the drive loop replaced
+            # wholesale). The expensive npz pack runs HERE, on this
+            # handler thread (memoized per fence): fault site
+            # `snapshot_ship` — a hang parks this one handler, the
+            # drive loop and writer never wait; a die is absorbed as a
+            # dropped connection, exactly like the `scrape` site
+            ship = job.ship
+            if ship is not None:
+                try:
+                    faults.maybe_fail("snapshot_ship")
+                    view["snapshot"] = ship.pack()
+                except SystemExit:
+                    return None, None        # drop the connection
+                view["snapshot_records"] = list(ship.records)
+                if ship.records_bytes is None:
+                    # measured once per fence (memoized on the unit,
+                    # handler thread): the gateway budgets its cache
+                    # on this number instead of re-serializing the
+                    # prefix on its dispatcher at every refresh
+                    ship.records_bytes = sum(
+                        len(json.dumps(r)) for r in ship.records)
+                view["snapshot_records_bytes"] = ship.records_bytes
+                view["snapshot_truncated"] = bool(ship.truncated)
+                ship.served = True           # preempt drain's signal
         return 200, view
 
     def jobs_view(self):
@@ -341,10 +369,14 @@ class ReplicaApi:
         r.inbox.put(("cancel", job_id))
         return 202, {"id": job_id, "cancelling": True}
 
-    def accept_drain(self):
+    def accept_drain(self, mode: str = "graceful", replica=None):
+        del replica                     # gateway-only selector
+        if mode not in ("graceful", "preempt"):
+            return 400, {"error": f"unknown drain mode {mode!r} "
+                                  f"(graceful | preempt)"}
         r = self._r
-        r.inbox.put(("drain",))
-        return 200, {"draining": True,
+        r.inbox.put(("drain", mode))
+        return 200, {"draining": True, "mode": mode,
                      "active": len(r.svc.queue.active())}
 
     def fleet_view(self):
@@ -396,6 +428,9 @@ class Replica:
         self.index_lock = threading.Lock()
         self.auto_id = itertools.count(1)
         self.draining = False
+        self._preempting = False     # preempt drain: park + ship, do
+        #                              NOT run the queue dry
+        self._preempt_deadline = None
         self._reaped: list = []      # terminal ids, oldest first —
         #                              heavy refs released, then
         #                              forgotten beyond TAIL_JOBS
@@ -471,7 +506,13 @@ class Replica:
             while not self._killed:
                 try:
                     if self._signal_drain and not self.draining:
-                        self._set_draining()
+                        # "preempt" = spot worker SIGTERM under
+                        # --preempt-on-term: park + ship, don't run
+                        # the queue dry
+                        if self._signal_drain == "preempt":
+                            self._preempt()
+                        else:
+                            self._set_draining()
                     try:
                         cmd = self.inbox.get_nowait()
                     except queue_mod.Empty:
@@ -480,7 +521,8 @@ class Replica:
                         self._handle(cmd)
                         continue
                     if self.draining and not self.svc.queue.active():
-                        break
+                        if not self._preempting or self._shipped():
+                            break
                     busy = False
                     if self.svc.queue.ready():
                         busy = bool(self.svc.step())
@@ -520,7 +562,8 @@ class Replica:
                     seed=payload.get("seed"),
                     generations=payload.get("generations"),
                     deadline_s=payload.get("deadline"),
-                    flow=flow)
+                    flow=flow,
+                    snapshot=payload.get("snapshot"))
                 with self.index_lock:
                     self.index.pop(job_id, None)
             except Exception as e:
@@ -539,8 +582,52 @@ class Replica:
         elif kind == "cancel":
             self.svc.cancel(cmd[1])
         elif kind == "drain":
-            self._set_draining()
+            mode = cmd[1] if len(cmd) > 1 else "graceful"
+            if mode == "preempt":
+                self._preempt()
+            else:
+                self._set_draining()
         # "wake": loop tick only
+
+    # -- preempt drain (README "Fleet resume") --------------------------
+
+    def _preempt(self) -> None:
+        """Cooperative preemption (POST /v1/drain?mode=preempt, or
+        SIGTERM under --preempt-on-term): every active job is PARKED
+        where it stands and marked `preempted` — a state the gateway
+        reads as "resume me elsewhere" — and the front stays up
+        serving `?snapshot=1` until every preempted job's ship unit
+        has been fetched or `--preempt-grace` expires; then the loop
+        exits and the service closes (the writer drains, so the
+        `preempted` jobEntries and everything before them reach the
+        durable log). Scale-down and spot preemption thereby lose at
+        most the in-flight quantum — usually nothing, since _handle
+        runs between quanta, when every job is at a park fence."""
+        self._set_draining()
+        if self._preempting:
+            return
+        self._preempting = True
+        self._preempt_deadline = (time.monotonic()
+                                  + self.cfg.preempt_grace)
+        from timetabling_ga_tpu.serve.queue import JobState
+        for job in list(self.svc.queue.active()):
+            job.state = JobState.PREEMPTED
+            jsonl.job_entry(self.svc.writer, job.id, "preempted",
+                            gens=job.gens_done,
+                            shipped=job.ship is not None)
+            self.svc.registry.counter("serve.jobs_preempted").inc()
+
+    def _shipped(self) -> bool:
+        """True when the preempt drain may exit: every preempted job's
+        ship unit was fetched at least once, or the grace deadline
+        passed (a spot preemption waits for nobody)."""
+        if (self._preempt_deadline is not None
+                and time.monotonic() >= self._preempt_deadline):
+            return True
+        from timetabling_ga_tpu.serve.queue import JobState
+        return all(job.ship is None or job.ship.served
+                   for job in self.svc.queue._jobs.values()
+                   if job.state == JobState.PREEMPTED)
 
     def _reap_terminal(self) -> None:
         """Release terminal jobs' heavy references — the padded
@@ -557,6 +644,8 @@ class Replica:
                 job.padded = None
                 job.problem = None
                 job.snapshot = None
+                job.ship = None
+                job.ship_records = []
                 self._reaped.append(job.id)
         while len(self._reaped) > TAIL_JOBS:
             self.svc.queue.forget(self._reaped.pop(0))
@@ -582,8 +671,15 @@ def serve_http(cfg: ServeConfig) -> int:
     def _drain(signum, frame):
         # lock-free by design: the handler interrupts the drive loop's
         # own thread, so queue/registry locks here could self-deadlock;
-        # the loop reads the flag at its next iteration
-        replica._signal_drain = True
+        # the loop reads the flag at its next iteration. SIGTERM on a
+        # spot worker launched with --preempt-on-term maps to the
+        # PREEMPT drain: park + ship every job within --preempt-grace
+        # instead of running the queue dry the preemption won't wait
+        # for (README "Fleet resume")
+        if signum == signal.SIGTERM and cfg.preempt_on_term:
+            replica._signal_drain = "preempt"
+        else:
+            replica._signal_drain = True
 
     signal.signal(signal.SIGTERM, _drain)
     signal.signal(signal.SIGINT, _drain)
@@ -703,8 +799,15 @@ class ReplicaHandle:
                          timeout=timeout, ok=(200,)).get("jobs", {})
 
     def get_job(self, job_id: str, timeout: float = 5.0,
-                with_records: bool = True):
-        suffix = "" if with_records else "?records=0"
+                with_records: bool = True, snapshot: bool = False):
+        params = []
+        if not with_records:
+            params.append("records=0")
+        if snapshot:
+            # ?snapshot=1: the replica's latest park-fence ship unit
+            # (wire snapshot + its exact record prefix) rides the view
+            params.append("snapshot=1")
+        suffix = "?" + "&".join(params) if params else ""
         return http_json(
             "GET",
             f"{self.url}/v1/jobs/{urllib.parse.quote(job_id)}"
@@ -717,8 +820,9 @@ class ReplicaHandle:
             f"{self.url}/v1/jobs/{urllib.parse.quote(job_id)}",
             timeout=timeout, ok=(200, 202, 404, 409))
 
-    def drain(self, timeout: float = 5.0):
-        return http_json("POST", self.url + "/v1/drain", {},
+    def drain(self, timeout: float = 5.0, mode: str = "graceful"):
+        suffix = f"?mode={mode}" if mode != "graceful" else ""
+        return http_json("POST", self.url + "/v1/drain" + suffix, {},
                          timeout=timeout, ok=(200,))
 
     # -- process management --------------------------------------------

@@ -16,6 +16,10 @@ BREAKDOWN — where each job's latency went:
   routed      the fleet gateway's placement leg (admit-at-gateway →
               accepted-by-replica: the `routed` span a gateway log
               carries per placed job — fleet/gateway.py, tt-obs v5)
+  recovered   warm-start snapshot admission on a RESUMED job (the
+              fleet-resume seam, serve/scheduler._admit_resumed):
+              what a failed-over or preempted job paid to not replay
+              — only present for resumed jobs
   packed      pack / resume / park spans it rode (the per-quantum
               host-side cost of the park/resume serving model)
   executing   its quantum spans (device time advancing the job)
@@ -58,6 +62,11 @@ def _key(proc_id, job):
 _EXEC_SPANS = ("quantum",)
 _PACKED_SPANS = ("pack", "resume", "park")   # init nests inside pack
 _ROUTED_SPANS = ("routed",)                  # gateway admit→placed
+_RECOVERED_SPANS = ("recover",)              # warm-start snapshot
+#                                              admission on a resumed
+#                                              job (the fleet-resume
+#                                              seam, serve/scheduler
+#                                              _admit_resumed)
 
 
 def _pctl(vals, q):
@@ -130,7 +139,11 @@ def _job_breakdown(spans) -> dict:
         packed = tally(_PACKED_SPANS)
         routed = tally(_ROUTED_SPANS, ss)   # the gateway leg: every
         #                                     placement round, summed
-        work = _EXEC_SPANS + _PACKED_SPANS \
+        recovered = tally(_RECOVERED_SPANS)  # snapshot unpack +
+        #                                      rehydrate on resume —
+        #                                      what a failed-over job
+        #                                      paid to NOT replay
+        work = _EXEC_SPANS + _PACKED_SPANS + _RECOVERED_SPANS \
             + (_ROUTED_SPANS if in_window else ())
         first_work = min(
             (float(s.get("ts", 0.0)) for s in base
@@ -138,11 +151,13 @@ def _job_breakdown(spans) -> dict:
         queued = max(0.0, first_work - t0)
         fin = tally(("finalize",))
         rest = max(0.0, base_total - queued - packed - executing
-                   - fin - (routed if in_window else 0.0))
+                   - recovered - fin
+                   - (routed if in_window else 0.0))
         total = base_total if in_window else base_total + routed
         out[jid] = {"total": total, "queued": queued,
-                    "routed": routed, "packed": packed,
-                    "executing": executing, "parked": rest}
+                    "routed": routed, "recovered": recovered,
+                    "packed": packed, "executing": executing,
+                    "parked": rest}
     return out
 
 
@@ -263,19 +278,25 @@ def summarize(records) -> str:
         # the `routed` column only appears when some job actually has
         # a gateway placement span — plain serve logs keep the old shape
         with_routed = any(b["routed"] > 0 for b in breakdown.values())
+        # likewise `recovered`: only resumed jobs (fleet failover /
+        # preemption) carry the snapshot-admission span
+        with_rec = any(b["recovered"] > 0 for b in breakdown.values())
         lines.append(f"== job latency breakdown ({len(breakdown)} "
                      f"jobs, from spans)")
         for jid, b in breakdown.items():
             routed_s = (f"routed {b['routed']:.2f} + "
                         if with_routed else "")
+            rec_s = (f"recovered {b['recovered']:.2f} + "
+                     if with_rec else "")
             lines.append(
                 f"  {jid}: total {b['total']:.2f}s = "
-                f"queued {b['queued']:.2f} + {routed_s}"
+                f"queued {b['queued']:.2f} + {routed_s}{rec_s}"
                 f"packed {b['packed']:.2f} "
                 f"+ executing {b['executing']:.2f} "
                 f"+ parked {b['parked']:.2f}")
         comps = ("total", "queued") \
             + (("routed",) if with_routed else ()) \
+            + (("recovered",) if with_rec else ()) \
             + ("packed", "executing", "parked")
         for comp in comps:
             vals = sorted(b[comp] for b in breakdown.values())

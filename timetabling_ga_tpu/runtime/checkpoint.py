@@ -152,6 +152,12 @@ def save(path: str, state: ga.PopState, key, generation: int,
 _CORRUPT_ERRORS = (zipfile.BadZipFile, zlib.error, ValueError, EOFError,
                    KeyError)
 
+# public alias: the per-JOB snapshot wire format (serve/snapshot.py —
+# the job-granular analogue of this module) classifies a torn npz
+# payload with the same error set, so what counts as "damaged on the
+# wire" can never drift from what counts as "damaged on disk"
+CORRUPT_ERRORS = _CORRUPT_ERRORS
+
 
 def _load_one(path: str, fingerprint: str):
     with np.load(path, allow_pickle=False) as z:

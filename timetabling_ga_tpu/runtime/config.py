@@ -701,8 +701,29 @@ class ServeConfig:
     faults: Optional[str] = None  # deterministic fault-injection plan
     #                               (runtime/faults.py grammar — the
     #                               serve-relevant sites are writer,
-    #                               obs_listen, scrape); None reads
+    #                               obs_listen, scrape, quantum,
+    #                               snapshot_ship, resume); None reads
     #                               $TT_FAULTS, like the engine
+    # ---- serve-path fault recovery + fleet resume (serve/snapshot.py,
+    # README "Fleet resume"):
+    max_job_recoveries: int = 2   # quantum-fault requeues PER JOB
+    #                               before the job fails alone with a
+    #                               terminal jobEntry (the engine's
+    #                               --max-recoveries, at job
+    #                               granularity; 0 = any quantum fault
+    #                               fails its dispatch's jobs)
+    preempt_grace: float = 10.0   # preempt-drain ship deadline: after
+    #                               POST /v1/drain?mode=preempt (or
+    #                               SIGTERM with --preempt-on-term)
+    #                               the replica parks + publishes
+    #                               every active job's snapshot and
+    #                               stays up at most this many seconds
+    #                               waiting for them to be fetched —
+    #                               then exits regardless (a spot
+    #                               preemption waits for nobody)
+    preempt_on_term: bool = False  # map SIGTERM to the PREEMPT drain
+    #                               (spot/preemptible workers: park +
+    #                               ship, don't run the queue dry)
     # ---- fleet front (timetabling_ga_tpu/fleet; README "Fleet"):
     http: Optional[str] = None    # HOST:PORT of the HTTP solve front
     #                               (fleet/replicas.py serve_http): the
@@ -745,9 +766,12 @@ _SERVE_FLAG_MAP = {
     "--shed-writer-hwm": ("shed_writer_hwm", int),
     "--faults": ("faults", str),
     "--http": ("http", str),
+    "--max-job-recoveries": ("max_job_recoveries", int),
+    "--preempt-grace": ("preempt_grace", float),
 }
 
-_SERVE_BOOL_FLAGS = {"--obs": "obs", "--quality": "quality"}
+_SERVE_BOOL_FLAGS = {"--obs": "obs", "--quality": "quality",
+                     "--preempt-on-term": "preempt_on_term"}
 
 
 def _serve_usage() -> str:
@@ -780,6 +804,11 @@ def parse_serve_args(argv) -> ServeConfig:
     if cfg.shed_queue_hwm < 0 or cfg.shed_writer_hwm < 0:
         raise SystemExit("--shed-queue-hwm / --shed-writer-hwm must be "
                          ">= 0 (0 disables that shed trigger)")
+    if cfg.max_job_recoveries < 0:
+        raise SystemExit("--max-job-recoveries must be >= 0 requeues "
+                         "per job")
+    if cfg.preempt_grace < 0:
+        raise SystemExit("--preempt-grace must be >= 0 seconds")
     if cfg.lanes < 1:
         raise SystemExit("--lanes must be >= 1")
     if cfg.quantum < 1:
@@ -861,6 +890,35 @@ class FleetConfig:
     #                                  (runtime/retry.py schedule)
     retry_wait_s: float = 0.2        # base wait of that schedule
     backlog: int = 256               # gateway job-table admission bound
+    snapshot_timeout: float = 5.0    # per-fetch HTTP budget for the
+    #                                  ?snapshot=1 cache refreshes:
+    #                                  they run on the ONE dispatcher
+    #                                  thread and are an OPTIMIZATION
+    #                                  (a failed fetch keeps the
+    #                                  previous cache; failover just
+    #                                  resumes further back), so they
+    #                                  get a budget far under
+    #                                  --io-timeout — one hung
+    #                                  replica's export must not eat
+    #                                  the fleet's routing/poll/
+    #                                  failover tick or trip the
+    #                                  dispatcher_stalled watchdog
+    snapshot_hwm: int = 256 * 1024 * 1024
+    #                                  byte budget for the dispatcher's
+    #                                  per-job snapshot cache (README
+    #                                  "Fleet resume"): at every park
+    #                                  fence the owning replica
+    #                                  publishes the job's latest wire
+    #                                  snapshot (?snapshot=1) and the
+    #                                  gateway caches the newest
+    #                                  fingerprint-valid one; over the
+    #                                  budget the OLDEST-PROGRESS
+    #                                  snapshots are evicted first
+    #                                  (losing them wastes the least
+    #                                  re-run). Evicted or uncached
+    #                                  jobs fail over by replay, as
+    #                                  before. 0 disables caching —
+    #                                  failover is pure replay
     faults: Optional[str] = None     # fault plan (gateway/route/
     #                                  gw_writer/gw_scrape sites)
     # ---- fleet observability (tt-obs v5, README "Fleet
@@ -922,6 +980,8 @@ _FLEET_FLAG_MAP = {
     "--route-retries": ("route_retries", int),
     "--retry-wait": ("retry_wait_s", float),
     "--backlog": ("backlog", int),
+    "--snapshot-hwm": ("snapshot_hwm", int),
+    "--snapshot-timeout": ("snapshot_timeout", float),
     "--faults": ("faults", str),
 }
 
@@ -991,6 +1051,12 @@ def parse_fleet_args(argv) -> FleetConfig:
         raise SystemExit("--retry-wait must be > 0 seconds")
     if cfg.backlog < 1:
         raise SystemExit("--backlog must be >= 1")
+    if cfg.snapshot_hwm < 0:
+        raise SystemExit("--snapshot-hwm must be >= 0 bytes (0 "
+                         "disables the snapshot cache: failover "
+                         "replays from generation 0)")
+    if cfg.snapshot_timeout <= 0:
+        raise SystemExit("--snapshot-timeout must be > 0 seconds")
     if cfg.metrics_every < 0:
         raise SystemExit("--metrics-every must be >= 0 dispatcher "
                          "ticks (0 = only the final snapshot)")

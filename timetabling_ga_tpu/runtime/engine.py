@@ -541,6 +541,16 @@ def _purge_programs(mesh) -> None:
             del cache[k]
 
 
+def purge_programs(mesh) -> None:
+    """Public program purge for the serve-path fault recovery
+    (serve/scheduler.py _recover_quantum): the per-job analogue of the
+    run supervisor applies the same rule — after a transient device
+    failure, every compiled program bound to the mesh (including the
+    cached lane runners/inits) may reference poisoned state and is
+    rebuilt on the next dispatch."""
+    _purge_programs(mesh)
+
+
 @dataclasses.dataclass
 class _Snapshot:
     """Rolling in-memory host snapshot of the last control-fenced run

@@ -56,10 +56,17 @@ tests/test_fleet.py pins that a wedged gateway never stalls replica
 dispatch or writer drain), `gw_writer` (the gateway's OWN telemetry
 AsyncWriter worker, once per dequeued item — a dead gateway log
 writer must never stall the dispatcher or job settlement; the gateway
-disables its obs emission and routes on) and `gw_scrape` (once per
+disables its obs emission and routes on), `gw_scrape` (once per
 replica /metrics scrape on the prober thread — a hung scrape parks
 only the prober; routing continues on the last-probed gauges and job
-settlement never waits on it; tests/test_fleet_obs.py pins both).
+settlement never waits on it; tests/test_fleet_obs.py pins both),
+`quantum` (once per stacked serve lane dispatch — the serve-path
+fault-recovery window: affected jobs requeue from their park
+snapshots), `snapshot_ship` (once per `?snapshot=1` export on a
+replica handler thread — a hung export parks one handler, never the
+drive loop or writer) and `resume` (once per warm-start snapshot
+admission — any failure falls back to a fresh replay;
+tests/test_resume.py pins the triad).
 
 The plan is installed per engine.run call (`install`), which resets the
 per-site counters — invocation indices are deterministic within one
@@ -110,9 +117,26 @@ ACTIONS = ("unavailable", "hang", "die", "truncate", "error")
 # gateway writer disables obs emission and the dispatcher routes on; a
 # hung scrape parks only the prober — job settlement never waits on
 # either (tests/test_fleet_obs.py pins it).
+# The resume triad (tests/test_resume.py pins all three):
+# `quantum` fires once per stacked serve lane dispatch on the drive
+# loop (serve/scheduler.py _advance) — the serve-path fault-recovery
+# window: a transient there requeues ONLY the dispatch's jobs from
+# their park snapshots (supervisor classify/rehydrate at job
+# granularity) while co-tenant jobs and the writer run on untouched.
+# `snapshot_ship` fires once per snapshot export (the `?snapshot=1`
+# pack on a replica HTTP handler thread, fleet/replicas.py): a hang
+# parks that one handler thread — the gateway's fetch times out and
+# routing, the drive loop and writer drain never wait on it; a die is
+# absorbed as a dropped connection like the `scrape` site's.
+# `resume` fires once per warm-start snapshot admission on the drive
+# loop (serve/scheduler.py _admit_resumed): any failure there —
+# including an injected die — falls back to a fresh solve (replay)
+# with a faultEntry, so a poisoned snapshot can reject, never stall,
+# the service.
 SITES = ("dispatch", "fetch", "writer", "ckpt", "init", "obs_listen",
          "scrape", "mem_poll", "profile", "gateway", "route",
-         "gw_writer", "gw_scrape")
+         "gw_writer", "gw_scrape", "quantum", "snapshot_ship",
+         "resume")
 
 
 class FaultInjected(Exception):

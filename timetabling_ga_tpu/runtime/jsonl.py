@@ -39,9 +39,15 @@ from typing import IO, List, Optional
 from timetabling_ga_tpu.runtime import faults
 
 
-def _write(stream: IO, obj: dict) -> None:
+def _write(stream: IO, obj: dict) -> dict:
     stream.write(json.dumps(obj, separators=(",", ":")) + "\n")
     stream.flush()
+    # the record is returned through every emitter below so a caller
+    # that must MIRROR its own stream — the serve scheduler's per-job
+    # ship units (serve/snapshot.py), which ride a snapshot across
+    # processes together with the exact records emitted up to its
+    # fence — can capture the dict it just wrote without rebuilding it
+    return obj
 
 
 class AsyncWriter:
@@ -263,7 +269,7 @@ def reported_best(hcv: int, scv: int) -> int:
 
 
 def log_entry(stream: IO, proc_id: int, thread_id: int, best: int,
-              time_s: float, job: Optional[str] = None) -> None:
+              time_s: float, job: Optional[str] = None) -> dict:
     rec = {
         "procID": proc_id,
         "threadID": thread_id,
@@ -276,14 +282,14 @@ def log_entry(stream: IO, proc_id: int, thread_id: int, best: int,
         # demultiplexes per tenant. Absent on single-run streams — the
         # reference protocol's records stay byte-identical there.
         rec["job"] = str(job)
-    _write(stream, {"logEntry": rec})
+    return _write(stream, {"logEntry": rec})
 
 
 def solution_record(stream: IO, proc_id: int, thread_id: int,
                     total_time: float, total_best: int, feasible: bool,
                     timeslots: Optional[List[int]] = None,
                     rooms: Optional[List[int]] = None,
-                    job: Optional[str] = None) -> None:
+                    job: Optional[str] = None) -> dict:
     rec = {
         "procID": proc_id,
         "threadID": thread_id,
@@ -296,10 +302,10 @@ def solution_record(stream: IO, proc_id: int, thread_id: int,
         rec["rooms"] = [int(x) for x in rooms]
     if job is not None:
         rec["job"] = str(job)
-    _write(stream, {"solution": rec})
+    return _write(stream, {"solution": rec})
 
 
-def job_entry(stream: IO, job: str, event: str, **extra) -> None:
+def job_entry(stream: IO, job: str, event: str, **extra) -> dict:
     """Serving EXTENSION record (not in the reference protocol): one
     line per job lifecycle transition on the service stream —
 
@@ -314,12 +320,12 @@ def job_entry(stream: IO, job: str, event: str, **extra) -> None:
     rec = {"job": str(job), "event": str(event)}
     for k, v in extra.items():
         rec[k] = v
-    _write(stream, {"jobEntry": rec})
+    return _write(stream, {"jobEntry": rec})
 
 
 def fault_entry(stream: IO, site: str, action: str, error, trial: int,
                 recovery: int, level: int, time_s: float,
-                **extra) -> None:
+                **extra) -> dict:
     """Robustness EXTENSION record (not in the reference protocol;
     always emitted — a recovery changes the run's trust story, so it
     must be visible without --trace). One line per supervisor event:
@@ -341,7 +347,7 @@ def fault_entry(stream: IO, site: str, action: str, error, trial: int,
            "time": max(0.0, float(time_s))}
     for k, v in extra.items():
         rec[k] = v
-    _write(stream, {"faultEntry": rec})
+    return _write(stream, {"faultEntry": rec})
 
 
 def span_entry(stream: IO, name: str, cat: str, ts: float, dur: float,
@@ -515,7 +521,7 @@ def run_entry(stream: IO, total_best: int, feasible: bool,
               procs_num: Optional[int] = None,
               threads_num: Optional[int] = None,
               total_time: Optional[float] = None,
-              job: Optional[str] = None) -> None:
+              job: Optional[str] = None) -> dict:
     rec = {"totalBest": int(total_best), "feasible": bool(feasible)}
     if procs_num is not None:
         rec["procsNum"] = int(procs_num)
@@ -523,4 +529,4 @@ def run_entry(stream: IO, total_best: int, feasible: bool,
         rec["totalTime"] = float(total_time)
     if job is not None:
         rec["job"] = str(job)
-    _write(stream, {"runEntry": rec})
+    return _write(stream, {"runEntry": rec})

@@ -44,6 +44,14 @@ class JobState:
     #                       configured high-water mark — immediate,
     #                       visible load shedding instead of unbounded
     #                       latency for everyone
+    PREEMPTED = "preempted"  # released by a preempt drain (POST
+    #                       /v1/drain?mode=preempt, or SIGTERM on a
+    #                       spot worker with --preempt-on-term): the
+    #                       replica stops advancing the job and SHIPS
+    #                       its park snapshot instead — terminal for
+    #                       THIS replica, but the fleet gateway reads
+    #                       it as "resume me elsewhere", never as done
+    #                       (fleet/gateway.py _poll_replicas)
 
     ACTIVE = (PENDING, RUNNING, PARKED)
     TERMINAL = (DONE, FAILED, CANCELLED, SHED)
@@ -83,6 +91,34 @@ class Job:
     #                                   job's life shares it, so
     #                                   `tt trace --job ID` renders one
     #                                   connected end-to-end timeline
+    # -- resume, don't replay (serve/snapshot.py; README "Fleet
+    # resume") -----------------------------------------------------------
+    resume_wire: object = None        # warm-start wire snapshot the
+    #                                   submit carried (a failover
+    #                                   resubmission, a preempted
+    #                                   job's re-placement, or a
+    #                                   client warm start): admitted
+    #                                   as a PARKED job, init skipped
+    resumed_at: int = 0               # gens_done restored at resume
+    #                                   admission (0 = fresh solve)
+    recoveries: int = 0               # quantum-fault requeues so far
+    #                                   (scheduler._recover_quantum);
+    #                                   over --max-job-recoveries the
+    #                                   job fails ALONE, co-tenants
+    #                                   untouched
+    ship: object = None               # latest park-fence ShipUnit
+    #                                   (host state + record prefix),
+    #                                   replaced wholesale at every
+    #                                   park — what ?snapshot=1 serves
+    ship_records: list = dataclasses.field(default_factory=list)
+    #                                   running mirror of THIS job's
+    #                                   emitted records (the prefix a
+    #                                   shipped snapshot carries so a
+    #                                   resumed stream is whole)
+    ship_truncated: bool = False      # the mirror hit its cap: a
+    #                                   resumed stream can no longer
+    #                                   claim identity (surfaced on
+    #                                   the wire, never silent)
 
     def runnable(self) -> bool:
         return self.state in (JobState.PENDING, JobState.RUNNING,
@@ -144,6 +180,7 @@ class JobQueue:
         job.state = JobState.CANCELLED
         job.finished_t = self._now()
         job.snapshot = None
+        job.ship = None
         return True
 
     def ready(self, bucket: Optional[tuple] = None) -> list[Job]:

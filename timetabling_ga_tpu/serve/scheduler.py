@@ -49,6 +49,7 @@ recovery item.
 
 from __future__ import annotations
 
+import collections
 import sys
 import time
 
@@ -59,9 +60,10 @@ from timetabling_ga_tpu.obs import quality as obs_quality
 from timetabling_ga_tpu.obs.spans import NULL_TRACER
 from timetabling_ga_tpu.ops import ga
 from timetabling_ga_tpu.parallel import islands
-from timetabling_ga_tpu.runtime import jsonl
+from timetabling_ga_tpu.runtime import faults, jsonl
 from timetabling_ga_tpu.runtime.config import ServeConfig
 from timetabling_ga_tpu.serve import bucket as bucket_mod
+from timetabling_ga_tpu.serve import snapshot as snapshot_mod
 from timetabling_ga_tpu.serve.queue import Job, JobQueue, JobState
 
 INT_MAX = 2 ** 31 - 1
@@ -159,16 +161,109 @@ class Scheduler:
         (the fleet gateway's X-TT-Flow header, threaded through
         SolveService.submit) keeps it: the replica-side spans then
         continue the gateway's cross-process chain instead of opening
-        a local one."""
+        a local one.
+
+        A job that arrived with a WARM-START snapshot (a failover
+        resubmission, a preempted job's re-placement, or a client warm
+        start — serve/snapshot.py) is admitted directly as a PARKED
+        job: init is skipped, the record stream continues from the
+        restored `emitted` floor (duplicate-free by the same floor
+        rule every park fence uses), and the only seam is a
+        `faultEntry site=fleet action=resume` — which strip_timing
+        drops, so the concatenated stream is identical to an
+        uninterrupted solve's. A snapshot that fails validation falls
+        back to a fresh solve (replay) with a faultEntry, never an
+        error: a poisoned snapshot may cost progress, not the job."""
         if not job.flow:
             job.flow = self.tracer.new_flow()
+        if job.resume_wire is not None and self._admit_resumed(job):
+            self._metrics.counter("serve.jobs_admitted").inc()
+            return
         with self.tracer.span("admit", cat="serve", job=job.id,
                               flow=job.flow):
-            jsonl.job_entry(self.out, job.id, "admitted",
-                            bucket=list(job.bucket),
-                            generations=job.generations,
-                            priority=job.priority)
+            self._ship_rec(job, jsonl.job_entry(
+                self.out, job.id, "admitted",
+                bucket=list(job.bucket),
+                generations=job.generations,
+                priority=job.priority))
         self._metrics.counter("serve.jobs_admitted").inc()
+
+    def _ship_rec(self, job: Job, rec: dict) -> None:
+        """Mirror one just-emitted record into the job's ship prefix
+        (the records a shipped snapshot travels with). Bounded ring
+        (the JobTail discipline — a deque, so the pathological
+        million-improvement stream costs O(1) per record on the drive
+        loop, not an O(cap) list shift): over the cap the OLDEST drop
+        and the unit is marked truncated — resume still works,
+        identity is honestly disclaimed."""
+        rs = job.ship_records
+        if not isinstance(rs, collections.deque):
+            rs = job.ship_records = collections.deque(
+                rs, maxlen=snapshot_mod.SHIP_RECORDS_CAP)
+        if len(rs) == rs.maxlen:
+            job.ship_truncated = True
+        rs.append(rec)
+
+    def _admit_resumed(self, job: Job) -> bool:
+        """Warm-start admission from `job.resume_wire`. True on
+        success (job is PARKED with restored progress); False falls
+        back to a fresh solve. Fault site `resume` fires here — ANY
+        failure, including an injected thread death, is absorbed into
+        the replay fallback so a bad snapshot can never stall the
+        drive loop or touch co-tenant jobs (tests/test_resume.py)."""
+        pop = self.cfg.pop_size
+        t0 = self._now()
+        wire, job.resume_wire = job.resume_wire, None
+        try:
+            faults.maybe_fail("resume")
+            expect = snapshot_mod.wire_fingerprint(job.bucket, pop,
+                                                   job.seed)
+            state, meta = snapshot_mod.unpack_state(
+                wire, expect_fingerprint=expect)
+            if tuple(state.slots.shape) != (pop,
+                                            job.padded.n_events):
+                raise snapshot_mod.SnapshotMismatch(
+                    f"snapshot population shape "
+                    f"{tuple(state.slots.shape)} != "
+                    f"({pop}, {job.padded.n_events}) for bucket "
+                    f"{job.bucket}")
+        except (KeyboardInterrupt,):
+            raise
+        except BaseException as e:
+            jsonl.fault_entry(self.out, "resume", "replay", e, 0, 0,
+                              0, self.tracer.now(), job=job.id)
+            self._metrics.counter("serve.jobs_resume_rejected").inc()
+            return False
+        job.snapshot = state
+        job.gens_done = meta["gens_done"]
+        job.chunks = meta["chunks"]
+        job.emitted = meta["emitted"]
+        job.best = meta["best"]
+        job.resumed_at = meta["gens_done"]
+        job.state = JobState.PARKED
+        # the resumed job ships again from admission: a preempt before
+        # its first local quantum re-ships the SAME snapshot (empty
+        # continuation prefix — the gateway accumulates prefixes)
+        job.ship = snapshot_mod.ShipUnit(
+            state=state, bucket=job.bucket, pop_size=pop,
+            seed=job.seed, gens_done=job.gens_done, chunks=job.chunks,
+            emitted=job.emitted, best=job.best, records=[],
+            wire=dict(wire))
+        # the seam: ONE faultEntry (strip_timing drops it — the
+        # resumed stream stays in the identity domain) + the
+        # `recover` span tt stats turns into the job's `recovered`
+        # latency component
+        jsonl.fault_entry(
+            self.out, "fleet", "resume",
+            f"resumed from shipped snapshot at gen "
+            f"{meta['gens_done']}", 0, 0, 0, self.tracer.now(),
+            job=job.id, gens=meta["gens_done"],
+            chunks=meta["chunks"])
+        self.tracer.record("recover", t0, self._now() - t0,
+                           cat="serve", job=job.id, flow=job.flow,
+                           gens=meta["gens_done"])
+        self._metrics.counter("serve.jobs_resumed").inc()
+        return True
 
     # -- backpressure ---------------------------------------------------
 
@@ -210,6 +305,8 @@ class Scheduler:
             job.finished_t = self._now()
             job.error = f"shed ({over})"
             job.snapshot = None
+            job.ship = None
+            job.ship_records = []
             with self.tracer.span("shed", cat="serve", job=job.id,
                                   flow=job.flow, reason=over):
                 jsonl.job_entry(self.out, job.id, "shed", reason=over,
@@ -294,21 +391,70 @@ class Scheduler:
                 chunks[lane] = job.chunks
                 gens[lane] = min(self.cfg.quantum, job.remaining())
 
+        self._dispatches += 1
+        self._metrics.counter("serve.dispatches").inc()
+        try:
+            self._advance(jobs, pa_stack, seeds, chunks, gens, Ep,
+                          jids, flows)
+            self._metrics.counter("serve.gens").inc(int(gens.sum()))
+        except Exception as e:
+            # serve-path fault recovery (README "Fleet resume"): the
+            # run supervisor's classify/rehydrate logic applied at JOB
+            # granularity — only this dispatch's jobs are touched
+            self._recover_quantum(jobs, e)
+        if self._profiler is not None:
+            self._profiler.on_dispatch()
+        if (self.cfg.obs and self.cfg.metrics_every > 0
+                and self._dispatches % self.cfg.metrics_every == 0):
+            jsonl.metrics_entry(self.out, self._metrics.snapshot(),
+                                ts=self.tracer.now())
+        return bool(self.queue.ready())
+
+    def _advance(self, jobs, pa_stack, seeds, chunks, gens, Ep,
+                 jids, flows) -> None:
+        """One resume → quantum → park cycle for an already-packed
+        group. On a dispatch/fetch fault the possibly-poisoned device
+        state is deleted HERE (islands.delete_state — donation may
+        already have consumed leaves; both are fine) and the error
+        re-raised — every job's park snapshot and host problem data
+        are untouched, so _recover_quantum requeues the group from
+        exactly where it stood. Fault site `quantum` fires once per
+        call, right before the lane dispatch."""
         from timetabling_ga_tpu.runtime import engine
+        try:
+            self._cycle(jobs, pa_stack, seeds, chunks, gens,
+                        Ep, jids, flows, engine)
+        except BaseException:
+            islands.delete_state(self._inflight)
+            raise
+        finally:
+            self._inflight = None
+
+    # the in-flight device state of the current _cycle, held on self so
+    # _advance can delete it when the cycle raises mid-dispatch
+    _inflight = None
+
+    def _cycle(self, jobs, pa_stack, seeds, chunks, gens, Ep,
+               jids, flows, engine) -> None:
+        lanes = self.cfg.lanes
+        pop = self.cfg.pop_size
         with self.tracer.span("resume", cat="serve", job=jids,
                               flow=flows):
             # parked host snapshots -> one stacked device placement
             host0 = _stack_states([j.snapshot for j in jobs], pop,
                                   lanes, Ep)
-            state = engine.reshard_state(host0, self.mesh)
+            state = self._inflight = engine.reshard_state(host0,
+                                                          self.mesh)
         with self.tracer.span("quantum", cat="device", job=jids,
                               flow=flows, gens=int(gens.sum())):
+            faults.maybe_fail("quantum")
             runner, _ = engine.cached_lane_runner(
                 self.mesh, self.gacfg, self.cfg.quantum, lanes,
                 donate=True, trace_mode=self.cfg.trace_mode,
                 quality=self.cfg.quality)
             tq0 = self._now()
             state, trace = runner(pa_stack, seeds, chunks, state, gens)
+            self._inflight = state
             trace = np.asarray(trace)   # (lanes, quantum, 2) | packed
             # live roofline for the serve path, same gauges and same
             # formula as the engine's (obs/cost.py owns it): the lane
@@ -378,9 +524,9 @@ class Scheduler:
                         job.best = rep
                     if rep < job.emitted:
                         job.emitted = rep
-                        jsonl.log_entry(self.out, 0, 0, rep,
-                                        now - job.submitted_t,
-                                        job=job.id)
+                        self._ship_rec(job, jsonl.log_entry(
+                            self.out, 0, 0, rep,
+                            now - job.submitted_t, job=job.id))
                 if q_dec is not None and self.cfg.obs:
                     jsonl.quality_entry(
                         self.out, obs_quality.lane_payload(q_dec, lane),
@@ -389,16 +535,68 @@ class Scheduler:
                 job.state = JobState.PARKED
                 if job.remaining() == 0:
                     self._finalize(job)
-        self._dispatches += 1
-        self._metrics.counter("serve.dispatches").inc()
-        self._metrics.counter("serve.gens").inc(int(gens.sum()))
-        if self._profiler is not None:
-            self._profiler.on_dispatch()
-        if (self.cfg.obs and self.cfg.metrics_every > 0
-                and self._dispatches % self.cfg.metrics_every == 0):
-            jsonl.metrics_entry(self.out, self._metrics.snapshot(),
-                                ts=self.tracer.now())
-        return bool(self.queue.ready())
+                else:
+                    # the park fence IS the ship fence (README "Fleet
+                    # resume"): replace the job's shippable unit
+                    # wholesale — state + the exact record prefix
+                    # through this fence, one consistent pair for any
+                    # handler thread serving ?snapshot=1
+                    job.ship = snapshot_mod.ShipUnit(
+                        state=job.snapshot, bucket=job.bucket,
+                        pop_size=pop, seed=job.seed,
+                        gens_done=job.gens_done, chunks=job.chunks,
+                        emitted=job.emitted, best=job.best,
+                        records=list(job.ship_records),
+                        truncated=job.ship_truncated)
+
+    def _recover_quantum(self, jobs, exc) -> None:
+        """Serve-path fault recovery: the engine supervisor's
+        classify/rehydrate logic at JOB granularity (ROADMAP item 1's
+        named payoff). The poisoned device state is already deleted
+        (_advance); here the compiled lane programs bound to the mesh
+        are purged (they may reference dead buffers — the supervisor's
+        rule), and each job of the faulted dispatch is REQUEUED from
+        its park snapshot: chunks/gens_done/emitted never advanced, so
+        the re-run repeats the identical chunk and the record stream
+        stays bit-identical to an uninjected run's (the per-job
+        emitted floor absorbs any records the faulted dispatch got out
+        before dying). A non-transient error — or a job over its
+        --max-job-recoveries budget — fails THAT JOB alone with a
+        terminal jobEntry; co-tenants, other buckets, the writer, and
+        the service itself run on untouched."""
+        from timetabling_ga_tpu.runtime import engine, retry
+        engine.purge_programs(self.mesh)
+        transient = retry.is_transient(exc)
+        now = self.tracer.now()
+        for job in jobs:
+            if job.state in JobState.TERMINAL:
+                # a fault late in the park loop (e.g. a dying writer)
+                # can interrupt the dispatch AFTER some lanes already
+                # finalized — a settled job must never be resurrected
+                continue
+            job.recoveries += 1
+            if transient and job.recoveries \
+                    <= self.cfg.max_job_recoveries:
+                job.state = JobState.PARKED
+                jsonl.fault_entry(self.out, "quantum", "requeue", exc,
+                                  0, job.recoveries, 0, now,
+                                  job=job.id, gens=job.gens_done)
+                self._metrics.counter("serve.job_recoveries").inc()
+            else:
+                jsonl.fault_entry(self.out, "quantum", "abort", exc,
+                                  0, job.recoveries, 0, now,
+                                  job=job.id, gens=job.gens_done)
+                jsonl.job_entry(self.out, job.id, "failed",
+                                reason="quantum fault: "
+                                       + str(exc)[:120],
+                                gens=job.gens_done)
+                job.state = JobState.FAILED
+                job.error = f"quantum fault: {str(exc)[:200]}"
+                job.finished_t = self._now()
+                job.snapshot = None
+                job.ship = None
+                job.ship_records = []
+                self._metrics.counter("serve.jobs_failed").inc()
 
     def drive(self) -> None:
         """Run dispatches until no runnable job remains."""
@@ -431,8 +629,8 @@ class Scheduler:
             host = engine.fetch_state(init(pa_stack, seeds))
         for lane, job in enumerate(jobs):
             job.snapshot = _slice_state(host, lane, self.cfg.pop_size)
-            jsonl.job_entry(self.out, job.id, "started",
-                            bucket=list(job.bucket))
+            self._ship_rec(job, jsonl.job_entry(
+                self.out, job.id, "started", bucket=list(job.bucket)))
 
     def _finalize(self, job: Job, deadline_hit: bool = False) -> None:
         """Emit the job's endTry records from its snapshot (row 0 is
@@ -474,6 +672,9 @@ class Scheduler:
         job.result = {"best": job.best, "feasible": feasible,
                       "hcv": hcv, "scv": scv, "gens": job.gens_done,
                       "deadline_hit": deadline_hit,
+                      "resumed_at": job.resumed_at,
                       "timeslots": slots.tolist(),
                       "rooms": rooms.tolist()}
         job.snapshot = None        # parked memory released
+        job.ship = None            # a settled job ships nothing — the
+        job.ship_records = []      # live tail serves its records

@@ -170,13 +170,20 @@ class SolveService:
 
     def submit(self, problem, job_id=None, priority: int = 0,
                seed=None, generations=None, deadline_s=None,
-               flow: int = 0) -> str:
+               flow: int = 0, snapshot=None) -> str:
         """Admit one job; returns its id. Raises AdmissionError when
         the backlog is full or the id is taken (admission control).
         `flow` (optional) is an inherited causal flow id — the fleet
         gateway's X-TT-Flow, so a routed job's replica-side spans
         continue the gateway's chain; 0 lets the scheduler allocate a
-        local one at admit."""
+        local one at admit. `snapshot` (optional) is a warm-start wire
+        snapshot (serve/snapshot.py): the scheduler admits the job as
+        already PARKED at the snapshot's progress — init skipped, the
+        record stream continuing duplicate-free from the restored
+        `emitted` floor — and `generations` stays the job's TOTAL
+        budget (the remaining budget is total minus the snapshot's
+        gens_done). A snapshot that fails validation demotes to a
+        fresh solve with a faultEntry, never an error."""
         if job_id is None:
             self._auto_id += 1
             job_id = f"job-{self._auto_id}"
@@ -186,7 +193,8 @@ class SolveService:
                   generations=int(self.cfg.generations
                                   if generations is None
                                   else generations),
-                  deadline_s=deadline_s, flow=int(flow or 0))
+                  deadline_s=deadline_s, flow=int(flow or 0),
+                  resume_wire=snapshot)
         # prepare (pad + place) BEFORE the queue takes the job: a
         # failing instance is rejected here with the queue untouched —
         # no half-admitted job can reach the scheduler
@@ -290,7 +298,8 @@ def serve_stream(cfg: ServeConfig, in_stream, out_stream=None,
                                priority=sub.get("priority", 0),
                                seed=sub.get("seed"),
                                generations=sub.get("generations"),
-                               deadline_s=sub.get("deadline"))
+                               deadline_s=sub.get("deadline"),
+                               snapshot=sub.get("snapshot"))
                 except Exception as e:
                     # one bad tenant must not take down the service:
                     # ANY submit-side failure (parse error, admission

@@ -1,0 +1,221 @@
+"""The per-job snapshot wire format: resume, don't replay.
+
+Every parked serve job already IS an all-numpy host snapshot
+(serve/scheduler.py parks through engine.fetch_state — the same tuple
+runtime/checkpoint.py serializes for whole runs). This module is the
+job-granular analogue of that checkpoint format: a versioned,
+fingerprinted serialization of one job's park-fence state that can
+cross a process boundary, so a dead replica's hours of search progress
+move to a survivor instead of dying with the process.
+
+Wire object (JSON-safe — it rides the /v1 protocol):
+
+    {"v": 1,
+     "fingerprint": "j1|b64x8x8x64x5x9|p16|s42",
+     "bucket": [64, 8, 8, 64, 5, 9],
+     "gens_done": 150, "chunks": 6,            # progress + RNG cursor
+     "emitted": 873, "best": 873,              # logEntry floor (the
+                                               #   duplicate-free seam)
+     "crc": 2839463521, "bytes": 51712,        # integrity of the npz
+     "npz": "<base64 of np.savez(PopState fields)>"}
+
+The fingerprint pins everything that must agree for the resumed lane
+to be bit-identical to the uninterrupted one: wire version, bucket key
+(the padded shapes every lane program is compiled for), per-lane
+population size, and the job's seed (lane RNG is fold_in(key(seed),
+chunk) — serve/scheduler.py docstring). A snapshot from a different
+bucket spec, pop size, or seed REFUSES to load (SnapshotMismatch,
+naming both fingerprints), exactly like checkpoint.load's
+FingerprintMismatch; damaged bytes (truncated base64, CRC mismatch,
+torn npz) raise SnapshotCorrupt naming the failing field — the
+CheckpointCorrupt analogue.
+
+Layering: `verify_wire` is STDLIB-ONLY (base64 + zlib) so the fleet
+gateway — which never imports jax — can validate and cache snapshots
+on its dispatcher thread; `pack_state`/`unpack_state` touch numpy (and
+unpack lazily imports ops.ga for PopState), and only ever run on a
+replica. Nothing here may import jax at module level.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import io
+import os
+import zlib
+
+import numpy as np
+
+WIRE_VERSION = 1
+
+# bound on the record prefix a ship unit mirrors (the scheduler keeps
+# each active job's emitted records so the snapshot travels with its
+# exact stream prefix): a pathological tenant's million-improvement
+# stream must not pin the replica's memory — beyond the cap the oldest
+# records drop and the unit is marked truncated (resume still works;
+# stream identity honestly cannot be claimed)
+SHIP_RECORDS_CAP = int(os.environ.get("TT_SNAPSHOT_RECORDS_CAP",
+                                      "4096"))
+
+# the PopState fields, in serialization order (kept explicit rather
+# than reflected off ga.PopState so the wire format cannot silently
+# drift when the runtime type grows a field — a new field is a wire
+# VERSION bump, reviewed here)
+_FIELDS = ("slots", "rooms", "penalty", "hcv", "scv")
+
+# wire keys every snapshot must carry (verify_wire names the missing
+# one — a truncated JSON object fails loudly, not with a KeyError deep
+# in the resume path)
+_REQUIRED = ("v", "fingerprint", "bucket", "gens_done", "chunks",
+             "emitted", "best", "crc", "bytes", "npz")
+
+
+class SnapshotCorrupt(RuntimeError):
+    """The wire snapshot is damaged (truncated base64, CRC mismatch,
+    torn npz, missing fields) — the CheckpointCorrupt analogue
+    (runtime/checkpoint.py). The message names the failing field."""
+
+
+class SnapshotMismatch(ValueError):
+    """The snapshot is intact but belongs to a different (bucket, pop
+    size, seed, wire version) — resuming from it would not reproduce
+    the uninterrupted stream. Named fingerprints in the message, like
+    checkpoint.FingerprintMismatch."""
+
+
+def wire_fingerprint(bucket, pop_size: int, seed: int) -> str:
+    """The compatibility stamp: wire version + bucket key + per-lane
+    population + the job's seed (the whole lane-RNG identity)."""
+    dims = "x".join(str(int(d)) for d in bucket)
+    return f"j{WIRE_VERSION}|b{dims}|p{int(pop_size)}|s{int(seed)}"
+
+
+def pack_state(state, *, bucket, pop_size: int, seed: int,
+               gens_done: int, chunks: int, emitted: int,
+               best: int) -> dict:
+    """Serialize one job's host PopState + progress cursor into the
+    wire object. `state` must be the all-numpy park snapshot (never a
+    device array — packing runs on replica handler threads)."""
+    buf = io.BytesIO()
+    np.savez(buf, **{f: np.asarray(getattr(state, f))
+                     for f in _FIELDS})
+    raw = buf.getvalue()
+    return {"v": WIRE_VERSION,
+            "fingerprint": wire_fingerprint(bucket, pop_size, seed),
+            "bucket": [int(d) for d in bucket],
+            "gens_done": int(gens_done), "chunks": int(chunks),
+            "emitted": int(emitted), "best": int(best),
+            "crc": zlib.crc32(raw) & 0xFFFFFFFF, "bytes": len(raw),
+            "npz": base64.b64encode(raw).decode("ascii")}
+
+
+def verify_wire(wire, expect_fingerprint: str | None = None) -> bytes:
+    """Validate a wire snapshot WITHOUT loading it; returns the raw
+    npz bytes. Stdlib-only (the gateway's cache-admission check).
+
+    Raises SnapshotCorrupt on structural damage (naming the failing
+    field) and SnapshotMismatch when `expect_fingerprint` is given and
+    disagrees (naming both fingerprints)."""
+    if not isinstance(wire, dict):
+        raise SnapshotCorrupt(
+            f"snapshot wire is {type(wire).__name__}, not an object")
+    for k in _REQUIRED:
+        if k not in wire:
+            raise SnapshotCorrupt(f"snapshot wire missing field {k!r}")
+    if int(wire["v"]) != WIRE_VERSION:
+        # version policy (README "Fleet resume"): there is exactly one
+        # live wire version per fleet — mixed versions mean a half-
+        # upgraded fleet, and a refused resume falls back to replay
+        # (progress lost, correctness kept)
+        raise SnapshotMismatch(
+            f"snapshot wire version {wire['v']!r} != {WIRE_VERSION} "
+            f"(fingerprint {str(wire['fingerprint'])!r})")
+    if expect_fingerprint is not None \
+            and str(wire["fingerprint"]) != expect_fingerprint:
+        raise SnapshotMismatch(
+            f"snapshot fingerprint mismatch: "
+            f"{str(wire['fingerprint'])!r} != {expect_fingerprint!r} "
+            f"— different bucket, pop size, seed, or wire version")
+    try:
+        raw = base64.b64decode(str(wire["npz"]), validate=True)
+    except (ValueError, TypeError) as e:
+        raise SnapshotCorrupt(
+            f"snapshot field 'npz' is not valid base64: {e}") from None
+    if len(raw) != int(wire["bytes"]):
+        raise SnapshotCorrupt(
+            f"snapshot field 'npz' truncated: {len(raw)} bytes != "
+            f"declared {int(wire['bytes'])}")
+    crc = zlib.crc32(raw) & 0xFFFFFFFF
+    if crc != int(wire["crc"]) & 0xFFFFFFFF:
+        raise SnapshotCorrupt(
+            f"snapshot field 'npz' CRC mismatch: {crc} != declared "
+            f"{int(wire['crc'])}")
+    return raw
+
+
+def unpack_state(wire, expect_fingerprint: str | None = None):
+    """verify_wire + deserialize: returns (PopState, meta) where meta
+    is {'gens_done', 'chunks', 'emitted', 'best'}. A torn npz that
+    survived the CRC (impossible short of a bug, but cheap to guard)
+    raises SnapshotCorrupt like checkpoint.load's corrupt classes."""
+    raw = verify_wire(wire, expect_fingerprint)
+    # lazy: PopState lives in ops.ga (which imports jax) and the npz
+    # corruption classes in runtime.checkpoint — neither may load in a
+    # gateway process, which only ever calls verify_wire
+    from timetabling_ga_tpu.ops import ga
+    from timetabling_ga_tpu.runtime.checkpoint import CORRUPT_ERRORS
+    try:
+        with np.load(io.BytesIO(raw), allow_pickle=False) as z:
+            state = ga.PopState(
+                **{f: np.array(z[f]) for f in _FIELDS})
+    except CORRUPT_ERRORS as e:
+        raise SnapshotCorrupt(
+            f"snapshot npz payload unreadable: {e!r}") from e
+    meta = {k: int(wire[k])
+            for k in ("gens_done", "chunks", "emitted", "best")}
+    return state, meta
+
+
+@dataclasses.dataclass
+class ShipUnit:
+    """One job's shippable park-fence unit: the host state plus the
+    exact record prefix emitted up to that fence — built by the
+    scheduler ON the drive loop (cheap: references + a list copy) and
+    replaced wholesale at every park, so a handler thread reading
+    `job.ship` sees one consistent (state, records) pair or the other,
+    never a mix. The expensive npz pack happens lazily on the HANDLER
+    thread serving `?snapshot=1` (fault site `snapshot_ship`): a hung
+    export parks one handler thread, never the drive loop or the
+    writer."""
+
+    state: object               # host PopState at the fence
+    bucket: tuple
+    pop_size: int
+    seed: int
+    gens_done: int
+    chunks: int
+    emitted: int
+    best: int
+    records: list               # the job's stream through this fence
+    truncated: bool = False     # records list hit its cap — a resumed
+    #                             stream cannot claim identity
+    wire: dict | None = None    # lazy pack memo (handler threads may
+    #                             race it: both compute the same dict)
+    records_bytes: int | None = None  # lazy serialized-size memo of
+    #                             `records` (same handler-thread
+    #                             discipline as `wire`): the gateway
+    #                             budgets its snapshot cache on this
+    #                             declared size instead of
+    #                             re-measuring the prefix per refresh
+    served: bool = False        # fetched at least once — preempt
+    #                             drain's "shipped" signal
+
+    def pack(self) -> dict:
+        if self.wire is None:
+            self.wire = pack_state(
+                self.state, bucket=self.bucket, pop_size=self.pop_size,
+                seed=self.seed, gens_done=self.gens_done,
+                chunks=self.chunks, emitted=self.emitted,
+                best=self.best)
+        return self.wire

@@ -54,6 +54,9 @@ if [ "${1:-}" = "--fast" ]; then
     step "fleet observatory tests (tests/test_fleet_obs.py)"
     timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_fleet_obs.py -q -p no:cacheprovider || fail=1
+    step "fleet resume tests (tests/test_resume.py)"
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_resume.py -q -p no:cacheprovider || fail=1
     [ "$fail" -eq 0 ] && step "OK (fast mode: full test tier skipped)"
     exit $fail
 fi
