@@ -1422,6 +1422,99 @@ def measure_obs(problem, pop: int = 256, gens: int = 600) -> dict:
     return out
 
 
+def measure_flight(problem, pop: int = 256, gens: int = 600) -> dict:
+    """extra.flight leg (ISSUE 13): the flight recorder + history
+    sampler's cost and its black-box output, same-seed A/B.
+
+    Two legs of the SAME run (same seed, same programs, obs on both so
+    the span/metrics machinery — priced by extra.obs — cancels): the
+    tt-flight pair OFF vs ON (`--incident-dir` + a fast
+    `--history-every`), with an identical injected transient on both
+    legs so the ON leg's recorder has a real trigger to dump on.
+    Reported: overhead ms/dispatch, the span ring's byte high-water,
+    the bundle time-to-dump (trigger -> bundle on disk, the
+    flight.dump_seconds histogram), bundle count — and the
+    records-identical assertion: the recorder is a pure observer, the
+    JSONL stream must not change with it on."""
+    import dataclasses
+    import io
+    import json as _json
+    import shutil
+    import tempfile
+
+    from timetabling_ga_tpu.obs.metrics import REGISTRY
+    from timetabling_ga_tpu.problem import dump_tim
+    from timetabling_ga_tpu.runtime import engine, jsonl
+    from timetabling_ga_tpu.runtime.config import RunConfig
+
+    with tempfile.NamedTemporaryFile("w", suffix=".tim",
+                                     delete=False) as f:
+        f.write(dump_tim(problem))
+        tim = f.name
+    incident_dir = tempfile.mkdtemp(prefix="tt-flight-bench-")
+    try:
+        # the same transient on BOTH legs: the recovery work is in
+        # both measurements, so the delta isolates the recorder; the
+        # faultEntry it emits is the ON leg's dump trigger (and
+        # strip_timing drops it, so the identity assertion holds)
+        base = RunConfig(input=tim, seed=1234, pop_size=pop, islands=1,
+                         generations=gens, migration_period=50,
+                         epochs_per_dispatch=4, ls_mode="sweep",
+                         ls_sweeps=1, init_sweeps=0,
+                         time_limit=100000.0, auto_tune=False,
+                         trace=True, obs=True, metrics_every=1,
+                         faults="dispatch:2:unavailable")
+        engine.precompile(base)
+
+        def leg(flight):
+            cfg = dataclasses.replace(
+                base,
+                incident_dir=incident_dir if flight else None,
+                incident_min_interval=0.0,
+                history_every=0.05 if flight else 0.0)
+            buf = io.StringIO()
+            best = engine.run(cfg, out=buf)
+            lines = [_json.loads(x)
+                     for x in buf.getvalue().splitlines()]
+            loop = [x["phase"] for x in lines if "phase" in x
+                    and x["phase"]["name"] == "gen-loop"][0]
+            return {"best": best, "loop_s": loop["seconds"],
+                    "dispatches": loop["dispatches"],
+                    "recs": jsonl.strip_timing(lines)}
+
+        off = leg(False)
+        on = leg(True)
+        bundles = sorted(p for p in os.listdir(incident_dir)
+                         if p.startswith("incident-"))
+        dump_h = REGISTRY.histogram("flight.dump_seconds").summary()
+        ring_hw = REGISTRY.gauge("flight.span_ring_bytes_hw").value
+    finally:
+        os.unlink(tim)
+        shutil.rmtree(incident_dir, ignore_errors=True)
+    out = {
+        "pop": pop, "gens": gens, "dispatches": off["dispatches"],
+        "loop_s_flight_off": round(off["loop_s"], 3),
+        "loop_s_flight_on": round(on["loop_s"], 3),
+        "flight_overhead_ms_per_dispatch": round(
+            (on["loop_s"] - off["loop_s"]) / max(1, on["dispatches"])
+            * 1e3, 3),
+        "bundles_written": len(bundles),
+        "span_ring_bytes_hw": int(ring_hw if ring_hw == ring_hw
+                                  else 0),
+        "dump_p50_s": dump_h.get("p50"),
+        "dump_max_s": dump_h.get("max"),
+        "records_identical_modulo_timing": off["recs"] == on["recs"],
+    }
+    print(f"# flight A/B (pop {pop}, {off['dispatches']} dispatches): "
+          f"loop {off['loop_s']:.3f}s off vs {on['loop_s']:.3f}s on "
+          f"({out['flight_overhead_ms_per_dispatch']} ms/dispatch); "
+          f"{out['bundles_written']} bundle(s), time-to-dump p50 "
+          f"{out['dump_p50_s']}s, span ring hw "
+          f"{out['span_ring_bytes_hw']}B; records identical="
+          f"{out['records_identical_modulo_timing']}", file=sys.stderr)
+    return out
+
+
 def measure_quality(problem, pop: int = 256, gens: int = 600) -> dict:
     """extra.quality leg (ISSUE 9): the search-quality observatory's
     overhead and its telemetry, same-session A/B.
@@ -1568,6 +1661,7 @@ def main(argv=None) -> None:
             ("pipeline", lambda: measure_pipeline(problem)),
             ("obs", lambda: measure_obs(problem)),
             ("quality", lambda: measure_quality(problem)),
+            ("flight", lambda: measure_flight(problem)),
             ("serve", measure_serve),
             ("soak", measure_soak),
             ("fleet", measure_fleet),

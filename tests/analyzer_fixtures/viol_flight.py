@@ -1,0 +1,55 @@
+"""TT606 fixture: bundle serialization off the recorder thread.
+
+Not imported or executed — parsed by tests/test_analysis.py (the test
+config adds this file to `dispatch-modules` so the loop half fires).
+The flight recorder's contract (obs/flight.py): bundle serialization
+and file I/O run on the RECORDER thread only — never in trace targets,
+never in dispatch loops, and never from an HTTP handler, which may
+only read the in-memory `latest()` / history `window()` state.
+"""
+import http.server
+import json
+
+import jax
+
+
+def dispatch_loop(chunks, runner, state):
+    for chunk in chunks:
+        state = runner(state, chunk)
+        blob = json.dumps({"state": 1})              # EXPECT TT606
+        with open("bundle.json", "w") as fh:         # EXPECT TT606
+            fh.write(blob)
+    return state
+
+
+@jax.jit
+def traced_dump(x):
+    json.dumps({"x": 1})                             # EXPECT TT606
+    return x * 2
+
+
+class FlightHandler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        core = self.server.flight.dump()             # EXPECT TT606
+        self.server.flight.trigger("manual")         # EXPECT TT606
+        self._write_bundle(core)
+
+    def _write_bundle(self, core):
+        # reachable via self._write_bundle() from do_GET — still the
+        # handler path; bundle writes belong on the recorder thread
+        json.dump(core, self.wfile)                  # EXPECT TT606
+
+    def do_HEAD(self):
+        # OK: serving the in-memory copy is exactly what the handler
+        # is for (FlightRecorder.latest / HistoryRing.window)
+        core = self.server.flight.latest()
+        window = self.server.history.window(30.0)
+        self.wfile.write(str((core, window)).encode())
+
+
+def recorder_thread_is_fine(recorder, core):
+    # OK: not a trace target, not a loop in a dispatch module, not a
+    # handler path — the recorder thread's dump body lives here
+    with open("incident.json", "w") as fh:
+        json.dump(core, fh)
+    recorder.trigger("manual")

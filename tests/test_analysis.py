@@ -45,7 +45,8 @@ def fixture_config() -> AnalyzerConfig:
     # fixtures in
     cfg.dispatch_modules = list(cfg.dispatch_modules) + ["viol_sync.py",
                                                          "viol_cost.py",
-                                                         "viol_quality.py"]
+                                                         "viol_quality.py",
+                                                         "viol_flight.py"]
     cfg.sharded_modules = (list(cfg.sharded_modules)
                            + ["viol_collective.py", "viol_quality.py"])
     cfg.fleet_modules = list(cfg.fleet_modules) + ["viol_fleet.py",
@@ -82,6 +83,9 @@ def analyze_fixture(fixture: str):
     "viol_gw_api.py",      # TT602/TT605 on *Api handler-path roots
     #                        (the fleet fronts' enqueue-or-read-only
     #                        api surfaces — tt-obs v5)
+    "viol_flight.py",      # TT606 bundle serialization in dispatch
+    #                        loops / trace targets + flight-recorder
+    #                        dump triggers on handler paths (tt-flight)
 ])
 def test_rule_fires_at_expected_lines(fixture):
     """Each rule family fires exactly at the marked (rule, line) pairs —

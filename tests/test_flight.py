@@ -1,0 +1,653 @@
+"""tt-flight (ISSUE 13): metrics history rings + the incident flight
+recorder, fleet-wide.
+
+The acceptance properties pinned here:
+
+  1. HISTORY — the ring's window queries (`rate`, `mean_over`,
+     `sustained` — the documented autoscaler trigger primitive) answer
+     correctly, `sustained` refuses uncovered windows, and
+     `GET /metrics/history?window=S` serves the ring read-only on the
+     existing pull front;
+  2. RECORDER — triggers (manual, faultEntry on the stream, a /readyz
+     reason flipping on) produce rate-limited, retained, self-contained
+     bundles; the span ring honors its byte budget; the record tee
+     changes nothing about the stream;
+  3. IDENTITY — an engine run with recorder+sampler ON emits a JSONL
+     stream bit-identical (strip_timing domain) to recorder OFF;
+  4. ISOLATION — a hung or dead sampler/dump thread (`history` /
+     `flight_dump` sites) never stalls dispatch, settlement, or writer
+     drain;
+  5. FLEET (slow) — an injected replica fault during a routed solve
+     produces a replica bundle AND a stitched gateway bundle sharing
+     the job's XFLOW id; `tt incident` renders a Perfetto-loadable
+     timeline from the stitched bundle; streams stay identical to the
+     unrouted recorder-off baseline.
+"""
+
+import io
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from timetabling_ga_tpu.obs import flight as obs_flight
+from timetabling_ga_tpu.obs import http as obs_http
+from timetabling_ga_tpu.obs.history import HistoryRing
+from timetabling_ga_tpu.obs.logstats import summarize
+from timetabling_ga_tpu.obs.metrics import MetricsRegistry
+from timetabling_ga_tpu.obs.spans import XFLOW_BASE
+from timetabling_ga_tpu.runtime import faults, jsonl
+from timetabling_ga_tpu.runtime.config import (
+    FleetConfig, RunConfig, ServeConfig, parse_args, parse_fleet_args,
+    parse_serve_args)
+
+from tests.conftest import TIM_FIXTURE
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+def _ring(every=1.0):
+    t = [0.0]
+    reg = MetricsRegistry()
+    ring = HistoryRing(registry=reg, every_s=every,
+                       now=lambda: t[0])
+    return t, reg, ring
+
+
+# ------------------------------------------------------------- history
+
+
+def test_history_rate_mean_sustained():
+    t, reg, ring = _ring()
+    reg.gauge("g").set(4.0)
+    reg.histogram("lat").observe(0.5)
+    for _ in range(6):
+        ring.sample_once()
+        reg.counter("c").inc(3)
+        t[0] += 1.0
+    # counter rate: 3/tick over 1s ticks
+    assert ring.rate("c", 10.0) == pytest.approx(3.0)
+    assert ring.mean_over("g", 10.0) == pytest.approx(4.0)
+    # histogram series materialize as .count/.sum
+    assert ring.series("lat.count")[-1][1] == 1.0
+    assert ring.series("lat.sum")[-1][1] == pytest.approx(0.5)
+    # sustained: every sample in a covered window satisfies the op
+    assert ring.sustained("g", ">=", 4.0, 3.0)
+    assert not ring.sustained("g", ">=", 5.0, 3.0)
+    assert ring.sustained("g", "<=", 4.0, 3.0)
+    # window payload shape (the /metrics/history body)
+    w = ring.window(2.5)
+    assert w["every_s"] == 1.0
+    assert all(len(pts) <= 3 for pts in w["series"].values())
+    with pytest.raises(ValueError):
+        ring.sustained("g", "~", 1.0, 3.0)
+
+
+def test_history_sustained_requires_coverage():
+    t, reg, ring = _ring()
+    reg.gauge("g").set(9.0)
+    ring.sample_once()          # a single young sample
+    t[0] += 0.5
+    # the signal satisfies the op but the ring has not WATCHED it for
+    # 30s — a fresh process must not claim a sustained condition
+    assert not ring.sustained("g", ">=", 1.0, 30.0)
+    # absent series: False, never a KeyError
+    assert not ring.sustained("nope", ">=", 1.0, 1.0)
+    assert ring.rate("g", 30.0) is None         # < 2 samples
+    assert ring.mean_over("nope", 1.0) is None
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def test_history_endpoint_on_pull_front():
+    t, reg, ring = _ring()
+    reg.gauge("serve.queue_depth").set(7.0)
+    for _ in range(3):
+        ring.sample_once()
+        t[0] += 1.0
+    srv = obs_http.ObsServer("127.0.0.1:0", registry=reg,
+                             history=ring).start()
+    try:
+        status, body = _get(srv.url + "/metrics/history")
+        assert status == 200
+        assert body["series"]["serve.queue_depth"][-1][1] == 7.0
+        assert body["samples"] == 3
+        status, body = _get(srv.url + "/metrics/history?window=1.5")
+        assert body["window"] == 1.5
+        # bad window: 400, not a traceback
+        try:
+            _get(srv.url + "/metrics/history?window=soon")
+            raise AssertionError("expected 400")
+        except urllib.request.HTTPError as e:
+            assert e.code == 400
+    finally:
+        srv.close()
+    # a front with NO ring answers 404 (engine run without the flag)
+    srv2 = obs_http.ObsServer("127.0.0.1:0", registry=reg).start()
+    try:
+        _get(srv2.url + "/metrics/history")
+        raise AssertionError("expected 404")
+    except urllib.request.HTTPError as e:
+        assert e.code == 404
+    finally:
+        srv2.close()
+
+
+# ------------------------------------------------------------ recorder
+
+
+def _recorder(tmp_path, **kw):
+    t = [100.0]
+    reg = MetricsRegistry()
+    kw.setdefault("min_interval_s", 0.0)
+    kw.setdefault("process", "test")
+    rec = obs_flight.FlightRecorder(str(tmp_path), registry=reg,
+                                    now=lambda: t[0], **kw)
+    return t, reg, rec
+
+
+def test_manual_trigger_rate_limit_and_retention(tmp_path):
+    t, reg, rec = _recorder(tmp_path, keep=2, min_interval_s=5.0)
+    rec.trigger("manual:one")
+    assert rec.poll_once()
+    assert rec.latest()["trigger"] == "manual:one"
+    # inside the min interval: DEFERRED (counted once), no new bundle
+    t[0] += 1.0
+    rec.trigger("manual:two")
+    assert rec.poll_once()
+    assert rec.poll_once()                       # re-check, same count
+    assert reg.counter("flight.rate_limited").value == 1
+    assert rec.latest()["trigger"] == "manual:one"
+    # interval elapses with NO new trigger: the deferred incident
+    # still gets its bundle — the limit means one bundle per storm,
+    # never zero for a distinct incident
+    t[0] += 6.0
+    assert rec.poll_once()
+    assert rec.latest()["trigger"] == "manual:two"
+    assert reg.counter("flight.dumps").value == 2
+    # later triggers past the interval dump; retention ages out the
+    # oldest bundles
+    for name in ("manual:three", "manual:four"):
+        t[0] += 6.0
+        rec.trigger(name)
+        assert rec.poll_once()
+    files = obs_flight.list_bundles(str(tmp_path))
+    assert len(files) == 2                       # keep=2, oldest gone
+    assert rec.latest()["trigger"] == "manual:four"
+    assert reg.counter("flight.dumps").value == 4
+    # the in-memory copy and the newest file agree
+    assert obs_flight.load_bundle(files[-1])["trigger"] == "manual:four"
+    # a PEER-carrying trigger (a failover's correlation dump) bypasses
+    # the rate limit: losing the one stitched bundle a failover asked
+    # for because a reason flapped seconds earlier would defeat the
+    # recorder's purpose
+    t[0] += 1.0                                  # inside min_interval
+    rec.trigger("failover:r0", peers=("r0",))
+    assert rec.poll_once()
+    assert rec.latest()["trigger"] == "failover:r0"
+    assert reg.counter("flight.dumps").value == 5
+
+
+def test_record_tee_rings_budget_and_fault_trigger(tmp_path):
+    t, reg, rec = _recorder(tmp_path, span_bytes=400, records_cap=3)
+    buf = io.StringIO()
+    tee = rec.tee(buf)
+    lines = [
+        '{"logEntry":{"procID":0,"threadID":0,"best":9,"time":1.0}}',
+        '{"spanEntry":{"name":"dispatch","cat":"device","ts":0.1,'
+        '"dur":0.2,"depth":0,"tid":0,"flow":7}}',
+        '{"spanEntry":{"name":"fetch","cat":"engine","ts":0.3,'
+        '"dur":0.1,"depth":0,"tid":0,"flow":7}}',
+        '{"spanEntry":{"name":"process","cat":"engine","ts":0.4,'
+        '"dur":0.1,"depth":0,"tid":0,"flow":7}}',
+        '{"logEntry":{"procID":0,"threadID":0,"best":8,"time":2.0}}',
+        '{"logEntry":{"procID":0,"threadID":0,"best":7,"time":3.0}}',
+        '{"logEntry":{"procID":0,"threadID":0,"best":6,"time":4.0}}',
+        '{"faultEntry":{"site":"dispatch","action":"recover",'
+        '"error":"x","trial":0,"recovery":1,"level":0,"time":4.5}}',
+    ]
+    for ln in lines:
+        tee.write(ln + "\n")
+    # the tee is a pure pass-through
+    assert buf.getvalue() == "".join(ln + "\n" for ln in lines)
+    assert rec.poll_once()
+    core = rec.latest()
+    assert core["trigger"] == "fault:dispatch/recover"
+    # record ring: count-capped, newest kept (the faultEntry survives)
+    assert len(core["records"]) == 3
+    assert "faultEntry" in core["records"][-1]
+    assert core["records_dropped"] == 2          # 5 non-span records
+    # span ring: byte-budgeted — 3 small spans fit 400B or evict
+    # oldest-first; whatever remains, accounting is honest
+    assert len(core["spans"]) + core["spans_dropped"] == 3
+    assert core["spans"][-1]["name"] == "process"
+    assert rec.span_bytes_hw > 0
+
+
+def test_readiness_flip_triggers_dump(tmp_path):
+    t, reg, rec = _recorder(tmp_path)
+    # first poll: all clear, nothing pending
+    assert rec.poll_once()
+    assert rec.latest() is None
+    # a /readyz reason flips ON (backlog_full: queue >= backlog)
+    reg.gauge("serve.backlog").set(4.0)
+    reg.gauge("serve.queue_depth").set(4.0)
+    assert rec.poll_once()
+    assert rec.latest()["trigger"] == "reason:backlog_full"
+    assert rec.latest()["reasons"] == ["backlog_full"]
+    # still on: no re-trigger (flip detection, not level detection)
+    t[0] += 1.0
+    assert rec.poll_once()
+    assert reg.counter("flight.dumps").value == 1
+    # clears, then flips on again: a NEW incident
+    reg.gauge("serve.queue_depth").set(0.0)
+    assert rec.poll_once()
+    reg.gauge("serve.queue_depth").set(9.0)
+    t[0] += 1.0
+    assert rec.poll_once()
+    assert reg.counter("flight.dumps").value == 2
+
+
+def test_flight_dump_die_ends_recorder_thread(tmp_path):
+    t, reg, rec = _recorder(tmp_path)
+    faults.install("flight_dump:1:die")
+    rec.trigger("manual:x")
+    assert rec.poll_once() is False              # thread would exit
+    assert rec.latest() is None
+    faults.install(None)
+
+
+def test_history_die_ends_sampler():
+    t, reg, ring = _ring()
+    faults.install("history:1:die")
+    assert ring.sample_once() is False
+    faults.install(None)
+    assert ring.sample_once() is True
+
+
+# ---------------------------------------------------- bundles -> tools
+
+
+def _mk_bundle(tmp_path, spans=(), records=(), **core_kw):
+    core = {"version": 1, "process": "engine", "pid": 1,
+            "trigger": "manual:t", "reasons": [], "ts": 1.0,
+            "unix_time": 0.0, "config": None, "metrics": {},
+            "history": None, "mem": {}, "spans": list(spans),
+            "records": list(records), "spans_dropped": 0,
+            "records_dropped": 0}
+    core.update(core_kw)
+    path = os.path.join(str(tmp_path), "incident-1-0001-manual-t.json")
+    with open(path, "w") as fh:
+        json.dump({"incident": core}, fh)
+    return path, core
+
+
+def test_tt_incident_renders_and_lists(tmp_path, capsys):
+    span = {"name": "dispatch", "cat": "device", "ts": 0.1,
+            "dur": 0.2, "depth": 0, "tid": 0, "flow": 3}
+    path, _ = _mk_bundle(
+        tmp_path, spans=[span],
+        records=[{"faultEntry": {"site": "dispatch",
+                                 "action": "recover", "error": "x",
+                                 "trial": 0, "recovery": 1,
+                                 "level": 0, "time": 1.0}}])
+    out = os.path.join(str(tmp_path), "t.json")
+    assert obs_flight.main_incident([str(tmp_path), "-o", out]) == 0
+    text = capsys.readouterr().out
+    assert "== incident: manual:t" in text
+    assert "last fault: dispatch/recover" in text
+    with open(out) as fh:
+        doc = json.load(fh)
+    assert any(e.get("name") == "dispatch" and e.get("ph") == "X"
+               for e in doc["traceEvents"])
+    # --list mode names the bundles without rendering
+    assert obs_flight.main_incident([str(tmp_path), "--list"]) == 0
+    assert "manual:t" in capsys.readouterr().out
+
+
+def test_tt_trace_accepts_bundle_next_to_jsonl(tmp_path):
+    from timetabling_ga_tpu.obs.trace_export import main_trace
+    span = {"name": "quantum", "cat": "device", "ts": 0.5,
+            "dur": 0.2, "depth": 0, "tid": 0,
+            "flow": XFLOW_BASE + 1}
+    bundle_path, _ = _mk_bundle(tmp_path, spans=[span])
+    log_path = os.path.join(str(tmp_path), "gw.jsonl")
+    with open(log_path, "w") as fh:
+        fh.write(json.dumps({"spanEntry": {
+            "name": "routed", "cat": "fleet", "ts": 0.1, "dur": 0.6,
+            "depth": 0, "tid": 0, "flow": XFLOW_BASE + 1}}) + "\n")
+    out = os.path.join(str(tmp_path), "stitched.json")
+    assert main_trace([log_path, bundle_path, "-o", out]) == 0
+    with open(out) as fh:
+        doc = json.load(fh)
+    names = {e.get("name") for e in doc["traceEvents"]}
+    assert {"routed", "quantum"} <= names
+    # the XFLOW chain crosses the two inputs: one s + one f flow event
+    flows = [e for e in doc["traceEvents"]
+             if e.get("ph") in ("s", "t", "f")
+             and e.get("id") == XFLOW_BASE + 1]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    assert {e["pid"] for e in flows} == {0, 1}
+
+
+def test_stats_incidents_section():
+    recs = [{"spanEntry": {"name": "flight_dump", "cat": "flight",
+                           "ts": 5.0, "dur": 0.4, "depth": 0,
+                           "tid": 1, "trigger": "fault:dispatch"}},
+            {"spanEntry": {"name": "flight_dump", "cat": "flight",
+                           "ts": 9.0, "dur": 0.2, "depth": 0,
+                           "tid": 1, "trigger": "reason:slo_burn"}}]
+    text = summarize(recs)
+    assert "== incidents (2 dumps)" in text
+    assert "fault:dispatch: 1x" in text
+    assert "reason:slo_burn: 1x" in text
+    assert "time-to-dump p50 0.400s" in text
+
+
+# --------------------------------------------------------------- flags
+
+
+def test_flight_flags_parse_and_validate():
+    cfg = parse_args(["-i", "x.tim", "--history-every", "0.5",
+                      "--incident-dir", "/tmp/inc",
+                      "--incident-min-interval", "10"])
+    assert (cfg.history_every, cfg.incident_dir,
+            cfg.incident_min_interval) == (0.5, "/tmp/inc", 10.0)
+    with pytest.raises(SystemExit):
+        parse_args(["-i", "x.tim", "--history-every", "-1"])
+    with pytest.raises(SystemExit):
+        parse_args(["-i", "x.tim", "--incident-min-interval", "-1"])
+    scfg = parse_serve_args(["--incident-dir", "/tmp/i",
+                             "--history-every", "2"])
+    assert scfg.incident_dir == "/tmp/i"
+    assert scfg.history_every == 2.0
+    with pytest.raises(SystemExit):
+        parse_serve_args(["--history-every", "-3"])
+    fcfg = parse_fleet_args(["--replica", "http://x:1",
+                             "--incident-dir", "/tmp/g",
+                             "--incident-min-interval", "0"])
+    assert fcfg.incident_dir == "/tmp/g"
+    with pytest.raises(SystemExit):
+        parse_fleet_args(["--replica", "http://x:1",
+                          "--incident-min-interval", "-2"])
+    # new fault sites parse
+    faults.FaultPlan.parse("history:1:hang,flight_dump:2:die")
+
+
+# ------------------------------------------------- engine e2e + identity
+
+
+def _engine_run(tmp_path=None, **kw):
+    from timetabling_ga_tpu.runtime import engine
+    base = dict(input=TIM_FIXTURE, seed=3, pop_size=8, islands=2,
+                generations=30, migration_period=10, max_steps=8,
+                time_limit=300, backend="cpu", auto_tune=False,
+                trace=True)
+    base.update(kw)
+    buf = io.StringIO()
+    best = engine.run(RunConfig(**base), out=buf)
+    return best, [json.loads(x) for x in buf.getvalue().splitlines()]
+
+
+def test_engine_bundle_and_stream_identity(tmp_path, engine_stream_baseline):
+    """Recorder+sampler ON: an injected transient produces a bundle
+    carrying trigger/metrics/history/rings, and the JSONL stream is
+    bit-identical to the session baseline (recorder OFF, fault-free —
+    strip_timing drops the fault/obs records)."""
+    d = str(tmp_path / "inc")
+    best_off, base_recs = engine_stream_baseline
+    best_on, on_recs = _engine_run(
+        obs=True, faults="dispatch:2:unavailable",
+        incident_dir=d, incident_min_interval=0.0, history_every=0.05)
+    assert best_on == best_off
+    assert jsonl.strip_timing(on_recs) == jsonl.strip_timing(base_recs)
+    bundles = [obs_flight.load_bundle(p)
+               for p in obs_flight.list_bundles(d)]
+    fault_bundles = [b for b in bundles
+                     if b["trigger"].startswith("fault:dispatch")]
+    assert fault_bundles, [b["trigger"] for b in bundles]
+    core = fault_bundles[0]
+    assert core["process"] == "engine"
+    assert core["config"]["kind"] == "RunConfig"
+    assert core["metrics"].get("counters", {}).get("flight.triggers")
+    assert len((core["history"] or {}).get("series", {})) > 0
+    assert core["records"]                      # the tee fed the ring
+    # the dump span landed on the stream (the tt stats source)
+    assert any(r.get("spanEntry", {}).get("name") == "flight_dump"
+               for r in on_recs)
+
+
+def test_hung_sampler_and_dumper_never_stall_the_run(tmp_path,
+                                                     monkeypatch):
+    """Isolation (the mem_poll discipline): a sampler that dies on its
+    first sample AND a dump attempt that hangs leave the run
+    untouched — it completes, the writer drains, the stream is whole."""
+    monkeypatch.setattr(faults, "HANG_S", 30.0)
+    d = str(tmp_path / "inc")
+    best, recs = _engine_run(
+        obs=True,
+        faults="history:1:die,dispatch:2:unavailable,"
+               "flight_dump:1:hang",
+        incident_dir=d, incident_min_interval=0.0, history_every=0.05)
+    # the run completed and the stream is complete (solution + final
+    # runEntry drained through the writer)
+    assert any("solution" in r for r in recs)
+    assert any("runEntry" in r for r in recs)
+    # the hung dump produced nothing — and stalled nothing
+    assert obs_flight.list_bundles(d) == []
+
+
+# ------------------------------------------------ replica front (fast)
+
+
+def _serve_cfg(**kw):
+    kw.setdefault("backend", "cpu")
+    kw.setdefault("lanes", 2)
+    kw.setdefault("quantum", 5)
+    kw.setdefault("pop_size", 4)
+    kw.setdefault("max_steps", 8)
+    return ServeConfig(**kw)
+
+
+def _fleet_cfg(urls, **kw):
+    kw.setdefault("listen", "127.0.0.1:0")
+    kw.setdefault("probe_every", 0.1)
+    kw.setdefault("poll_every", 0.05)
+    kw.setdefault("dead_after", 2)
+    return FleetConfig(replicas=list(urls), **kw)
+
+
+def test_replica_incident_endpoint(tmp_path):
+    """GET /v1/incident serves the replica's newest bundle from
+    memory; GET /metrics/history serves its ring; both 404 cleanly
+    when unwired."""
+    from timetabling_ga_tpu.fleet.replicas import (
+        FleetHTTPError, http_json, in_process_replica)
+    from timetabling_ga_tpu.problem import dump_tim, random_instance
+    p = random_instance(71, n_events=12, n_rooms=3, n_features=2,
+                        n_students=8, attend_prob=0.2)
+    rep, handle = in_process_replica(
+        _serve_cfg(http="127.0.0.1:0", obs=True,
+                   incident_dir=str(tmp_path / "r"),
+                   incident_min_interval=0.0, history_every=0.1),
+        "fl0")
+    try:
+        # before any incident: a clean 404 (and the handle's client
+        # decodes it to None)
+        assert handle.get_incident(timeout=5.0) is None
+        faults.install("quantum:1:unavailable")
+        http_json("POST", rep.url + "/v1/solve",
+                  {"tim": dump_tim(p), "id": "fj", "seed": 3,
+                   "generations": 10})
+        deadline = time.monotonic() + 90
+        core = None
+        while time.monotonic() < deadline:
+            core = handle.get_incident(timeout=5.0)
+            if core is not None:
+                break
+            time.sleep(0.05)
+        assert core is not None, "no bundle served"
+        assert core["trigger"].startswith("fault:quantum")
+        assert core["process"] == "serve"
+        hist = handle.get_history(window=30.0, timeout=5.0)
+        assert hist["series"], "empty history ring"
+    finally:
+        faults.install(None)
+        rep.kill()
+    # a replica WITHOUT the flags answers 404 on /v1/incident
+    rep2, handle2 = in_process_replica(
+        _serve_cfg(http="127.0.0.1:0"), "fl1")
+    try:
+        assert handle2.get_incident(timeout=5.0) is None
+        with pytest.raises(FleetHTTPError):
+            http_json("GET", rep2.url + "/metrics/history", ok=(200,))
+    finally:
+        rep2.kill()
+
+
+# ----------------------------------------------- fleet acceptance (slow)
+
+
+@pytest.mark.slow
+def test_fleet_kill_mid_stream_incident_acceptance(tmp_path):
+    """ISSUE 13 acceptance: with --incident-dir set fleet-wide, an
+    injected replica fault during a routed solve produces a REPLICA
+    bundle and — after the replica is killed mid-stream — a STITCHED
+    gateway bundle; the two share the job's XFLOW id, `tt incident`
+    renders a Perfetto-loadable cross-process timeline from the
+    stitched bundle, and the settled record stream (recorder+sampler
+    ON everywhere) is bit-identical to the recorder-OFF unrouted
+    baseline."""
+    from timetabling_ga_tpu.fleet.gateway import Gateway
+    from timetabling_ga_tpu.fleet.replicas import (
+        http_json, in_process_replica)
+    from timetabling_ga_tpu.problem import dump_tim, random_instance
+    from timetabling_ga_tpu.serve.service import SolveService
+    p = random_instance(71, n_events=12, n_rooms=3, n_features=2,
+                        n_students=8, attend_prob=0.2)
+
+    def rep_cfg(tag):
+        return _serve_cfg(http="127.0.0.1:0", obs=True,
+                          incident_dir=str(tmp_path / tag),
+                          incident_min_interval=0.0,
+                          history_every=0.1)
+
+    rep0, h0 = in_process_replica(rep_cfg("r0"), "fk0")
+    rep1, h1 = in_process_replica(rep_cfg("r1"), "fk1")
+    gw_dir = str(tmp_path / "gw")
+    gwbuf = io.StringIO()
+    gw = Gateway(_fleet_cfg([h0.url, h1.url], incident_dir=gw_dir,
+                            incident_min_interval=0.0,
+                            history_every=0.1),
+                 [h0, h1], out=gwbuf).start()
+    reps = {"fk0": rep0, "fk1": rep1}
+    handles = {"fk0": h0, "fk1": h1}
+    try:
+        # the injected replica fault: the FIRST quantum anywhere in
+        # the process dies transiently — i.e. on the job's owner
+        faults.install("quantum:1:unavailable")
+        http_json("POST", gw.url + "/v1/solve",
+                  {"tim": dump_tim(p), "id": "kx", "seed": 3,
+                   "generations": 2000})
+        # wait for the owner's recorder to dump on the fault AND for
+        # the gateway prober to cache that bundle off the dump-counter
+        # scrape (the dead replica's bundle must survive its death)
+        deadline = time.monotonic() + 120
+        owner = None
+        while time.monotonic() < deadline:
+            with gw.jobs_lock:
+                j = gw.jobs.get("kx")
+                owner, flow = j.replica, j.flow
+            if (owner in reps
+                    and handles[owner].last_incident is not None
+                    and j.snap_gens >= 5):
+                break
+            time.sleep(0.02)
+        assert owner in reps, "job never placed"
+        rep_core = handles[owner].last_incident
+        assert rep_core is not None, "prober never cached the bundle"
+        assert rep_core["trigger"].startswith("fault:quantum")
+        assert flow >= XFLOW_BASE
+
+        # kill mid-stream: failover stitches gateway + cached replica
+        reps[owner].kill()
+        deadline = time.monotonic() + 120
+        stitched = None
+        while time.monotonic() < deadline:
+            for path in obs_flight.list_bundles(gw_dir):
+                core = obs_flight.load_bundle(path)
+                if core.get("stitched") and core["trigger"] \
+                        == f"failover:{owner}":
+                    stitched = (path, core)
+            if stitched:
+                break
+            time.sleep(0.05)
+        assert stitched, "no stitched failover bundle"
+        st_path, st_core = stitched
+
+        # the job completes on the survivor, stream identical to the
+        # recorder-OFF unrouted baseline
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            v = http_json("GET", gw.url + "/v1/jobs/kx", ok=(200,))
+            if v["state"] in ("done", "failed"):
+                break
+            time.sleep(0.1)
+        assert v["state"] == "done"
+        base_buf = io.StringIO()
+        svc = SolveService(_serve_cfg(), out=base_buf)
+        svc.submit(p, job_id="kx", seed=3, generations=2000)
+        svc.drive()
+        svc.close()
+        base = [json.loads(x)
+                for x in base_buf.getvalue().splitlines()]
+        assert jsonl.strip_timing(v["records"]) \
+            == jsonl.strip_timing(base)
+
+        # the shared XFLOW id: gateway spans and the replica bundle's
+        # spans both carry the job's cross-process flow
+        def flows(core):
+            out = set()
+            for s in core.get("spans", ()):
+                f = s.get("flow")
+                for x in (f if isinstance(f, list) else [f]):
+                    if isinstance(x, (int, float)):
+                        out.add(int(x))
+            return out
+
+        assert flow in flows(st_core), "gateway bundle lost the flow"
+        peer = next(pr["incident"] for pr in st_core["peers"]
+                    if pr["label"] == owner)
+        assert peer is not None
+        assert flow in flows(peer), "replica bundle lost the flow"
+        # the embedded stitched trace reused export_stitched's rules:
+        # per-process lanes + the verbatim XFLOW chain
+        assert any(e.get("ph") == "M" for e in
+                   st_core["trace"]["traceEvents"])
+
+        # tt incident renders the stitched bundle as Perfetto JSON
+        out = str(tmp_path / "incident.trace.json")
+        assert obs_flight.main_incident([st_path, "-o", out]) == 0
+        with open(out) as fh:
+            doc = json.load(fh)
+        assert doc["traceEvents"]
+        xfl = [e for e in doc["traceEvents"]
+               if e.get("ph") in ("s", "t", "f")
+               and e.get("id") == flow]
+        assert xfl, "no cross-process flow arrows in the timeline"
+        assert len({e["pid"] for e in xfl}) >= 2
+    finally:
+        faults.install(None)
+        gw.close()
+        rep0.kill()
+        rep1.kill()

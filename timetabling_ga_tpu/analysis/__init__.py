@@ -42,6 +42,11 @@ Rules (see README "Static analysis & sanitizers"):
          introduced in quality-reduction helpers (TT302-adjacent);
          the search-quality observatory ships packed on-device rows
          instead (obs/quality.py, parallel/islands.py)
+  TT606  incident-bundle serialization / file I/O inside trace targets
+         or dispatch loops, and flight-recorder dump triggers on HTTP
+         handler paths — dumps belong on the recorder's own thread;
+         handlers serve the in-memory `latest()`/history `window()`
+         only (obs/flight.py, obs/history.py)
 
 Suppress one finding inline with `# tt-analyze: ignore[TT301]` (on the
 line, or on a comment line directly above). Configure via
@@ -77,9 +82,9 @@ class _Context:
 
 def _rule_modules():
     from timetabling_ga_tpu.analysis import (
-        rules_api, rules_cost, rules_donate, rules_fleet, rules_http,
-        rules_obs, rules_quality, rules_recompile, rules_rng,
-        rules_sync, rules_trace)
+        rules_api, rules_cost, rules_donate, rules_fleet,
+        rules_flight, rules_http, rules_obs, rules_quality,
+        rules_recompile, rules_rng, rules_sync, rules_trace)
     return {
         "TT101": rules_trace,
         "TT102": rules_trace,
@@ -97,6 +102,7 @@ def _rule_modules():
         "TT603": rules_cost,
         "TT604": rules_quality,
         "TT605": rules_fleet,
+        "TT606": rules_flight,
     }
 
 

@@ -39,6 +39,11 @@ JSONL record stream, never a device.
     python -m timetabling_ga_tpu.cli quality run.jsonl
         summarize the search-quality telemetry (--quality runs):
         diversity trend, operator hit rates, migration gain, stalls
+    python -m timetabling_ga_tpu.cli incident ./incidents [--job ID]
+        summarize the flight recorder's bundles (--incident-dir) and
+        render the newest — a stitched gateway bundle renders the
+        cross-process gateway+replica timeline — as Perfetto JSON;
+        `tt trace` also accepts bundle files next to JSONL logs
 
 `profile` subcommand — the cost observatory's on-demand capture
 trigger (README "Cost observatory"; obs/cost.py): ask a live run or
@@ -85,6 +90,13 @@ def main(argv=None) -> int:
         # README "Search-quality observatory")
         from timetabling_ga_tpu.obs.quality import main_quality
         return main_quality(argv[1:])
+    if argv and argv[0] == "incident":
+        # deferred + jax-free like trace/stats: summarize/render the
+        # flight recorder's incident bundles (obs/flight.py, README
+        # "Flight recorder & history") — a stitched gateway bundle
+        # renders the cross-process Perfetto timeline
+        from timetabling_ga_tpu.obs.flight import main_incident
+        return main_incident(argv[1:])
     if argv and argv[0] == "profile":
         # deferred + jax-free like trace/stats: `tt profile` is a
         # stdlib HTTP client asking a LIVE run's --obs-listen front to

@@ -238,6 +238,13 @@ class ApiHandler(obs_http._Handler):
         elif path == "/v1/fleet":
             status, obj = self.server.api.fleet_view()
             self._reply_json(status, obj)
+        elif path == "/v1/incident":
+            # tt-flight: the newest incident bundle, from the
+            # recorder's in-memory `latest()` — replicas serve their
+            # own, the gateway its (possibly stitched) one. Read-only
+            # and file-I/O-free on this thread (TT602/TT606)
+            status, obj = self.server.api.incident_view()
+            self._reply_json(status, obj)
         else:
             super().do_GET()
 
@@ -539,6 +546,15 @@ class GatewayApi:
                          if not j.terminal())
         return 200, {"draining": True, "active": active}
 
+    def incident_view(self):
+        """GET /v1/incident at the gateway: its newest bundle — after
+        a failover or burn, the STITCHED cross-process one (own rings
+        + the involved replicas' pulled bundles). Same shared wire
+        shape and in-memory discipline as the replica's
+        (obs/flight.incident_response)."""
+        from timetabling_ga_tpu.obs.flight import incident_response
+        return incident_response(self._gw.flight)
+
     def fleet_view(self):
         # served from the dispatcher's lock-guarded SNAPSHOT, refreshed
         # once per tick — the handler thread never reads router/replica
@@ -574,20 +590,78 @@ class Gateway:
         #                              would be popped right back and
         #                              starve the poll/drain phases)
         self._terminal_order: list = []   # settled ids, eviction FIFO
+        # the gateway's PRIVATE registry (replicas keep their own
+        # /readyz truths; so does the front) — created before the
+        # telemetry stream so the tt-flight pieces can report into it
+        self.registry = obs_metrics.MetricsRegistry()
+        # tt-flight: the history ring samples this registry (whose
+        # per-replica pull gauges the prober refreshes — so
+        # `sustained("fleet.replica.r0.backlog", ...)` is exactly the
+        # autoscaling loop's input, ROADMAP item 3); the recorder tees
+        # the gateway log and stitches cross-process bundles on
+        # failover/burn (`_pull_incidents` is its peer fetch, run on
+        # the RECORDER thread — a hung replica export parks the
+        # recorder, never the dispatcher)
+        self.history = None
+        self.flight = None
+        self._stream = None
+        self._close_stream = False
+        self.writer = None
+        self.front = None
+        self.replicas = None
+        try:
+            self._init_rest(cfg, handles, out)
+        except BaseException:
+            # ANY constructor failure past the thread starts — a taken
+            # listen port, an unwritable -o path, a bad worker-flag
+            # parse — must not leak the started tt-flight threads, the
+            # gw_writer worker, the -o handle, the prober thread, or
+            # owned worker processes into a process whose Gateway
+            # never existed (the SolveService ctor-failure discipline;
+            # close() is unreachable here)
+            if self.front is not None:
+                self.front.close()
+            if self.flight is not None:
+                self.flight.close()
+            if self.history is not None:
+                self.history.close()
+            if self.writer is not None:
+                try:
+                    self.writer.close(raise_error=False)
+                except Exception:
+                    pass
+            if self._close_stream:
+                try:
+                    self._stream.close()
+                except Exception:
+                    pass
+            if self.replicas is not None:
+                self.replicas.close()
+            raise
+
+    def _init_rest(self, cfg: FleetConfig, handles, out) -> None:
         # -- telemetry stream (tt-obs v5): `-o LOG` (or an explicit
         # `out` stream) gives the gateway its own AsyncWriter + tracer;
         # without one the tracer is the shared no-op and nothing emits
         self._stream = out
-        self._close_stream = False
         if self._stream is None and cfg.output:
             self._stream = open(cfg.output, "w")
             self._close_stream = True
-        self.writer = (jsonl.AsyncWriter(self._stream, site="gw_writer")
-                       if self._stream is not None else None)
+        from timetabling_ga_tpu.obs import flight as obs_flight
+        self.history, self.flight, sink = obs_flight.wire(
+            cfg, self._stream, registry=self.registry,
+            process="gateway", peers_fn=self._pull_incidents,
+            now=self.now, history_always=True)
+        self.writer = (jsonl.AsyncWriter(sink, site="gw_writer")
+                       if sink is not None else None)
         self._obs_dead = False       # latched by _rec on a dead writer
         self.tracer = (SpanTracer(self.writer, clock=self.now,
                                   flow_base=XFLOW_BASE)
                        if self.writer is not None else NULL_TRACER)
+        if self.flight is not None:
+            if self.writer is not None:
+                self.flight.bind_tracer(self.tracer)
+            self.flight.start()
         # the serve flags spawned workers run with double as the
         # router's bucket spec — one parse, no drift
         serve_cfg = (parse_serve_args(cfg.serve_args)
@@ -608,7 +682,6 @@ class Gateway:
             probe_timeout=cfg.probe_timeout,
             dead_after=cfg.dead_after, max_restarts=cfg.max_restarts,
             on_death=self._on_death, boot_grace=cfg.boot_grace)
-        self.registry = obs_metrics.MetricsRegistry()
         self.router = Router(self.replicas, registry=self.registry)
         self.registry.gauge_fn(
             "fleet.replicas_ready",
@@ -651,29 +724,13 @@ class Gateway:
         self._thread = threading.Thread(
             target=self._dispatch_loop, name="tt-fleet-dispatch",
             daemon=True)
-        try:
-            self.front = obs_http.ObsServer(
-                cfg.listen, registry=self.registry,
-                probes={"dispatcher": self._thread.is_alive},
-                handler=ApiHandler, api=GatewayApi(self),
-                site="gateway")
-        except BaseException:
-            # the listen port is taken: close() is unreachable, so the
-            # telemetry writer's worker thread (and the -o file handle
-            # it holds) must not outlive the gateway that never
-            # existed — the same constructor-failure discipline
-            # SolveService.__init__ applies (obs server there)
-            if self.writer is not None:
-                try:
-                    self.writer.close(raise_error=False)
-                except Exception:
-                    pass
-                if self._close_stream:
-                    try:
-                        self._stream.close()
-                    except Exception:
-                        pass
-            raise
+        # a taken listen port raises here — __init__'s outer guard
+        # closes every thread/handle started above
+        self.front = obs_http.ObsServer(
+            cfg.listen, registry=self.registry,
+            probes={"dispatcher": self._thread.is_alive},
+            handler=ApiHandler, api=GatewayApi(self),
+            site="gateway", history=self.history)
         self._refresh_view()
 
     # -- lifecycle ------------------------------------------------------
@@ -717,11 +774,19 @@ class Gateway:
                 self.writer.close(raise_error=False)
             except Exception:
                 pass
-            if self._close_stream:
-                try:
-                    self._stream.close()
-                except Exception:
-                    pass
+        # flight teardown AFTER the writer drains (the engine/serve
+        # ordering): a last-tick failover's faultEntry and spans must
+        # reach the tee's rings before the recorder's final poll dumps
+        # the pending trigger's bundle
+        if self.flight is not None:
+            self.flight.close()
+        if self.history is not None:
+            self.history.close()
+        if self.writer is not None and self._close_stream:
+            try:
+                self._stream.close()
+            except Exception:
+                pass
         self.front.close()
         self.replicas.close()
 
@@ -762,6 +827,36 @@ class Gateway:
         reg.gauge_fn(f"{base}.restarts",
                      lambda h=h: float(h.restarts))
 
+    def _pull_incidents(self, names) -> list:
+        """The flight recorder's peer fetch (RECORDER thread, never the
+        dispatcher — a hung replica export parks the recorder, routing
+        and settlement run on): each involved replica's newest
+        GET /v1/incident bundle, falling back to the prober's last
+        cached copy (ReplicaHandle.last_incident) when the replica is
+        already dead — the usual case at failover, and exactly the
+        "30 seconds before" evidence the cache exists for."""
+        out = []
+        for name in names:
+            handle = self.replicas.get(name)
+            if handle is None:
+                out.append((name, None, "unknown replica"))
+                continue
+            bundle, err = None, None
+            if not handle.dead:
+                try:
+                    bundle = handle.get_incident(
+                        timeout=self.cfg.snapshot_timeout)
+                except Exception as e:
+                    err = str(e)[:120]
+            if bundle is None and handle.last_incident is not None:
+                bundle = handle.last_incident
+                err = None if err is None else err + " (cached copy)"
+            if bundle is None and err is None:
+                err = ("dead, no cached bundle" if handle.dead
+                       else "no incident recorded")
+            out.append((name, bundle, err))
+        return out
+
     def _refresh_view(self) -> None:
         """Rebuild the /v1/fleet snapshot ON the dispatcher (the only
         thread mutating router/job state) and publish it under the
@@ -801,6 +896,14 @@ class Gateway:
             1.0 if burning else 0.0)
         if burning:
             self.registry.counter("fleet.slo_burns").inc()
+            if self.flight is not None:
+                # a burn START is an incident: stitch the whole live
+                # fleet's bundles — every replica is "involved" in a
+                # latency objective (the pull runs on the recorder
+                # thread; this call only enqueues)
+                self.flight.trigger(
+                    "slo_burn",
+                    peers=[h.name for h in self.replicas.live()])
         self._rec(jsonl.fault_entry, self.writer, "slo_burn",
                   "burn" if burning else "clear",
                   f"rolling p99 {p99:.3f}s vs SLO "
@@ -1271,6 +1374,12 @@ class Gateway:
             victims = [j for j in self.jobs.values()
                        if j.replica == name
                        and not (j.terminal() and j.records_final)]
+        if self.flight is not None:
+            # one stitched incident per failover: the gateway's own
+            # rings + the dead replica's last bundle (live pull when
+            # it still answers, the prober's cached copy otherwise) —
+            # enqueued here, pulled and written on the RECORDER thread
+            self.flight.trigger(f"failover:{name}", peers=[name])
         with self.tracer.span("failover", cat="fleet", replica=name,
                               jobs=len(victims),
                               flow=[j.flow for j in victims if j.flow]):

@@ -382,6 +382,14 @@ class ReplicaApi:
     def fleet_view(self):
         return 404, {"error": "not a gateway (single replica)"}
 
+    def incident_view(self):
+        """GET /v1/incident: the replica's newest flight-recorder
+        bundle, served FROM MEMORY (obs/flight.incident_response —
+        the shared wire shape; no file I/O on this handler thread).
+        404 without a recorder or before the first dump."""
+        from timetabling_ga_tpu.obs.flight import incident_response
+        return incident_response(self._r.svc.flight)
+
 
 class Replica:
     """One HTTP replica: SolveService + drive loop + `/v1` front.
@@ -451,6 +459,7 @@ class Replica:
                         "writer": self.svc.writer.alive,
                         "drive": self.driving},
                 profile=self.svc.profile_capture,
+                history=self.svc.history,
                 handler=ApiHandler, api=ReplicaApi(self)).start()
 
     @property
@@ -720,6 +729,15 @@ class ReplicaHandle:
         #                              trip (/readyz + /metrics) — the
         #                              gateway's fleet.replica.* probe
         #                              latency gauge
+        # -- tt-flight incident correlation (refreshed by probe()) ------
+        self.flight_dumps = 0.0      # the replica's incident-dump
+        #                              counter, off the SAME scrape the
+        #                              router inputs ride
+        self.last_incident = None    # newest bundle fetched when that
+        #                              counter advanced: the gateway's
+        #                              stitched bundle falls back to
+        #                              this copy when the replica is
+        #                              already dead at failover time
 
     # -- probe ----------------------------------------------------------
 
@@ -765,6 +783,27 @@ class ReplicaHandle:
         self.compile_cache_hits = obs_scrape.scalar(
             families, obs_scrape.COMPILE_HITS,
             self.compile_cache_hits)
+        # the prober's incident scrape (tt-flight): when the replica's
+        # dump counter advances — off the exposition this probe already
+        # parsed — fetch the fresh bundle and cache it on the handle,
+        # so a replica that dumps and then DIES still contributes its
+        # last pre-death bundle to the gateway's stitched incident.
+        # Same thread, same `gw_scrape` isolation contract as the rest
+        # of this method: a failure leaves the previous cached copy.
+        dumps = obs_scrape.scalar(families, obs_scrape.FLIGHT_DUMPS,
+                                  self.flight_dumps)
+        # the counter is per-incarnation: a restarted replica resets
+        # to 0, so a BACKWARD reading means "new incarnation" and any
+        # nonzero value there is a fresh bundle too (the respawn path
+        # also resets our baseline, but a static replica restarted
+        # behind our back only shows up here)
+        if dumps > self.flight_dumps \
+                or (dumps < self.flight_dumps and dumps > 0):
+            try:
+                self.last_incident = self.get_incident(timeout=timeout)
+            except Exception:
+                pass                 # keep the previous copy
+        self.flight_dumps = dumps
 
     def compile_hit_rate(self) -> float:
         total = self.compile_count + self.compile_cache_hits
@@ -813,6 +852,31 @@ class ReplicaHandle:
             f"{self.url}/v1/jobs/{urllib.parse.quote(job_id)}"
             f"{suffix}",
             timeout=timeout, ok=(200,))
+
+    def get_incident(self, timeout: float = 5.0):
+        """GET /v1/incident: the replica's newest flight-recorder
+        bundle (the inner `incident` object), or None before the first
+        dump / without a recorder."""
+        try:
+            return http_json("GET", self.url + "/v1/incident",
+                             timeout=timeout, ok=(200,)
+                             ).get("incident")
+        except FleetHTTPError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    def get_history(self, window: float | None = None,
+                    timeout: float = 5.0):
+        """GET /metrics/history[?window=S]: the replica's metrics
+        history ring as JSON (obs/history.py window payload).
+        window=0.0 means a zero-second window (empty series, like the
+        endpoint itself), not 'everything'."""
+        suffix = (f"?window={float(window)}" if window is not None
+                  else "")
+        return http_json("GET",
+                         self.url + "/metrics/history" + suffix,
+                         timeout=timeout, ok=(200,))
 
     def cancel_job(self, job_id: str, timeout: float = 5.0):
         return http_json(
@@ -940,6 +1004,13 @@ class ReplicaSet:
                 handle.fails = 0
                 handle.ok_once = False
                 handle.born = time.monotonic()
+                # fresh incarnation, fresh dump counter: without this
+                # reset the new process's first bundles (counter 1, 2,
+                # ...) would read as "below the old high-water" and
+                # never be fetched (last_incident stays — the dead
+                # incarnation's bundle IS the death's evidence until a
+                # newer one lands)
+                handle.flight_dumps = 0.0
                 respawned = True
             except Exception:
                 pass

@@ -21,6 +21,12 @@ scrapes and k8s-style probes need no sidecar at all:
              and the memory poller's near-HBM fraction — 503 flips
              exactly when the stack is shedding, degraded, or about to
              OOM
+  /metrics/history   the tt-flight history ring (obs/history.py) as
+             JSON: per-series (t, value) samples, `?window=S` bounded
+             — the windowed substrate the autoscaler primitives
+             (`rate`/`mean_over`/`sustained`) and the incident
+             bundles read; absent ring answers 404. The handler only
+             READS the ring's lock-guarded deques (TT602-pure)
   /profile   on-demand profiler trigger (?for=N): flips the cost
              observatory's ProfileCapture state and wakes ITS worker
              thread — no blocking I/O, no registry touch (TT602-pure);
@@ -207,6 +213,34 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         if path == "/metrics":
             body = self.server.registry.to_openmetrics().encode()
             self._reply(200, body, OPENMETRICS_CT)
+        elif path == "/metrics/history":
+            # tt-flight (obs/history.py): the bounded per-series
+            # sample rings as JSON — `?window=S` restricts to the last
+            # S seconds. TT602-pure by construction: window() reads
+            # the ring under ITS lock and never touches the registry
+            # (the sampler thread owns the registry reads).
+            ring = getattr(self.server, "history", None)
+            if ring is None:
+                self._reply_json(404, {"ok": False,
+                                       "reason": "no history ring "
+                                                 "wired "
+                                                 "(--history-every)"})
+                return
+            params = dict(
+                p.split("=", 1) for p in query.split("&") if "=" in p)
+            window = None
+            if "window" in params:
+                try:
+                    window = float(params["window"])
+                except ValueError:
+                    self._reply_json(400, {"ok": False,
+                                           "reason": "window must be "
+                                                     "seconds"})
+                    return
+            out = ring.window(window)
+            if window is not None:
+                out["window"] = window
+            self._reply_json(200, out)
         elif path == "/profile":
             # the cost observatory's on-demand capture trigger
             # (obs/cost.py ProfileCapture; `tt profile` is the client).
@@ -295,7 +329,7 @@ class ObsServer:
 
     def __init__(self, listen: str, registry=None, probes=None,
                  profile=None, handler=None, api=None,
-                 site: str = "obs_listen"):
+                 site: str = "obs_listen", history=None):
         host, port = parse_listen(listen)
         self._srv = _Server((host, port), handler or _Handler)
         self._srv.registry = (obs_metrics.REGISTRY if registry is None
@@ -303,6 +337,9 @@ class ObsServer:
         self._srv.probes = dict(probes or {})
         self._srv.profile = profile
         self._srv.api = api
+        # tt-flight: the obs/history.py ring /metrics/history serves
+        # (absent: 404) — handlers only READ it, like the registry
+        self._srv.history = history
         self._site = site
         self._thread = threading.Thread(
             target=self._serve, name=f"tt-{site}", daemon=True)

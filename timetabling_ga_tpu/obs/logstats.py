@@ -169,6 +169,7 @@ def summarize(records) -> str:
     faults: list = []
     jobs: dict = {}         # job id -> lifecycle events
     spans: list = []        # spanEntry bodies (per-job breakdown)
+    flight_spans: list = []  # flight_dump spans (incident section)
     routes: list = []       # routeEntry bodies (placement summary)
     compiles: list = []     # costEntry bodies (compile accounting)
     quality_recs: list = []  # whole records (obs/quality.py summarize)
@@ -193,6 +194,8 @@ def summarize(records) -> str:
         elif kind == "spanEntry":
             if body.get("job") is not None:
                 spans.append(body)
+            if body.get("name") == "flight_dump":
+                flight_spans.append(body)
         elif kind == "routeEntry":
             routes.append(body)
         elif kind == "costEntry":
@@ -326,6 +329,23 @@ def summarize(records) -> str:
             lines.append(f"  {rep}: {len(rs)} placements "
                          f"({ostr}) over {len(buckets)} "
                          f"bucket{'s' if len(buckets) != 1 else ''}")
+
+    if flight_spans:
+        # tt-flight (obs/flight.py): every `flight_dump` span is one
+        # incident bundle written — its duration is the TIME-TO-DUMP
+        # (trigger instant -> bundle on disk), the latency of the
+        # black box itself
+        lines.append(f"== incidents ({len(flight_spans)} dumps)")
+        by_trig: dict = {}
+        for s in flight_spans:
+            by_trig.setdefault(s.get("trigger", "?"), []).append(
+                max(0.0, float(s.get("dur", 0.0))))
+        for trig, durs in sorted(by_trig.items()):
+            durs.sort()
+            lines.append(
+                f"  {trig}: {len(durs)}x, time-to-dump "
+                f"p50 {_pctl(durs, 0.5):.3f}s "
+                f"p99 {_pctl(durs, 0.99):.3f}s")
 
     if compiles:
         # cost observatory (obs/cost.py): per-program compile count,

@@ -37,6 +37,11 @@ QUEUE_DEPTH = "tt_serve_queue_depth"
 BACKLOG = "tt_serve_backlog"
 COMPILE_COUNT = "tt_compile_count_total"
 COMPILE_HITS = "tt_compile_cache_hits_total"
+# tt-flight: the replica's incident-dump counter (obs/flight.py). The
+# prober watches it across probes and fetches GET /v1/incident when it
+# advances, so the gateway holds a replica's newest bundle even after
+# the replica dies — the "30 seconds before the failover" evidence
+FLIGHT_DUMPS = "tt_flight_dumps_total"
 
 # one sample line: name, optional {labels}, value, optional exemplar
 # (OpenMetrics: " # {labels} value [timestamp]")

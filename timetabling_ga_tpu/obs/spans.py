@@ -59,6 +59,12 @@ a reusable null context and `record` returns immediately — the hot
 path pays one attribute read. Nesting depth is tracked per thread, so
 serve-loop spans and engine spans never interleave their stacks.
 
+Black-box capture (tt-flight, obs/flight.py): because every span rides
+the writer as a spanEntry record, the flight recorder's stream tee
+sees them all with no tracer hook — the last spans live on in a
+byte-budget ring and ship inside incident bundles, which is how "the
+30 seconds before the failover" stays answerable after the fact.
+
 Stdlib-only: the CLI trace exporter imports this module without JAX.
 """
 

@@ -365,9 +365,24 @@ def main_trace(argv) -> int:
     if not inputs:
         raise SystemExit("usage: tt trace <log.jsonl> [more.jsonl ...]"
                          " [-o trace.json] [--job ID]")
-    doc = export_stitched(
-        [(os.path.basename(p), read_jsonl(p)) for p in inputs],
-        job=job)
+    resolved: list = []
+    for p in inputs:
+        records = read_jsonl(p)
+        # an INCIDENT BUNDLE (obs/flight.py) is accepted next to JSONL
+        # logs: its span/record rings expand into ordinary inputs — a
+        # stitched bundle contributes one process lane per member, so
+        # `tt trace gateway-bundle.json replica.jsonl` just works
+        bundle = next((r["incident"] for r in records
+                       if isinstance(r, dict)
+                       and isinstance(r.get("incident"), dict)), None)
+        if bundle is not None:
+            from timetabling_ga_tpu.obs.flight import bundle_records
+            base = os.path.basename(p)
+            for label, recs in bundle_records(bundle):
+                resolved.append((f"{base}:{label}", recs))
+        else:
+            resolved.append((os.path.basename(p), records))
+    doc = export_stitched(resolved, job=job)
     if out is None:
         out = inputs[0] + ".trace.json"
     with open(out, "w", encoding="utf-8") as fh:

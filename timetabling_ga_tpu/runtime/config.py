@@ -203,6 +203,26 @@ class RunConfig:
     #                           /readyz — a daemon-thread listener that
     #                           shares nothing with the dispatch loop
     #                           but the registry lock (None = off)
+    # ---- tt-flight (obs/history.py + obs/flight.py, README "Flight
+    # recorder & history"): windowed metrics history and automatic
+    # incident capture. The history sampler runs under
+    # --obs/--obs-listen/--incident-dir; the recorder only under
+    # --incident-dir. Both live on their own daemon threads (fault
+    # sites `history`/`flight_dump`) and the record stream is
+    # bit-identical with them on or off.
+    history_every: float = 1.0  # seconds between registry samples on
+    #                           the history ring (GET /metrics/history,
+    #                           rate/mean_over/sustained window
+    #                           queries; 0 disables the ring)
+    incident_dir: Optional[str] = None  # directory the flight recorder
+    #                           dumps incident bundles into (trigger +
+    #                           reasons, config fingerprint, registry
+    #                           snapshot, history window, span/record
+    #                           rings); None = recorder off
+    incident_min_interval: float = 30.0  # seconds between dumps: a
+    #                           reason storm produces ONE bundle, not a
+    #                           bundle storm (oldest-first retention
+    #                           under TT_INCIDENT_KEEP)
     # ---- search-quality observatory (tt-obs v4; obs/quality.py +
     # parallel/islands.py quality runners, README "Search-quality
     # observatory"): on-device diversity/operator/migration telemetry
@@ -449,6 +469,9 @@ _FLAG_MAP = {
     "--trace-mode": ("trace_mode", str),
     "--metrics-every": ("metrics_every", int),
     "--obs-listen": ("obs_listen", str),
+    "--history-every": ("history_every", float),
+    "--incident-dir": ("incident_dir", str),
+    "--incident-min-interval": ("incident_min_interval", float),
     "--stall-window": ("stall_window", int),
     "--stall-hamming": ("stall_hamming", float),
     "--max-recoveries": ("max_recoveries", int),
@@ -538,6 +561,17 @@ def _validate_obs_listen(spec) -> None:
         raise SystemExit(str(e)) from None
 
 
+def _validate_flight(cfg) -> None:
+    """Shared tt-flight flag validation (RunConfig / ServeConfig /
+    FleetConfig all carry the trio)."""
+    if cfg.history_every < 0:
+        raise SystemExit("--history-every must be >= 0 seconds "
+                         "(0 disables the metrics history ring)")
+    if cfg.incident_min_interval < 0:
+        raise SystemExit("--incident-min-interval must be >= 0 "
+                         "seconds between incident dumps")
+
+
 def _usage() -> str:
     return _format_usage(
         ["usage: python -m timetabling_ga_tpu -i <instance.tim> "
@@ -570,6 +604,7 @@ def parse_args(argv) -> RunConfig:
         raise SystemExit("--metrics-every must be >= 0 dispatches "
                          "(0 = only the end-of-try snapshot)")
     _validate_obs_listen(cfg.obs_listen)
+    _validate_flight(cfg)
     if cfg.profile_for < 0:
         raise SystemExit("--profile-for must be >= 0 dispatches "
                          "(0 = no launch-time capture)")
@@ -680,6 +715,12 @@ class ServeConfig:
     #                               with exemplars, /healthz, /readyz,
     #                               /profile) — same semantics as
     #                               RunConfig's
+    # ---- tt-flight (same semantics as RunConfig's): metrics history
+    # ring + incident flight recorder — the replica additionally
+    # serves its newest bundle at GET /v1/incident
+    history_every: float = 1.0
+    incident_dir: Optional[str] = None
+    incident_min_interval: float = 30.0
     # ---- cost observatory (obs/cost.py; same semantics as
     # RunConfig's): the device memory poller and the on-demand
     # profiler capture
@@ -759,6 +800,9 @@ _SERVE_FLAG_MAP = {
     "--trace-mode": ("trace_mode", str),
     "--metrics-every": ("metrics_every", int),
     "--obs-listen": ("obs_listen", str),
+    "--history-every": ("history_every", float),
+    "--incident-dir": ("incident_dir", str),
+    "--incident-min-interval": ("incident_min_interval", float),
     "--profile-dir": ("profile_dir", str),
     "--profile-for": ("profile_for", int),
     "--mem-poll-every": ("mem_poll_every", float),
@@ -797,6 +841,7 @@ def parse_serve_args(argv) -> ServeConfig:
         raise SystemExit("--metrics-every must be >= 0 dispatches")
     _validate_obs_listen(cfg.obs_listen)
     _validate_obs_listen(cfg.http)   # same HOST:PORT grammar
+    _validate_flight(cfg)
     if cfg.profile_for < 0:
         raise SystemExit("--profile-for must be >= 0 dispatches")
     if cfg.mem_poll_every < 0:
@@ -955,6 +1000,14 @@ class FleetConfig:
     #                                  jobs it will never place — HA
     #                                  stacks must route around it).
     #                                  0 disables the watchdog
+    # ---- tt-flight (same semantics as RunConfig's trio): the gateway
+    # additionally triggers its recorder on failover/SLO burn, pulls
+    # the involved replicas' GET /v1/incident bundles on the recorder
+    # thread, and writes ONE stitched cross-process bundle (README
+    # "Flight recorder & history")
+    history_every: float = 1.0
+    incident_dir: Optional[str] = None
+    incident_min_interval: float = 30.0
     serve_args: list = dataclasses.field(default_factory=list)
     #                                  verbatim worker flags (after --)
 
@@ -966,6 +1019,9 @@ _FLEET_FLAG_MAP = {
     "--slo-p99": ("slo_p99", float),
     "--slo-window": ("slo_window", int),
     "--stall-after": ("stall_after", float),
+    "--history-every": ("history_every", float),
+    "--incident-dir": ("incident_dir", str),
+    "--incident-min-interval": ("incident_min_interval", float),
     "--spawn": ("spawn", int),
     "--backend": ("backend", str),
     "--probe-every": ("probe_every", float),
@@ -1068,6 +1124,7 @@ def parse_fleet_args(argv) -> FleetConfig:
     if cfg.stall_after < 0:
         raise SystemExit("--stall-after must be >= 0 seconds (0 "
                          "disables the dispatcher watchdog)")
+    _validate_flight(cfg)
     # the worker flags must themselves parse (a typo would otherwise
     # only surface as N crashed spawns); the parsed copy also gives
     # the gateway its bucket spec, so router and workers agree

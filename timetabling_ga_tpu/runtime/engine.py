@@ -1148,6 +1148,8 @@ def run(cfg: RunConfig, out=None) -> int:
     obs_srv = None
     mem_poller = None
     prof_cap = None
+    hist_ring = None
+    flight = None
     try:
         # all record emission (and checkpoint serialization, via
         # submit()) rides the background writer thread so the dispatch
@@ -1157,12 +1159,25 @@ def run(cfg: RunConfig, out=None) -> int:
         # telemetry failure must not REPLACE the run's own exception
         # (retry logic matches on the propagating error), so close()
         # only re-raises when nothing else is in flight.
-        writer = jsonl.AsyncWriter(out)
+        # tt-flight (obs/history.py + obs/flight.py): the history
+        # sampler rides its own daemon thread whenever any obs surface
+        # is on; the incident recorder tees the record stream (on the
+        # WRITER thread — ingestion costs nothing on the dispatch
+        # path) and dumps bundles from ITS own thread. Both are
+        # die/hang-isolated (fault sites `history`/`flight_dump`) and
+        # the JSONL stream is bit-identical with them on or off.
+        from timetabling_ga_tpu.obs import flight as obs_flight
+        hist_ring, flight, sink = obs_flight.wire(cfg, out,
+                                                  process="engine")
+        writer = jsonl.AsyncWriter(sink)
         # obs wiring: the span tracer emits through the SAME writer
         # (spans are telemetry; the writer thread serializes them), and
         # the registry's writer gauges re-bind to THIS run's writer —
         # pull gauges, sampled at snapshot time
         tracer = SpanTracer(writer, enabled=cfg.obs)
+        if flight is not None:
+            flight.bind_tracer(tracer)
+            flight.start()
         obs_metrics.REGISTRY.gauge_fn("writer.queue_depth", writer.qsize)
         obs_metrics.REGISTRY.gauge_fn(
             "writer.records", lambda: writer.records_written)
@@ -1201,7 +1216,7 @@ def run(cfg: RunConfig, out=None) -> int:
                 cfg.obs_listen,
                 probes={"process": lambda: True,
                         "writer": writer.alive},
-                profile=prof_cap).start()
+                profile=prof_cap, history=hist_ring).start()
         try:
             ret = _run_tries(cfg, writer, tracer, profiler=prof_cap)
         except BaseException:
@@ -1216,6 +1231,13 @@ def run(cfg: RunConfig, out=None) -> int:
             prof_cap.close()
         if mem_poller is not None:
             mem_poller.close()
+        # tt-flight teardown AFTER the listener (no handler may race a
+        # closing ring) and BEFORE the fault-plan uninstall (the
+        # recorder thread's sites must stay deterministic to the end)
+        if flight is not None:
+            flight.close()
+        if hist_ring is not None:
+            hist_ring.close()
         # unbind the observatory's costEntry emitter: the global must
         # not hold this run's writer (same rule as the pull gauges)
         obs_cost.OBSERVATORY.unbind()

@@ -64,9 +64,14 @@ settlement never waits on it; tests/test_fleet_obs.py pins both),
 fault-recovery window: affected jobs requeue from their park
 snapshots), `snapshot_ship` (once per `?snapshot=1` export on a
 replica handler thread — a hung export parks one handler, never the
-drive loop or writer) and `resume` (once per warm-start snapshot
+drive loop or writer), `resume` (once per warm-start snapshot
 admission — any failure falls back to a fresh replay;
-tests/test_resume.py pins the triad).
+tests/test_resume.py pins the triad), `history` (once per registry
+sample on the tt-flight history sampler thread — obs/history.py) and
+`flight_dump` (once per incident-dump attempt on the flight recorder
+thread — obs/flight.py; both share the mem_poll isolation contract:
+a hung or dead sampler/dumper never stalls dispatch, settlement, or
+writer drain — tests/test_flight.py pins it).
 
 The plan is installed per engine.run call (`install`), which resets the
 per-site counters — invocation indices are deterministic within one
@@ -133,10 +138,17 @@ ACTIONS = ("unavailable", "hang", "die", "truncate", "error")
 # including an injected die — falls back to a fresh solve (replay)
 # with a faultEntry, so a poisoned snapshot can reject, never stall,
 # the service.
+# The tt-flight pair (tests/test_flight.py pins both): `history` fires
+# once per registry sample on the obs/history.py sampler thread (the
+# mem_poll discipline — a hang parks the sampler, history goes stale,
+# nothing else notices; a die ends it silently) and `flight_dump`
+# once per incident-dump attempt on the obs/flight.py recorder thread
+# (a hang parks the recorder — no bundle materializes; a die ends it —
+# dispatch, settlement, and writer drain never wait on either).
 SITES = ("dispatch", "fetch", "writer", "ckpt", "init", "obs_listen",
          "scrape", "mem_poll", "profile", "gateway", "route",
          "gw_writer", "gw_scrape", "quantum", "snapshot_ship",
-         "resume")
+         "resume", "history", "flight_dump")
 
 
 class FaultInjected(Exception):

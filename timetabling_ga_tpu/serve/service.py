@@ -91,10 +91,23 @@ class SolveService:
             else:
                 out = sys.stdout
         self._raw_out = out
-        self.writer = jsonl.AsyncWriter(out)
+        # tt-flight, mirroring engine.run's wiring: the history
+        # sampler on its own daemon thread, the incident recorder
+        # teeing the record stream (writer-thread ingestion; fault
+        # sites `history`/`flight_dump`; the stream is bit-identical
+        # with both on or off). The recorder reports into THIS
+        # service's registry, so N in-process replicas keep separate
+        # incident truths like they keep separate /readyz truths.
+        from timetabling_ga_tpu.obs import flight as obs_flight
+        self.history, self.flight, sink = obs_flight.wire(
+            cfg, out, registry=self._registry, process="serve")
+        self.writer = jsonl.AsyncWriter(sink)
         # obs wiring, mirroring engine.run's: spans ride the writer,
         # the registry's writer gauges re-bind to this service's writer
         self.tracer = SpanTracer(self.writer, enabled=cfg.obs)
+        if self.flight is not None:
+            self.flight.bind_tracer(self.tracer)
+            self.flight.start()
         self._registry.gauge_fn("writer.queue_depth",
                                 self.writer.qsize)
         self._registry.gauge_fn(
@@ -138,7 +151,8 @@ class SolveService:
                     cfg.obs_listen, registry=self._registry,
                     probes={"process": lambda: True,
                             "writer": self.writer.alive},
-                    profile=self.profile_capture).start()
+                    profile=self.profile_capture,
+                    history=self.history).start()
             except BaseException:
                 # a failed construction (e.g. the port is taken) never
                 # reaches close(): the observatory threads started
@@ -152,6 +166,10 @@ class SolveService:
                     self.profile_capture.close()
                 if self.mem_poller is not None:
                     self.mem_poller.close()
+                if self.flight is not None:
+                    self.flight.close()
+                if self.history is not None:
+                    self.history.close()
                 obs_cost.OBSERVATORY.unbind()
                 self.writer.close(raise_error=False)
                 self._registry.freeze(
@@ -250,11 +268,17 @@ class SolveService:
         try:
             self.writer.close()
         finally:
-            # same unbind as engine.run's finally — and like there it
-            # must run even when close() re-raises a latched writer
+            # flight teardown AFTER the writer drains (the tee's last
+            # records land in the rings; a pending trigger's final
+            # dump happens in flight.close) — and, like the unbind
+            # below, even when close() re-raises a latched writer
             # error: drop the process-global registry's closures over
             # this service's writer and queue (and the observatory's
             # costEntry emitter, which holds the same writer)
+            if self.flight is not None:
+                self.flight.close()
+            if self.history is not None:
+                self.history.close()
             from timetabling_ga_tpu.obs import cost as obs_cost
             obs_cost.OBSERVATORY.unbind()
             self._registry.freeze(

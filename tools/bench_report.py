@@ -75,6 +75,11 @@ _METRICS = [
     ("fleet affinity", "fleet", "affinity_hit_rate"),
     ("fleet jobs/min obs", "fleet", "jobs_per_min_2rep_obs"),
     ("gateway obs ms/job", "fleet", "gateway_overhead_ms_per_job"),
+    ("flight ms/dispatch", "flight",
+     "flight_overhead_ms_per_dispatch"),
+    ("flight dump p50 s", "flight", "dump_p50_s"),
+    ("flight ring hw B", "flight", "span_ring_bytes_hw"),
+    ("flight bundles", "flight", "bundles_written"),
 ]
 
 _NUM = r"(-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)"

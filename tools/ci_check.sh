@@ -57,6 +57,13 @@ if [ "${1:-}" = "--fast" ]; then
     step "fleet resume tests (tests/test_resume.py)"
     timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_resume.py -q -p no:cacheprovider || fail=1
+    # the flight e2e acceptance is tier-marked slow (a full
+    # gateway+2-replica kill scenario); fast mode runs the
+    # unit/endpoint/isolation tier
+    step "flight recorder tests (tests/test_flight.py)"
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_flight.py -q -p no:cacheprovider -m 'not slow' \
+        || fail=1
     [ "$fail" -eq 0 ] && step "OK (fast mode: full test tier skipped)"
     exit $fail
 fi
