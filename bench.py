@@ -788,6 +788,101 @@ def measure_serve() -> dict:
     }
 
 
+def measure_usage() -> dict:
+    """extra.usage leg (tt-meter, README "Usage metering"): same-seed
+    serve stream with metering OFF vs ON — the meter's cost and its
+    two pinned contracts on a live stream:
+
+      overhead ms/dispatch    wall-time delta per dispatch (the drive
+                              loop pays dict arithmetic + one bounded
+                              deque append; the folds ride the ledger
+                              thread)
+      conservation            every emitted per-dispatch usageEntry's
+                              lane shares sum EXACTLY to its dispatch
+                              totals (obs/usage.split)
+      records identical       strip_timing streams match with metering
+                              on or off (usageEntry is TIMING)
+    """
+    import dataclasses
+    import io
+    import json as _json
+
+    from timetabling_ga_tpu.obs import usage as obs_usage
+    from timetabling_ga_tpu.problem import random_instance
+    from timetabling_ga_tpu.runtime import jsonl
+    from timetabling_ga_tpu.runtime.config import ServeConfig
+    from timetabling_ga_tpu.serve.service import SolveService
+
+    shapes = [(100, 8, 60), (120, 7, 50), (90, 8, 55), (70, 6, 64),
+              (110, 8, 60), (40, 4, 30)]
+    problems = [random_instance(1000 + i, n_events=e, n_rooms=r,
+                                n_features=4, n_students=s,
+                                attend_prob=0.05)
+                for i, (e, r, s) in enumerate(shapes)]
+    gens = 60
+    base = ServeConfig(lanes=4, quantum=15, pop_size=16, max_steps=32,
+                       obs=True, metrics_every=0)
+
+    from timetabling_ga_tpu.obs.metrics import MetricsRegistry
+
+    def leg(usage):
+        buf = io.StringIO()
+        # a PRIVATE registry per leg: the dispatch count must be this
+        # leg's own, not the process-cumulative one
+        svc = SolveService(dataclasses.replace(base, usage=usage),
+                           out=buf, registry=MetricsRegistry())
+        t0 = time.perf_counter()
+        for i, p in enumerate(problems):
+            svc.submit(p, job_id=f"u{i}", seed=i, generations=gens,
+                       tenant=f"tenant{i % 3}")
+        svc.drive()
+        wall = time.perf_counter() - t0
+        dispatches = svc.registry.counter("serve.dispatches").value
+        svc.close()
+        lines = [_json.loads(x) for x in buf.getvalue().splitlines()]
+        return {"wall": wall, "dispatches": int(dispatches),
+                "entries": [x["usageEntry"] for x in lines
+                            if "usageEntry" in x],
+                "recs": jsonl.strip_timing(lines)}
+
+    leg(False)      # warm-up: both clocked legs ride warm bucket
+    #                 programs, so the delta prices the METER, not a
+    #                 compile (the measure_fleet discipline)
+    off = leg(False)
+    on = leg(True)
+
+    # conservation: every dispatch entry's lane shares sum EXACTLY to
+    # its totals, for each conserved component
+    disp_entries = [e for e in on["entries"] if "lanes" in e]
+    conserved = bool(disp_entries) and all(
+        sum(lane[f] for lane in e["lanes"]) == e[f]
+        for e in disp_entries
+        for f in ("gens", "device_seconds", "compile_seconds", "flops"))
+    report = obs_usage.fold_entries(
+        [{"usageEntry": e} for e in on["entries"]])
+    out = {
+        "jobs": len(problems), "gens_per_job": gens,
+        "dispatches": on["dispatches"],
+        "wall_s_usage_off": round(off["wall"], 3),
+        "wall_s_usage_on": round(on["wall"], 3),
+        "usage_overhead_ms_per_dispatch": round(
+            (on["wall"] - off["wall"]) / max(1, on["dispatches"])
+            * 1e3, 3),
+        "usage_entries": len(on["entries"]),
+        "tenants_metered": len(report["tenants"]),
+        "conservation_holds": conserved,
+        "records_identical_modulo_timing": off["recs"] == on["recs"],
+    }
+    print(f"# usage A/B ({out['dispatches']} dispatches): "
+          f"{out['wall_s_usage_off']}s off vs "
+          f"{out['wall_s_usage_on']}s on "
+          f"({out['usage_overhead_ms_per_dispatch']} ms/dispatch, "
+          f"{out['usage_entries']} usageEntry); conservation="
+          f"{out['conservation_holds']}, records identical="
+          f"{out['records_identical_modulo_timing']}", file=sys.stderr)
+    return out
+
+
 def measure_soak() -> dict:
     """extra.soak leg (ISSUE 7): ROADMAP item 3's 'heavy traffic' as
     MEASURED numbers — a sustained mixed-stream of jobs arriving in
@@ -1663,6 +1758,7 @@ def main(argv=None) -> None:
             ("quality", lambda: measure_quality(problem)),
             ("flight", lambda: measure_flight(problem)),
             ("serve", measure_serve),
+            ("usage", measure_usage),
             ("soak", measure_soak),
             ("fleet", measure_fleet),
             ("resume", measure_resume),

@@ -1,0 +1,61 @@
+"""TT607 fixture: usage-ledger mutation / wall-clock metering off its
+home threads.
+
+Not imported or executed — parsed by tests/test_analysis.py. The
+tt-meter contract (obs/usage.py): the ledger is fed from the
+scheduler's park fence and folded on its own thread; HTTP handlers
+(and the fleet fronts' *Api surfaces) only READ the meter, and never
+read wall clocks to meter where requests land.
+"""
+import http.server
+import time
+
+import jax
+
+
+@jax.jit
+def traced_meter(x, ledger):
+    ledger.dispatch({"gens": 1})                     # EXPECT TT607
+    return x * 2
+
+
+def traced_lambda_site(xs, usage):
+    return jax.vmap(lambda x: usage.final("j", "t", {}) or x)(xs)  # EXPECT TT607
+
+
+class UsageHandler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        t0 = time.monotonic()                        # EXPECT TT607
+        self.server.usage.job("j1", "acme")          # EXPECT TT607
+        self._meter(t0)
+
+    def _meter(self, t0):
+        # reachable via self._meter() from do_GET — still the handler
+        # path; metering clocks belong to the drive loop's fences
+        dt = time.perf_counter() - t0                # EXPECT TT607
+        self.server.ledger.final("j1", "acme", {"s": dt})  # EXPECT TT607
+
+    def do_HEAD(self):
+        # OK: reading the meter is exactly what a handler is for
+        totals = self.server.usage.totals()
+        self.wfile.write(str(totals).encode())
+
+
+class MeterApi:
+    # a fleet-front api surface (handler-api-suffixes root): its
+    # methods run ON handler threads even without do_* names
+    def usage_view(self):
+        return 200, {"tenants": self._ledger.totals()}   # OK: read
+
+    def accept_solve(self, payload):
+        self._ledger.job(payload["id"], payload["tenant"])  # EXPECT TT607
+        return 202, {"id": payload["id"]}
+
+
+def drive_loop_fence_is_fine(ledger, jobs, now):
+    # OK: not a trace target, not a handler path — the scheduler's
+    # park fence is the sanctioned feed point, and its clock reads
+    # are the fence brackets themselves
+    t0 = now()
+    ledger.dispatch({"gens": 5, "lanes": []})
+    ledger.final("j", "t", {"queue_seconds": now() - t0})

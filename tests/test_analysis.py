@@ -86,6 +86,9 @@ def analyze_fixture(fixture: str):
     "viol_flight.py",      # TT606 bundle serialization in dispatch
     #                        loops / trace targets + flight-recorder
     #                        dump triggers on handler paths (tt-flight)
+    "viol_usage.py",       # TT607 usage-ledger mutation in trace
+    #                        targets / handler paths + handler-side
+    #                        metering clocks (tt-meter)
 ])
 def test_rule_fires_at_expected_lines(fixture):
     """Each rule family fires exactly at the marked (rule, line) pairs —

@@ -1,0 +1,655 @@
+"""tt-meter (ISSUE 14): per-job / per-tenant usage metering and
+capacity attribution, fleet-wide.
+
+The acceptance properties pinned here:
+
+  1. CONSERVATION — `obs/usage.split` shares sum BIT-EXACTLY to the
+     quantized total (in float and through JSON), and every emitted
+     per-dispatch usageEntry's lane shares sum to its dispatch totals
+     for each conserved component;
+  2. IDENTITY — the record stream is identical with metering on or
+     off (usageEntry is a TIMING record);
+  3. CONTINUITY — a job resumed from a shipped snapshot CONTINUES its
+     meter (the wire usage cursor): its settle total equals an
+     uninterrupted solve's deterministic components, while the
+     survivor's ledger counts only its own deltas;
+  4. ISOLATION — a dead or hung ledger (fault site `usage`) never
+     stalls dispatch, settlement, or writer drain;
+  5. FLEET — replicas serve GET /v1/usage, the gateway aggregates
+     fleet-wide (a dead replica's last-scraped ledger included), and
+     a killed-and-resumed job's tenant totals on the gateway match an
+     uninterrupted solve's modulo the re-run quantum;
+  6. RENDERING — `tt usage` (logs + --json) and `tt stats`'s
+     `== usage` section.
+"""
+
+import io
+import json
+import random
+import time
+
+import pytest
+
+from timetabling_ga_tpu.fleet.gateway import _PAYLOAD_KEYS, Gateway
+from timetabling_ga_tpu.fleet.replicas import (
+    http_json, in_process_replica)
+from timetabling_ga_tpu.obs import usage as obs_usage
+from timetabling_ga_tpu.obs.metrics import MetricsRegistry
+from timetabling_ga_tpu.problem import dump_tim, random_instance
+from timetabling_ga_tpu.runtime import faults, jsonl
+from timetabling_ga_tpu.runtime.config import (
+    FleetConfig, ServeConfig, parse_serve_args)
+from timetabling_ga_tpu.serve.service import SolveService
+
+_PA = random_instance(71, n_events=12, n_rooms=3, n_features=2,
+                      n_students=8, attend_prob=0.2)
+_PB = random_instance(72, n_events=40, n_rooms=4, n_features=2,
+                      n_students=30, attend_prob=0.1)
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+def _serve_cfg(**kw):
+    kw.setdefault("backend", "cpu")
+    kw.setdefault("lanes", 2)
+    kw.setdefault("quantum", 5)
+    kw.setdefault("pop_size", 4)
+    kw.setdefault("max_steps", 8)
+    return ServeConfig(**kw)
+
+
+def _fleet_cfg(urls, **kw):
+    kw.setdefault("listen", "127.0.0.1:0")
+    kw.setdefault("probe_every", 0.1)
+    kw.setdefault("poll_every", 0.05)
+    kw.setdefault("dead_after", 2)
+    return FleetConfig(replicas=list(urls), **kw)
+
+
+def _records(buf):
+    return [json.loads(x) for x in buf.getvalue().splitlines()]
+
+
+def _dispatch_entries(recs):
+    return [r["usageEntry"] for r in recs
+            if "usageEntry" in r and "lanes" in r["usageEntry"]]
+
+
+# ------------------------------------------------------------- unit tier
+
+
+def test_split_conservation_and_proportionality():
+    rng = random.Random(7)
+    for _ in range(2000):
+        n = rng.randint(1, 8)
+        total = rng.choice([rng.uniform(0, 1), rng.uniform(0, 1e9),
+                            rng.uniform(0, 1e16),
+                            float(rng.randint(0, 10 ** 12))])
+        ws = [rng.choice([0, rng.randint(0, 100)]) for _ in range(n)]
+        qt, shares = obs_usage.split(total, ws)
+        # bit-exact, in float AND through a JSON round trip
+        assert sum(shares) == qt
+        assert sum(json.loads(json.dumps(shares))) \
+            == json.loads(json.dumps(qt))
+        # quantization error lands on the total once, sub-quantum
+        assert abs(qt - total) <= max(obs_usage.QUANTUM,
+                                      abs(total) / 2 ** 50)
+        # zero-weight lanes get zero (unless every weight is zero)
+        if any(ws):
+            for w, s in zip(ws, shares):
+                if w == 0:
+                    assert s == 0.0
+    # integer grid (FLOPs): totals preserved exactly
+    qt, shares = obs_usage.split(7.0, [1, 1, 1], quantum=1.0)
+    assert qt == 7.0 and sum(shares) == 7.0
+    assert sorted(shares) == [2.0, 2.0, 3.0]
+    # proportionality on the integer grid
+    qt, shares = obs_usage.split(800.0, [3, 5], quantum=1.0)
+    assert shares == [300.0, 500.0]
+    # degenerate shapes
+    assert obs_usage.split(5.0, []) == (0.0, [])
+    qt, shares = obs_usage.split(10.0, [0, 0])
+    assert qt == 10.0 and sum(shares) == 10.0   # even split fallback
+
+
+def test_tenant_label():
+    assert obs_usage.tenant_label(None) == "default"
+    assert obs_usage.tenant_label("") == "default"
+    assert obs_usage.tenant_label("  ") == "default"
+    assert obs_usage.tenant_label("acme") == "acme"
+    assert obs_usage.tenant_label("bob corp!") == "bob_corp_"
+    assert len(obs_usage.tenant_label("x" * 200)) == 64
+
+
+def _lane(job, tenant, **kw):
+    d = obs_usage.new_usage()
+    d.update(kw)
+    return {"job": job, "tenant": tenant, **d}
+
+
+def test_ledger_units():
+    reg = MetricsRegistry()
+    buf = io.StringIO()
+    ledger = obs_usage.UsageLedger(registry=reg, out=buf,
+                                   now=lambda: 1.5)
+    ledger.job("j1", "acme")
+    ledger.job("j2", "acme")
+    ledger.job("j3", "zeta")
+    ledger.dispatch({
+        "dispatch": 0, "gens": 8, "device_seconds": 1.0,
+        "compile_seconds": 0.5, "flops": 100.0,
+        "lanes": [_lane("j1", "acme", gens=5, dispatches=1,
+                        device_seconds=0.625, compile_seconds=0.3125,
+                        flops=62.5, queue_seconds=0.25),
+                  _lane("j3", "zeta", gens=3, dispatches=1,
+                        device_seconds=0.375, compile_seconds=0.1875,
+                        flops=37.5, park_seconds=0.5)]})
+    ledger.final("j1", "acme", {"gens": 5, "dispatches": 1,
+                                "device_seconds": 0.625, "flops": 62.5})
+    assert ledger.drain()
+    totals = ledger.totals()
+    assert totals["acme"]["jobs"] == 2
+    assert totals["acme"]["gens"] == 5
+    assert totals["acme"]["device_seconds"] == 0.625
+    assert totals["zeta"]["jobs"] == 1
+    assert totals["zeta"]["park_seconds"] == 0.5
+    # live counters (what obs/history.py samples for demand curves)
+    assert reg.counter("usage.tenant.acme.gens").value == 5
+    assert reg.counter("usage.tenant.acme.jobs").value == 2
+    assert reg.counter("usage.tenant.zeta.flops").value == 37.5
+    assert reg.counter("usage.dispatches").value == 1
+    ledger.close()
+    recs = _records(buf)
+    assert len(_dispatch_entries(recs)) == 1
+    tot = [r["usageEntry"] for r in recs
+           if r.get("usageEntry", {}).get("event") == "total"]
+    assert tot and tot[0]["job"] == "j1" and tot[0]["gens"] == 5
+    assert tot[0]["ts"] == 1.5
+
+
+def test_fold_entries_render_and_aggregate():
+    buf = io.StringIO()
+    ledger = obs_usage.UsageLedger(registry=MetricsRegistry(), out=buf)
+    ledger.dispatch({
+        "dispatch": 0, "gens": 8, "device_seconds": 1.0,
+        "compile_seconds": 0.0, "flops": 100.0,
+        "lanes": [_lane("j1", "acme", gens=5, device_seconds=0.625,
+                        flops=62.5, dispatches=1),
+                  _lane("j2", "zeta", gens=3, device_seconds=0.375,
+                        flops=37.5, dispatches=1)]})
+    ledger.final("j1", "acme", {"gens": 10, "flops": 125.0,
+                                "dispatches": 2})
+    ledger.drain()
+    ledger.close()
+    report = obs_usage.fold_entries(_records(buf))
+    # the settle total overrides the job's delta sum (authoritative,
+    # cumulative across incarnations)
+    assert report["jobs"]["j1"]["usage"]["gens"] == 10
+    assert report["jobs"]["j2"]["usage"]["gens"] == 3
+    # tenant totals come from the deltas (each metered exactly once)
+    assert report["tenants"]["acme"]["gens"] == 5
+    assert report["tenants"]["acme"]["jobs"] == 1
+    text = obs_usage.render(report)
+    assert "== usage by tenant" in text and "acme" in text
+    assert "j2 (zeta)" in text
+    # tenant filter
+    only = obs_usage.render(report, tenant="zeta")
+    assert "acme" not in only and "zeta" in only
+
+    # fleet aggregation: tenants SUM, jobs take the highest-progress
+    # view, a dead replica's cached payload still contributes
+    p0 = {"tenants": {"acme": dict(obs_usage.new_usage(), jobs=1,
+                                   gens=10, flops=50.0)},
+          "jobs": {"r": {"tenant": "acme", "state": "preempted",
+                         "gens": 10,
+                         "usage": dict(obs_usage.new_usage(),
+                                       gens=10)}}}
+    p1 = {"tenants": {"acme": dict(obs_usage.new_usage(), jobs=0,
+                                   gens=30, flops=150.0)},
+          "jobs": {"r": {"tenant": "acme", "state": "done",
+                         "gens": 40,
+                         "usage": dict(obs_usage.new_usage(),
+                                       gens=40)}}}
+    agg = obs_usage.aggregate([("r0", True, p0), ("r1", False, p1),
+                               ("r2", False, None)])
+    assert agg["tenants"]["acme"]["gens"] == 40
+    assert agg["tenants"]["acme"]["flops"] == 200.0
+    assert agg["tenants"]["acme"]["jobs"] == 1
+    assert agg["jobs"]["r"]["usage"]["gens"] == 40
+    assert agg["jobs"]["r"]["replica"] == "r1"
+    assert agg["replicas"]["r0"]["dead"] is True
+    assert agg["replicas"]["r2"]["scraped"] is False
+
+
+def test_ledger_tenant_cardinality_cap():
+    """The tenant tag is client-controlled: past TENANTS_CAP distinct
+    labels, NEW tenants fold into the shared overflow bucket — still
+    metered and conserved, honestly counted, never unbounded."""
+    reg = MetricsRegistry()
+    ledger = obs_usage.UsageLedger(registry=reg, tenants_cap=2)
+    for i, tenant in enumerate(("t0", "t1", "t2", "t3")):
+        ledger.job(f"j{i}", tenant)
+        ledger.dispatch({"dispatch": i, "gens": 1,
+                         "device_seconds": 0.0, "compile_seconds": 0.0,
+                         "flops": 0.0,
+                         "lanes": [_lane(f"j{i}", tenant, gens=1,
+                                         dispatches=1)]})
+    ledger.drain()
+    ledger.close()
+    totals = ledger.totals()
+    assert set(totals) == {"t0", "t1", obs_usage.OVERFLOW_TENANT}
+    assert totals[obs_usage.OVERFLOW_TENANT]["jobs"] == 2
+    assert totals[obs_usage.OVERFLOW_TENANT]["gens"] == 2
+    # nothing lost: the fold conserves the fleet-wide sums
+    assert sum(t["gens"] for t in totals.values()) == 4
+    assert reg.counter("usage.tenant_overflow").value > 0
+    assert reg.counter(
+        f"usage.tenant.{obs_usage.OVERFLOW_TENANT}.gens").value == 2
+
+
+def test_respawned_replica_keeps_dead_incarnations_ledger():
+    """A respawned worker answers /v1/usage with a fresh, near-empty
+    ledger; the handle folds the dead incarnation's last scrape into
+    `usage_base` so the gateway's bill never loses metered work."""
+    from timetabling_ga_tpu.fleet.replicas import (ReplicaHandle,
+                                                   ReplicaSet)
+    h = ReplicaHandle("r0", "http://127.0.0.1:1",
+                      respawn=lambda: None)
+    h.last_usage = {
+        "tenants": {"acme": dict(obs_usage.new_usage(), jobs=1,
+                                 gens=150, flops=50.0)},
+        "jobs": {"j": {"tenant": "acme", "state": "running",
+                       "gens": 150,
+                       "usage": dict(obs_usage.new_usage(),
+                                     gens=150)}}}
+    rs = ReplicaSet([h], max_restarts=1)
+    rs._declare_dead(h)
+    assert not h.dead and h.restarts == 1      # respawned, not dead
+    assert h.last_usage is None                # fresh incarnation
+    assert h.usage_payload()["tenants"]["acme"]["gens"] == 150
+    # the new incarnation's scrape ADDS to the retired history
+    h.last_usage = {
+        "tenants": {"acme": dict(obs_usage.new_usage(), jobs=0,
+                                 gens=450, flops=150.0)},
+        "jobs": {"j": {"tenant": "acme", "state": "done", "gens": 600,
+                       "usage": dict(obs_usage.new_usage(),
+                                     gens=600)}}}
+    merged = h.usage_payload()
+    assert merged["tenants"]["acme"]["gens"] == 600
+    assert merged["tenants"]["acme"]["jobs"] == 1
+    # per-job: highest-progress view wins, never the sum
+    assert merged["jobs"]["j"]["usage"]["gens"] == 600
+
+
+def test_resubmit_header_does_not_rebill_job():
+    """A gateway RESEND (X-TT-Resubmit — failover replay/resume)
+    admits and METERS the job but never re-counts it in the tenant's
+    `jobs` ledger: the first admission already billed it."""
+    rep, h = in_process_replica(_serve_cfg(http="127.0.0.1:0"), "rs0")
+    try:
+        http_json("POST", h.url + "/v1/solve",
+                  {"tim": dump_tim(_PA), "id": "rj", "seed": 3,
+                   "generations": 10, "tenant": "acme"},
+                  headers={"X-TT-Resubmit": "1"})
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            v = http_json("GET", h.url + "/v1/jobs/rj?records=0",
+                          ok=(200,))
+            if v.get("state") in ("done", "failed"):
+                break
+            time.sleep(0.1)
+        assert v["state"] == "done"
+        payload = http_json("GET", h.url + "/v1/usage", ok=(200,))
+        acme = payload["tenants"]["acme"]
+        assert acme["gens"] == 10          # work metered as usual
+        assert acme["jobs"] == 0           # but never re-billed
+        assert payload["jobs"]["rj"]["usage"]["gens"] == 10
+    finally:
+        rep.kill()
+
+
+# ------------------------------------------------------------ serve tier
+
+
+def test_serve_ab_identity_and_conservation():
+    """Metering on vs off: strip_timing streams identical; the on
+    leg's usageEntry dispatch records conserve every component; the
+    unequal-gens pack splits proportionally."""
+    jobs = [("a", _PA, 3, 3, "acme"), ("b", _PA, 4, 10, "acme"),
+            ("c", _PB, 5, 10, "zeta")]
+
+    def leg(usage):
+        buf = io.StringIO()
+        svc = SolveService(_serve_cfg(obs=True, usage=usage), out=buf,
+                           registry=MetricsRegistry())
+        for jid, p, seed, gens, tenant in jobs:
+            svc.submit(p, job_id=jid, seed=seed, generations=gens,
+                       tenant=tenant)
+        svc.drive()
+        svc.close()
+        return svc, _records(buf)
+
+    svc_off, recs_off = leg(False)
+    svc_on, recs_on = leg(True)
+    assert jsonl.strip_timing(recs_off) == jsonl.strip_timing(recs_on)
+    assert not _dispatch_entries(recs_off)
+    disp = _dispatch_entries(recs_on)
+    assert disp
+    for u in disp:
+        for f in ("gens", "device_seconds", "compile_seconds",
+                  "flops"):
+            assert sum(lane[f] for lane in u["lanes"]) == u[f], (f, u)
+    # the packed a+b dispatch (gens 3 vs 5) splits flops 3:5 on the
+    # integer grid
+    packed = next(u for u in disp if len(u["lanes"]) == 2
+                  and {x["job"] for x in u["lanes"]} == {"a", "b"})
+    by_job = {x["job"]: x for x in packed["lanes"]}
+    assert by_job["a"]["gens"] == 3 and by_job["b"]["gens"] == 5
+    if packed["flops"]:
+        assert by_job["a"]["flops"] \
+            == obs_usage.split(packed["flops"], [3, 5],
+                               quantum=1.0)[1][0]
+    # results: the meter travels with the result only when metering on
+    assert "usage" not in svc_off.queue.get("a").result
+    res = svc_on.queue.get("b").result
+    assert res["tenant"] == "acme" and res["usage"]["gens"] == 10
+    # tenant ledgers: gens are deterministic and exact
+    totals = svc_on.usage.totals()
+    assert totals["acme"]["gens"] == 13 and totals["acme"]["jobs"] == 2
+    assert totals["zeta"]["gens"] == 10 and totals["zeta"]["jobs"] == 1
+    # the per-tenant counters live in the registry (what the history
+    # ring samples into autoscaler demand curves)
+    snap = svc_on.registry.snapshot()
+    assert snap["counters"]["usage.tenant.acme.gens"] == 13
+    assert snap["counters"]["usage.tenant.zeta.jobs"] == 1
+
+
+def test_resume_meter_continuity():
+    """The snapshot wire's usage cursor: a resumed job CONTINUES its
+    meter — settle totals match an uninterrupted solve's deterministic
+    components — while the survivor's ledger counts only its own
+    deltas (fleet sums never double count)."""
+    base_svc = SolveService(_serve_cfg(), out=io.StringIO(),
+                            registry=MetricsRegistry())
+    base_svc.submit(_PA, job_id="r", seed=3, generations=20,
+                    tenant="acme")
+    base_svc.drive()
+    base_svc.close()
+    base_usage = base_svc.queue.get("r").result["usage"]
+    assert base_usage["gens"] == 20
+
+    svc1 = SolveService(_serve_cfg(), out=io.StringIO(),
+                        registry=MetricsRegistry())
+    svc1.submit(_PA, job_id="r", seed=3, generations=20,
+                tenant="acme")
+    svc1.step()
+    svc1.step()
+    ship = svc1.queue.get("r").ship
+    wire = json.loads(json.dumps(ship.pack()))
+    svc1.close()
+    assert wire["usage"]["gens"] == 10     # the cursor rides the wire
+
+    svc2 = SolveService(_serve_cfg(), out=io.StringIO(),
+                        registry=MetricsRegistry())
+    svc2.submit(_PA, job_id="r", seed=3, generations=20,
+                snapshot=wire, tenant="acme")
+    job = svc2.queue.get("r")
+    assert job.usage["gens"] == 10         # seeded, not reset
+    svc2.drive()
+    svc2.close()
+    res = svc2.queue.get("r").result
+    assert res["usage"]["gens"] == base_usage["gens"]
+    assert res["usage"]["flops"] == base_usage["flops"]
+    assert res["usage"]["dispatches"] == base_usage["dispatches"]
+    # the survivor's LEDGER has only the post-resume half, and did NOT
+    # re-count the job (resumed admissions skip the jobs counter)
+    totals = svc2.usage.totals()
+    assert totals["acme"]["gens"] == 10
+    assert totals["acme"]["jobs"] == 0
+
+
+@pytest.mark.parametrize("action", ["die", "hang"])
+def test_ledger_fault_isolation(action):
+    """Fault site `usage`: a dead or hung ledger never stalls
+    dispatch, settlement, or writer drain — jobs finish, the stream
+    completes, and the INLINE per-job meter (the drive loop's own
+    arithmetic) still reaches the result."""
+    buf = io.StringIO()
+    svc = SolveService(_serve_cfg(obs=True), out=buf,
+                       registry=MetricsRegistry())
+    faults.install(f"usage:1:{action}")
+    t0 = time.monotonic()
+    svc.submit(_PA, job_id="f", seed=3, generations=10,
+               tenant="acme")
+    svc.drive()
+    faults.install(None)
+    svc.close()
+    assert time.monotonic() - t0 < 60      # nothing waited on the hang
+    assert svc.queue.get("f").state == "done"
+    res = svc.queue.get("f").result
+    assert res["usage"]["gens"] == 10      # inline meter unaffected
+    recs = _records(buf)
+    assert any("solution" in r for r in recs)   # writer drained
+    if action == "die":
+        assert not svc.usage.alive()
+
+
+# ------------------------------------------------------------ fleet tier
+
+
+def test_v1_usage_endpoint_and_gateway_aggregation():
+    """Replicas serve GET /v1/usage; the gateway aggregates
+    fleet-wide off the prober's cache — and a DEAD replica's
+    last-scraped ledger keeps contributing."""
+    rep0, h0 = in_process_replica(_serve_cfg(http="127.0.0.1:0"), "u0")
+    rep1, h1 = in_process_replica(_serve_cfg(http="127.0.0.1:0"), "u1")
+    gw = Gateway(_fleet_cfg([h0.url, h1.url]), [h0, h1]).start()
+    jobs = [("ja", _PA, 3, "acme"), ("jb", _PA, 4, "acme"),
+            ("jc", _PB, 5, "zeta")]
+    try:
+        for jid, p, seed, tenant in jobs:
+            http_json("POST", gw.url + "/v1/solve",
+                      {"tim": dump_tim(p), "id": jid, "seed": seed,
+                       "generations": 10, "tenant": tenant})
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            views = {jid: http_json(
+                "GET", f"{gw.url}/v1/jobs/{jid}?records=0", ok=(200,))
+                for jid, *_ in jobs}
+            if all(v["state"] == "done" for v in views.values()):
+                break
+            time.sleep(0.1)
+        assert all(v["state"] == "done" for v in views.values())
+        # the result carries tenant + meter through the fleet view
+        full = http_json("GET", gw.url + "/v1/jobs/ja", ok=(200,))
+        assert full["result"]["tenant"] == "acme"
+        assert full["result"]["usage"]["gens"] == 10
+
+        # each replica's own /v1/usage
+        per_rep = []
+        for h in (h0, h1):
+            payload = http_json("GET", h.url + "/v1/usage", ok=(200,))
+            per_rep.append(payload)
+        rep_gens = sum(t.get("gens", 0)
+                       for p in per_rep
+                       for t in p["tenants"].values())
+        assert rep_gens == 30              # deterministic, exact
+
+        # gateway aggregation reaches the same totals once the prober
+        # cache catches up
+        deadline = time.monotonic() + 30
+        agg = None
+        while time.monotonic() < deadline:
+            agg = http_json("GET", gw.url + "/v1/usage", ok=(200,))
+            got = sum(t.get("gens", 0)
+                      for t in agg["tenants"].values())
+            if got == 30:
+                break
+            time.sleep(0.2)
+        assert sum(t.get("gens", 0)
+                   for t in agg["tenants"].values()) == 30
+        assert agg["tenants"]["acme"]["jobs"] == 2
+        assert agg["tenants"]["zeta"]["jobs"] == 1
+        for jid, *_ in jobs:
+            assert agg["jobs"][jid]["usage"]["gens"] == 10
+
+        # kill one replica: its last-scraped ledger keeps feeding the
+        # fleet totals (metered work never vanishes with its replica)
+        rep0.kill()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if h0.dead:
+                break
+            time.sleep(0.1)
+        assert h0.dead
+        agg2 = http_json("GET", gw.url + "/v1/usage", ok=(200,))
+        assert sum(t.get("gens", 0)
+                   for t in agg2["tenants"].values()) == 30
+        assert agg2["replicas"]["u0"]["dead"] is True
+    finally:
+        gw.close()
+        rep0.kill()
+        rep1.kill()
+
+
+@pytest.mark.slow
+def test_fleet_acceptance_killed_job_tenant_totals():
+    """ISSUE 14 acceptance: kill a replica mid-job; after the
+    failover RESUME the tenant's fleet-wide gens on the gateway match
+    an uninterrupted solve's modulo the re-run quantum and the scrape
+    cadence (the dead replica's LAST-scraped ledger + the survivor's
+    continuation — on a fast CPU backend hundreds of generations fit
+    inside one probe interval, so the tolerance is derived from the
+    measured generation rate, not guessed), the tenant's jobs count
+    stays 1 — a resumed job is never re-billed as a new job — and the
+    job's own cumulative meter is exact."""
+    gens_budget = 2000
+    rep0, h0 = in_process_replica(_serve_cfg(http="127.0.0.1:0"), "k0")
+    rep1, h1 = in_process_replica(_serve_cfg(http="127.0.0.1:0"), "k1")
+    gw = Gateway(_fleet_cfg([h0.url, h1.url]), [h0, h1]).start()
+    reps = {"k0": rep0, "k1": rep1}
+    try:
+        http_json("POST", gw.url + "/v1/solve",
+                  {"tim": dump_tim(_PA), "id": "ka", "seed": 3,
+                   "generations": gens_budget, "tenant": "acme"})
+        deadline = time.monotonic() + 120
+        killed = None
+        while time.monotonic() < deadline:
+            with gw.jobs_lock:
+                j = gw.jobs.get("ka")
+                owner, snap_gens = j.replica, j.snap_gens
+            if owner in reps and snap_gens >= gens_budget // 2:
+                # measure the generation rate: the honest tolerance is
+                # what one scrape/poll interval of lag costs in gens
+                g1 = reps[owner].svc.queue.get("ka").gens_done
+                time.sleep(0.25)
+                g2 = reps[owner].svc.queue.get("ka").gens_done
+                rate = max(0.0, (g2 - g1) / 0.25)
+                killed = owner
+                reps[owner].kill()
+                break
+            time.sleep(0.005)
+        assert killed, "never reached a kill point"
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            v = http_json("GET", gw.url + "/v1/jobs/ka?records=0",
+                          ok=(200,))
+            if v["state"] in ("done", "failed"):
+                break
+            time.sleep(0.1)
+        assert v["state"] == "done"
+        full = http_json("GET", gw.url + "/v1/jobs/ka", ok=(200,))
+        assert full["result"]["resumed_at"] > 0    # resumed, not replayed
+
+        agg = http_json("GET", gw.url + "/v1/usage", ok=(200,))
+        acme = agg["tenants"]["acme"]
+        # dead ledger (scraped a bounded-but-loaded-box-dependent
+        # moment before the kill: a probe round is several HTTP calls
+        # across two replicas on two cores) + survivor deltas: within
+        # the re-run quantum plus a TWO-second lag window of the
+        # uninterrupted budget — and far from the two failure modes
+        # this pins (double-billed history ~ 1.5x budget; dropped
+        # dead ledger ~ 0.5x budget). If the box dispatches so fast
+        # that the lag window swamps the signal, skip rather than
+        # assert vacuously.
+        slack = int(rate * 2.0) + 4 * 5
+        if slack >= gens_budget * 0.45:
+            pytest.skip(f"dispatch rate {rate:.0f} gens/s too high "
+                        f"to bound scrape lag on this box")
+        assert abs(acme["gens"] - gens_budget) <= slack, (acme, slack)
+        assert acme["jobs"] == 1                   # never re-billed
+        # and the job's own cumulative meter is exact (cursor + tail)
+        assert full["result"]["usage"]["gens"] == gens_budget
+    finally:
+        gw.close()
+        rep0.kill()
+        rep1.kill()
+
+
+# -------------------------------------------------------- rendering tier
+
+
+def test_tt_usage_and_tt_stats_rendering(tmp_path, capsys):
+    log = tmp_path / "serve.jsonl"
+    with open(log, "w") as fh:
+        svc = SolveService(_serve_cfg(obs=True), out=fh,
+                           registry=MetricsRegistry())
+        svc.submit(_PA, job_id="ra", seed=3, generations=10,
+                   tenant="acme")
+        svc.submit(_PA, job_id="rb", seed=4, generations=5,
+                   tenant="zeta")
+        svc.drive()
+        svc.close()
+
+    assert obs_usage.main_usage([str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "== usage by tenant" in out
+    assert "acme" in out and "zeta" in out
+    assert "ra (acme)" in out
+
+    assert obs_usage.main_usage([str(log), "--json",
+                                 "--tenant", "acme"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert list(doc["tenants"]) == ["acme"]
+    assert doc["jobs"]["ra"]["usage"]["gens"] == 10
+    assert "rb" not in doc["jobs"]
+
+    from timetabling_ga_tpu.obs.logstats import main_stats
+    assert main_stats([str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "== usage by tenant" in out
+    assert "rb (zeta)" in out
+
+    with pytest.raises(SystemExit):
+        obs_usage.main_usage([])
+    assert obs_usage.main_usage(["-h"]) == 0
+    capsys.readouterr()
+
+
+# ---------------------------------------------------- flags & plumbing
+
+
+def test_flags_and_wire_plumbing():
+    assert parse_serve_args([]).usage is True
+    assert parse_serve_args(["--no-usage"]).usage is False
+    # the fault site is part of the closed, validated set
+    assert faults.FaultPlan.parse("usage:1:die") is not None
+    with pytest.raises(faults.FaultPlanError):
+        faults.FaultPlan.parse("usages:1:die")
+    # the tenant tag survives the gateway payload filter (routing and
+    # failover resends keep it)
+    assert "tenant" in _PAYLOAD_KEYS
+    # tt submit grew --tenant
+    from timetabling_ga_tpu.fleet import client
+    import inspect
+    assert "--tenant" in inspect.getsource(client.main_submit)
+    # usageEntry is a TIMING record: strip_timing drops it
+    assert jsonl.strip_timing([{"usageEntry": {"gens": 1}},
+                               {"runEntry": {"totalBest": 1,
+                                             "feasible": True}}]) \
+        == [{"runEntry": {"totalBest": 1, "feasible": True}}]

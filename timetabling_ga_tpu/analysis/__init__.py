@@ -47,6 +47,12 @@ Rules (see README "Static analysis & sanitizers"):
          handler paths — dumps belong on the recorder's own thread;
          handlers serve the in-memory `latest()`/history `window()`
          only (obs/flight.py, obs/history.py)
+  TT607  usage-ledger mutation inside trace targets or on HTTP handler
+         paths, and wall-clock reads on handler paths — the tt-meter
+         ledger is fed from the scheduler's park fence and folded on
+         its own thread; handlers READ the meter (`totals()`), and
+         metering timestamps belong to the drive loop's fence
+         brackets, never a scrape's (obs/usage.py)
 
 Suppress one finding inline with `# tt-analyze: ignore[TT301]` (on the
 line, or on a comment line directly above). Configure via
@@ -84,7 +90,8 @@ def _rule_modules():
     from timetabling_ga_tpu.analysis import (
         rules_api, rules_cost, rules_donate, rules_fleet,
         rules_flight, rules_http, rules_obs, rules_quality,
-        rules_recompile, rules_rng, rules_sync, rules_trace)
+        rules_recompile, rules_rng, rules_sync, rules_trace,
+        rules_usage)
     return {
         "TT101": rules_trace,
         "TT102": rules_trace,
@@ -103,6 +110,7 @@ def _rule_modules():
         "TT604": rules_quality,
         "TT605": rules_fleet,
         "TT606": rules_flight,
+        "TT607": rules_usage,
     }
 
 

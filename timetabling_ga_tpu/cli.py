@@ -39,6 +39,13 @@ JSONL record stream, never a device.
     python -m timetabling_ga_tpu.cli quality run.jsonl
         summarize the search-quality telemetry (--quality runs):
         diversity trend, operator hit rates, migration gain, stalls
+    python -m timetabling_ga_tpu.cli usage serve.jsonl [more.jsonl]
+    python -m timetabling_ga_tpu.cli usage http://127.0.0.1:8070
+        per-tenant / per-job usage report (tt-meter, README "Usage
+        metering"): who consumed the fleet — device seconds, FLOPs,
+        queue/park wall, compile amortization — from usageEntry logs
+        or a live replica/gateway /v1/usage endpoint (the gateway
+        aggregates fleet-wide, dead replicas' ledgers included)
     python -m timetabling_ga_tpu.cli incident ./incidents [--job ID]
         summarize the flight recorder's bundles (--incident-dir) and
         render the newest — a stitched gateway bundle renders the
@@ -97,6 +104,12 @@ def main(argv=None) -> int:
         # renders the cross-process Perfetto timeline
         from timetabling_ga_tpu.obs.flight import main_incident
         return main_incident(argv[1:])
+    if argv and argv[0] == "usage":
+        # deferred + jax-free like trace/stats: per-tenant / per-job
+        # usage report from usageEntry logs or a live /v1/usage
+        # endpoint (tt-meter, obs/usage.py, README "Usage metering")
+        from timetabling_ga_tpu.obs.usage import main_usage
+        return main_usage(argv[1:])
     if argv and argv[0] == "profile":
         # deferred + jax-free like trace/stats: `tt profile` is a
         # stdlib HTTP client asking a LIVE run's --obs-listen front to

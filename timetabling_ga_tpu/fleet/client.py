@@ -35,6 +35,10 @@ usage: python -m timetabling_ga_tpu submit URL INSTANCE.tim [flags]
 
 submit one instance to a fleet gateway (or a single replica) and wait:
   --id <str>            job id (default: server-assigned)
+  --tenant <str>        tenant tag for usage metering (tt-meter,
+                        README "Usage metering"): every share of
+                        fleet capacity the job consumes is attributed
+                        to this tag — `tt usage URL` reports it
   --priority <int>      scheduling priority (higher first)
   -s <int>              seed
   --generations <int>   generation budget
@@ -104,6 +108,7 @@ def main_submit(argv) -> int:
     records_out = None
     i = 0
     flag_types = {"--id": ("id", str), "--priority": ("priority", int),
+                  "--tenant": ("tenant", str),
                   "-s": ("seed", int),
                   "--generations": ("generations", int),
                   "--deadline": ("deadline", float)}

@@ -137,7 +137,13 @@ _PAYLOAD_KEYS = ("id", "tim", "problem", "priority", "seed",
                  # gateway attaches one at resume-on-failover, and a
                  # client may submit one directly (incremental
                  # re-solve warm starts ride the same seam)
-                 "snapshot")
+                 "snapshot",
+                 # tt-meter (obs/usage.py): the tenant tag rides the
+                 # payload end to end — tt submit --tenant → gateway →
+                 # replica → Job.tenant — so capacity attribution
+                 # survives routing AND failover (the replayed payload
+                 # is byte-stable, tenant included)
+                 "tenant")
 
 
 # ---------------------------------------------------------------- protocol
@@ -245,6 +251,15 @@ class ApiHandler(obs_http._Handler):
             # and file-I/O-free on this thread (TT602/TT606)
             status, obj = self.server.api.incident_view()
             self._reply_json(status, obj)
+        elif path == "/v1/usage":
+            # tt-meter (obs/usage.py): per-tenant / per-job capacity
+            # attribution — a replica serves its own ledger + live job
+            # meters, the gateway the fleet-wide aggregation over its
+            # prober-cached per-replica payloads (dead replicas
+            # contribute their last-scraped ledger). Read-only on this
+            # thread (TT607: handlers read the ledger, never mutate)
+            status, obj = self.server.api.usage_view()
+            self._reply_json(status, obj)
         else:
             super().do_GET()
 
@@ -268,7 +283,8 @@ class ApiHandler(obs_http._Handler):
                 self._reply_json(400, {"error": str(e)[:300]})
                 return
             status, obj = self.server.api.accept_solve(
-                payload, flow=self._flow_header())
+                payload, flow=self._flow_header(),
+                resubmit=self._resubmit_header())
             self._reply_json(status, obj)
         elif path == "/v1/drain":
             # consume any declared body BEFORE the 200: a keep-alive
@@ -303,6 +319,13 @@ class ApiHandler(obs_http._Handler):
             return int(self.headers.get("X-TT-Flow") or 0)
         except ValueError:
             return 0
+
+    def _resubmit_header(self) -> bool:
+        """`X-TT-Resubmit: 1` marks a gateway RESEND (failover
+        replay/resume): the receiving replica admits the job without
+        re-counting it in its tenant `jobs` ledger — the first
+        admission already did (tt-meter, obs/usage.py)."""
+        return self.headers.get("X-TT-Resubmit") == "1"
 
     def _discard_body(self) -> None:
         try:
@@ -437,12 +460,14 @@ class GatewayApi:
     def __init__(self, gw: "Gateway"):
         self._gw = gw
 
-    def accept_solve(self, payload: dict, flow: int = 0):
+    def accept_solve(self, payload: dict, flow: int = 0,
+                     resubmit: bool = False):
         # `flow` (an upstream X-TT-Flow) is accepted for signature
         # parity with ReplicaApi but ignored: the gateway is the ROOT
         # allocator of cross-process chains — its dispatcher mints
-        # each job's flow at first placement
-        del flow
+        # each job's flow at first placement; likewise `resubmit` —
+        # the gateway originates resends, it never receives them
+        del flow, resubmit
         gw = self._gw
         if gw.draining:
             return 503, {"error": "draining", "reasons": ["draining"]}
@@ -554,6 +579,24 @@ class GatewayApi:
         (obs/flight.incident_response)."""
         from timetabling_ga_tpu.obs.flight import incident_response
         return incident_response(self._gw.flight)
+
+    def usage_view(self):
+        """GET /v1/usage at the gateway: fleet-wide totals aggregated
+        over the prober's cached per-replica `/v1/usage` payloads
+        (ReplicaHandle.last_usage — refreshed on the PROBER thread; a
+        DEAD replica keeps contributing its last-scraped ledger, the
+        incident-bundle stitching rule, so a killed replica's metered
+        work never vanishes from the bill). Tenant meters SUM — each
+        replica counted only its own metered quanta, and a resumed
+        job's survivor ledger starts from zero — so a failover's
+        fleet totals match an uninterrupted solve's modulo the re-run
+        quantum (tests/test_usage.py pins it). Read-only over handle
+        attributes on this handler thread (TT605/TT607)."""
+        gw = self._gw
+        payloads = [(h.name, h.dead, h.usage_payload())
+                    for h in gw.replicas.all()]
+        from timetabling_ga_tpu.obs import usage as obs_usage
+        return 200, obs_usage.aggregate(payloads)
 
     def fleet_view(self):
         # served from the dispatcher's lock-guarded SNAPSHOT, refreshed
@@ -1041,9 +1084,18 @@ class Gateway:
                 self.registry.counter("fleet.submit_retries").inc()
             idem = job.sent_any
             job.sent_any = True
+            # resubmit (the tt-meter no-rebill header) is keyed on a
+            # previously SUCCESSFUL placement (routed_any), not on
+            # sent_any: a boot-window retry whose first POST never
+            # landed is still the job's first admission and must be
+            # billed; a genuine failover resend was already counted
+            # by its first replica. (The lost-response resend inside
+            # one placement needs no header: the replica answers 409
+            # duplicate — no second admission, no second count.)
             return handle.post_job(job.payload,
                                    timeout=self.cfg.io_timeout,
-                                   idempotent=idem, flow=job.flow)
+                                   idempotent=idem, flow=job.flow,
+                                   resubmit=job.routed_any)
 
         try:
             with self.tracer.span("submit", cat="fleet", job=job.id,

@@ -268,7 +268,8 @@ class ReplicaApi:
     def __init__(self, replica: "Replica"):
         self._r = replica
 
-    def accept_solve(self, payload: dict, flow: int = 0):
+    def accept_solve(self, payload: dict, flow: int = 0,
+                     resubmit: bool = False):
         r = self._r
         if r.draining:
             return 503, {"error": "draining", "reasons": ["draining"]}
@@ -282,8 +283,11 @@ class ReplicaApi:
             r.index[job_id] = {"state": "accepted"}
         # `flow` is the gateway's X-TT-Flow header (0 = none): the
         # drive loop threads it into Job.flow so every replica-side
-        # span of this job CONTINUES the gateway's causal chain
-        r.inbox.put(("submit", job_id, dict(payload, id=job_id), flow))
+        # span of this job CONTINUES the gateway's causal chain.
+        # `resubmit` is its X-TT-Resubmit: a gateway RESEND skips the
+        # tenant `jobs` count — the first admission already billed it
+        r.inbox.put(("submit", job_id, dict(payload, id=job_id), flow,
+                     resubmit))
         return 202, {"id": job_id, "state": "accepted"}
 
     def job_view(self, job_id: str, with_records: bool = True,
@@ -389,6 +393,27 @@ class ReplicaApi:
         404 without a recorder or before the first dump."""
         from timetabling_ga_tpu.obs.flight import incident_response
         return incident_response(self._r.svc.flight)
+
+    def usage_view(self):
+        """GET /v1/usage: this replica's tt-meter view (README "Usage
+        metering") — the ledger's per-tenant totals (ITS OWN metered
+        contribution: the gateway sums these fleet-wide) plus each
+        known job's cumulative meter (`Job.usage`, replaced wholesale
+        at park fences, so this read is torn-free). Read-only on this
+        handler thread (TT607); 404 when metering is off
+        (--no-usage)."""
+        ledger = self._r.svc.usage
+        if ledger is None:
+            return 404, {"error": "usage metering off (--no-usage)"}
+        from timetabling_ga_tpu.obs import usage as obs_usage
+        jobs = {}
+        for job in list(self._r.svc.queue._jobs.values()):
+            if job.usage:
+                jobs[job.id] = {"tenant": job.tenant,
+                                "state": job.state,
+                                "gens": job.gens_done,
+                                "usage": obs_usage.rounded(job.usage)}
+        return 200, {"tenants": ledger.totals(), "jobs": jobs}
 
 
 class Replica:
@@ -563,6 +588,7 @@ class Replica:
         if kind == "submit":
             job_id, payload = cmd[1], cmd[2]
             flow = cmd[3] if len(cmd) > 3 else 0
+            resubmit = bool(cmd[4]) if len(cmd) > 4 else False
             try:
                 problem = payload_problem(payload)
                 self.svc.submit(
@@ -572,7 +598,9 @@ class Replica:
                     generations=payload.get("generations"),
                     deadline_s=payload.get("deadline"),
                     flow=flow,
-                    snapshot=payload.get("snapshot"))
+                    snapshot=payload.get("snapshot"),
+                    tenant=payload.get("tenant"),
+                    count_job=not resubmit)
                 with self.index_lock:
                     self.index.pop(job_id, None)
             except Exception as e:
@@ -738,6 +766,35 @@ class ReplicaHandle:
         #                              stitched bundle falls back to
         #                              this copy when the replica is
         #                              already dead at failover time
+        # -- tt-meter ledger cache (refreshed by probe()) ----------------
+        self.last_usage = None       # the replica's newest /v1/usage
+        #                              payload: a DEAD replica's last-
+        #                              scraped ledger keeps feeding the
+        #                              gateway's fleet-wide /v1/usage
+        #                              aggregation (obs/usage.aggregate
+        #                              — metered work never vanishes
+        #                              from the bill with its replica)
+        self.usage_base = None       # RETIRED incarnations' combined
+        #                              ledger: a respawned worker's
+        #                              fresh (near-empty) payload must
+        #                              ADD to the dead incarnation's,
+        #                              never replace it — _declare_dead
+        #                              folds last_usage in here before
+        #                              the respawn, and usage_payload()
+        #                              serves the sum (a STATIC replica
+        #                              restarted behind our back still
+        #                              loses its pre-restart ledger:
+        #                              there is no respawn event to
+        #                              fold on — documented limit).
+        #                              The (base, last) PAIR is read
+        #                              and written under _usage_lock:
+        #                              unlike the single-attribute
+        #                              probe gauges, retiring is a
+        #                              two-field move, and a gateway
+        #                              /v1/usage racing it would
+        #                              double-count (or drop) a whole
+        #                              incarnation's bill
+        self._usage_lock = threading.Lock()
 
     # -- probe ----------------------------------------------------------
 
@@ -804,6 +861,21 @@ class ReplicaHandle:
             except Exception:
                 pass                 # keep the previous copy
         self.flight_dumps = dumps
+        # the prober's tt-meter scrape: refresh the cached /v1/usage
+        # ledger every probe round (the payload is bounded — active
+        # jobs plus the TAIL_JOBS-retained terminals) so the gateway's
+        # fleet aggregation, INCLUDING a dead replica's final
+        # contribution, is never staler than one probe. Same thread
+        # and isolation contract as the rest of this method: a failed
+        # fetch (404 = metering off, timeouts, a mid-drain front)
+        # leaves the previous cached copy in place.
+        try:
+            fresh = self.get_usage(timeout=timeout)
+            if fresh is not None:
+                with self._usage_lock:
+                    self.last_usage = fresh
+        except Exception:
+            pass                     # keep the previous copy
 
     def compile_hit_rate(self) -> float:
         total = self.compile_count + self.compile_cache_hits
@@ -812,7 +884,8 @@ class ReplicaHandle:
     # -- verbs ----------------------------------------------------------
 
     def post_job(self, payload: dict, timeout: float = 5.0,
-                 idempotent: bool = False, flow: int = 0):
+                 idempotent: bool = False, flow: int = 0,
+                 resubmit: bool = False):
         # 409 (duplicate id) is SUCCESS only for a RESEND (failover
         # resubmission, or a retry whose first attempt landed but
         # lost its response): the job is already there, the placement
@@ -827,9 +900,24 @@ class ReplicaHandle:
         # the payload: the payload is the replayable solve REQUEST and
         # must stay byte-stable across failover resends, while the
         # flow is pure telemetry
-        headers = {"X-TT-Flow": str(int(flow))} if flow else None
+        headers = {}
+        if flow:
+            headers["X-TT-Flow"] = str(int(flow))
+        if resubmit:
+            # tt-meter: a resend of a job some replica ALREADY
+            # ACCEPTED (failover replay/resume — the gateway keys this
+            # on a previously successful placement, NOT on "a send was
+            # attempted": a boot-window retry whose first POST never
+            # landed must still be billed) must not re-count the job
+            # in the new replica's tenant `jobs` ledger — the first
+            # admission (possibly on a now-dead replica whose cached
+            # ledger the gateway still sums) already did. Telemetry
+            # like the flow header, so it rides a header, never the
+            # byte-stable payload.
+            headers["X-TT-Resubmit"] = "1"
         return http_json("POST", self.url + "/v1/solve", payload,
-                         timeout=timeout, ok=ok, headers=headers)
+                         timeout=timeout, ok=ok,
+                         headers=headers or None)
 
     def list_jobs(self, timeout: float = 5.0):
         """{id: {"state", ...}} for every job the replica knows —
@@ -865,6 +953,51 @@ class ReplicaHandle:
             if e.status == 404:
                 return None
             raise
+
+    def get_usage(self, timeout: float = 5.0):
+        """GET /v1/usage: the replica's tt-meter payload ({tenants,
+        jobs} — obs/usage.py), or None when metering is off
+        (--no-usage answers 404)."""
+        try:
+            return http_json("GET", self.url + "/v1/usage",
+                             timeout=timeout, ok=(200,))
+        except FleetHTTPError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    def usage_payload(self):
+        """This handle's whole metered history: retired incarnations'
+        folded ledgers (`usage_base`) + the live incarnation's latest
+        scrape — what the gateway's fleet aggregation consumes. None
+        when nothing was ever scraped. Reads the (base, last) pair
+        under the lock: retire_usage moves a ledger between the two
+        fields, and an unlocked reader catching it mid-move would
+        bill a whole incarnation twice (or not at all)."""
+        from timetabling_ga_tpu.obs import usage as obs_usage
+        with self._usage_lock:
+            base, last = self.usage_base, self.last_usage
+        if base is None:
+            return last
+        if last is None:
+            return base
+        return obs_usage.combine([base, last])
+
+    def retire_usage(self) -> None:
+        """Fold the (about-to-die) incarnation's last-scraped ledger
+        into the retired base — called by the prober right before a
+        respawn, so the fresh worker's near-empty payload ADDS to the
+        history instead of replacing it. One locked move, so
+        usage_payload never sees the ledger in both fields."""
+        from timetabling_ga_tpu.obs import usage as obs_usage
+        with self._usage_lock:
+            if self.last_usage is None:
+                return
+            self.usage_base = (
+                self.last_usage if self.usage_base is None
+                else obs_usage.combine([self.usage_base,
+                                        self.last_usage]))
+            self.last_usage = None
 
     def get_history(self, window: float | None = None,
                     timeout: float = 5.0):
@@ -999,6 +1132,11 @@ class ReplicaSet:
                 and handle.restarts < self.max_restarts):
             try:
                 handle.terminate()   # reap a half-dead process first
+                # the dying incarnation's metered work joins the
+                # retired ledger BEFORE the fresh (near-empty) worker
+                # starts answering /v1/usage — billing survives the
+                # respawn like the flight-dump baseline reset below
+                handle.retire_usage()
                 handle.proc = handle.respawn()
                 handle.restarts += 1
                 handle.fails = 0

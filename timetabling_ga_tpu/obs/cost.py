@@ -357,7 +357,7 @@ class CostProgram:
     under its own name)."""
 
     __slots__ = ("_fn", "program", "_obs", "_compiled", "_lock",
-                 "last_cost", "last_compiled")
+                 "last_cost", "last_compiled", "last_compile_s")
 
     def __init__(self, fn, program: str, observatory=None):
         self._fn = fn
@@ -367,6 +367,11 @@ class CostProgram:
         self._lock = threading.Lock()
         self.last_cost: dict | None = None
         self.last_compiled = False
+        # the lower+compile wall the most recent call paid (0.0 on a
+        # warm dispatch): the usage ledger's compile-amortization
+        # input — a cold dispatch's wall time is compile+execute, and
+        # tt-meter attributes the two halves under their own names
+        self.last_compile_s = 0.0
 
     def _compile(self, sig: tuple, args):
         from timetabling_ga_tpu.runtime import retry
@@ -393,11 +398,11 @@ class CostProgram:
                       f"back to plain dispatch", file=sys.stderr)
                 self._obs.record_compile(self.program, sig, 0.0, 0.0,
                                          {}, retries=retries)
-                return {"exe": None, "cost": {}}
+                return {"exe": None, "cost": {}, "seconds": 0.0}
         cost = extract_cost(exe)
         self._obs.record_compile(self.program, sig, t1 - t0, t2 - t1,
                                  cost, retries=retries)
-        return {"exe": exe, "cost": cost}
+        return {"exe": exe, "cost": cost, "seconds": t2 - t0}
 
     def __call__(self, *args):
         sig = _sig(args)
@@ -413,6 +418,8 @@ class CostProgram:
         if not compiled_now:
             self._obs.hit(self.program)
         self.last_compiled = compiled_now
+        self.last_compile_s = (entry.get("seconds", 0.0)
+                               if compiled_now else 0.0)
         self.last_cost = entry["cost"] or None
         exe = entry["exe"]
         if exe is None:

@@ -172,6 +172,7 @@ def summarize(records) -> str:
     flight_spans: list = []  # flight_dump spans (incident section)
     routes: list = []       # routeEntry bodies (placement summary)
     compiles: list = []     # costEntry bodies (compile accounting)
+    usage_recs: list = []   # whole records (obs/usage.py summarize)
     quality_recs: list = []  # whole records (obs/quality.py summarize)
     counts: dict = {}
     last_metrics = None
@@ -200,6 +201,8 @@ def summarize(records) -> str:
             routes.append(body)
         elif kind == "costEntry":
             compiles.append(body)
+        elif kind == "usageEntry":
+            usage_recs.append(rec)
         elif kind == "qualityEntry":
             quality_recs.append(rec)
         elif kind == "metricsEntry":
@@ -369,6 +372,13 @@ def summarize(records) -> str:
                     tail += f" AI {last['intensity']:.1f}"
             lines.append(f"  {prog}: {len(cs)}x, {total:.2f}s "
                          f"lower+compile{tail}")
+
+    if usage_recs:
+        # tt-meter (obs/usage.py owns the report): who consumed the
+        # capacity — per-tenant and per-job device seconds, FLOPs,
+        # queue/park wall, compile amortization
+        from timetabling_ga_tpu.obs import usage as obs_usage
+        lines.append(obs_usage.summarize_entries(usage_recs))
 
     if quality_recs:
         # search-quality observatory (obs/quality.py owns the report):

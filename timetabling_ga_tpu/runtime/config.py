@@ -711,6 +711,20 @@ class ServeConfig:
     #                               it on or off)
     metrics_every: int = 10       # dispatches between metricsEntry
     #                               snapshots under --obs
+    usage: bool = True            # tt-meter (obs/usage.py, README
+    #                               "Usage metering"): per-job /
+    #                               per-tenant capacity attribution at
+    #                               every park fence — the live
+    #                               usage.tenant.<t>.* metrics
+    #                               namespace, the per-job meter
+    #                               GET /v1/usage serves, the snapshot
+    #                               wire's usage cursor, and (under
+    #                               --obs) usageEntry records. ON by
+    #                               default — host-side dict
+    #                               arithmetic off the dispatch path;
+    #                               --no-usage is the A/B's other leg
+    #                               (record streams identical either
+    #                               way — usageEntry is TIMING)
     obs_listen: Optional[str] = None  # HOST:PORT pull front (/metrics
     #                               with exemplars, /healthz, /readyz,
     #                               /profile) — same semantics as
@@ -817,13 +831,15 @@ _SERVE_FLAG_MAP = {
 _SERVE_BOOL_FLAGS = {"--obs": "obs", "--quality": "quality",
                      "--preempt-on-term": "preempt_on_term"}
 
+_SERVE_NEG_BOOL_FLAGS = {"--no-usage": "usage"}
+
 
 def _serve_usage() -> str:
     return _format_usage(
         ["usage: python -m timetabling_ga_tpu serve [flags]", "",
          "multi-tenant solver service (line-JSON jobs on -i/stdin, "
          "job-tagged JSONL records on -o/stdout):"],
-        _SERVE_FLAG_MAP, (_SERVE_BOOL_FLAGS,))
+        _SERVE_FLAG_MAP, (_SERVE_BOOL_FLAGS, _SERVE_NEG_BOOL_FLAGS))
 
 
 def parse_serve_args(argv) -> ServeConfig:
@@ -831,7 +847,7 @@ def parse_serve_args(argv) -> ServeConfig:
     parse_args — _parse_flag_stream is the shared loop)."""
     cfg = ServeConfig()
     _parse_flag_stream(argv, cfg, _SERVE_FLAG_MAP, _serve_usage,
-                       _SERVE_BOOL_FLAGS)
+                       _SERVE_BOOL_FLAGS, _SERVE_NEG_BOOL_FLAGS)
     if cfg.backend not in ("tpu", "cpu"):
         raise SystemExit(f"unknown backend: {cfg.backend}")
     if cfg.trace_mode not in TRACE_MODES:

@@ -67,11 +67,14 @@ replica handler thread — a hung export parks one handler, never the
 drive loop or writer), `resume` (once per warm-start snapshot
 admission — any failure falls back to a fresh replay;
 tests/test_resume.py pins the triad), `history` (once per registry
-sample on the tt-flight history sampler thread — obs/history.py) and
+sample on the tt-flight history sampler thread — obs/history.py),
 `flight_dump` (once per incident-dump attempt on the flight recorder
 thread — obs/flight.py; both share the mem_poll isolation contract:
 a hung or dead sampler/dumper never stalls dispatch, settlement, or
-writer drain — tests/test_flight.py pins it).
+writer drain — tests/test_flight.py pins it) and `usage` (once per
+drained event batch on the tt-meter usage ledger thread —
+obs/usage.py; same contract: a hung or dead ledger leaves stale
+meters, never a stalled dispatch — tests/test_usage.py pins it).
 
 The plan is installed per engine.run call (`install`), which resets the
 per-site counters — invocation indices are deterministic within one
@@ -145,10 +148,16 @@ ACTIONS = ("unavailable", "hang", "die", "truncate", "error")
 # once per incident-dump attempt on the obs/flight.py recorder thread
 # (a hang parks the recorder — no bundle materializes; a die ends it —
 # dispatch, settlement, and writer drain never wait on either).
+# `usage` fires once per drained event batch on the tt-meter usage
+# ledger thread (obs/usage.py UsageLedger) — the mem_poll/history
+# discipline: a hang parks the ledger (tenant meters go stale, over-cap
+# events drop into the honest `usage.dropped` counter), a die ends it
+# silently; dispatch, job settlement, and writer drain never wait on it
+# (tests/test_usage.py pins the isolation).
 SITES = ("dispatch", "fetch", "writer", "ckpt", "init", "obs_listen",
          "scrape", "mem_poll", "profile", "gateway", "route",
          "gw_writer", "gw_scrape", "quantum", "snapshot_ship",
-         "resume", "history", "flight_dump")
+         "resume", "history", "flight_dump", "usage")
 
 
 class FaultInjected(Exception):

@@ -461,6 +461,31 @@ def route_entry(stream: IO, job: str, bucket, replica: str,
     _write(stream, {"routeEntry": rec})
 
 
+def usage_entry(stream: IO, payload: dict, ts=None) -> None:
+    """Observability EXTENSION record (tt-meter, obs/usage.py; emitted
+    by the usage ledger thread when an emitter is bound — i.e. under
+    --obs with metering on): per-dispatch capacity attribution, or a
+    settled job's cumulative meter —
+
+      {"usageEntry":{"dispatch":7,"gens":10,"device_seconds":0.083,
+                     "compile_seconds":0.0,"flops":1.1e9,
+                     "lanes":[{"job":"j1","tenant":"acme","gens":5,
+                               "device_seconds":0.041,...}, ...],
+                     "ts":5.2}}
+      {"usageEntry":{"event":"total","job":"j1","tenant":"acme",
+                     "gens":200,"device_seconds":1.7,...,"ts":9.9}}
+
+    The per-lane shares of a dispatch entry sum EXACTLY to its totals
+    (obs/usage.split — the conservation invariant bench `extra.usage`
+    asserts). Pure capacity/timing telemetry: strip_timing drops the
+    whole record, so the stream identity contract (metering on vs off)
+    holds by construction."""
+    rec = dict(payload)
+    if ts is not None:
+        rec["ts"] = round(max(0.0, float(ts)), 6)
+    _write(stream, {"usageEntry": rec})
+
+
 def phase_record(stream: IO, name: str, trial: int, seconds: float,
                  **extra) -> None:
     """Observability EXTENSION record (not in the reference protocol;
@@ -493,7 +518,8 @@ TIMING_FIELDS = {"logEntry": ("time",), "solution": ("totalTime",),
 # observatory's (streams identical with it on or off MODULO
 # qualityEntry/timing records — tests/test_quality.py).
 TIMING_RECORDS = ("phase", "faultEntry", "spanEntry", "metricsEntry",
-                  "costEntry", "qualityEntry", "routeEntry")
+                  "costEntry", "qualityEntry", "routeEntry",
+                  "usageEntry")
 
 
 def strip_timing(records: List[dict]) -> List[dict]:

@@ -71,6 +71,19 @@ class Job:
     seed: int = 0
     generations: int = 200            # total generation budget
     deadline_s: Optional[float] = None  # wall-clock bound from submit
+    tenant: str = "default"           # who submitted it (tt-meter,
+    #                                   obs/usage.py): every share of
+    #                                   fleet capacity this job
+    #                                   consumes is attributed to this
+    #                                   tag — the usage.tenant.<t>.*
+    #                                   metrics namespace, usageEntry
+    #                                   records, and GET /v1/usage
+    count_usage: bool = True          # False on a fleet RESEND (the
+    #                                   gateway's X-TT-Resubmit): the
+    #                                   job is metered but not
+    #                                   re-counted in its tenant's
+    #                                   `jobs` ledger — the first
+    #                                   admission already billed it
     # -- runtime (owned by the scheduler) --------------------------------
     state: str = JobState.PENDING
     seq: int = 0                      # admission order (FIFO tie-break)
@@ -119,6 +132,23 @@ class Job:
     #                                   resumed stream can no longer
     #                                   claim identity (surfaced on
     #                                   the wire, never silent)
+    # -- tt-meter (obs/usage.py; README "Usage metering") ----------------
+    usage: dict = dataclasses.field(default_factory=dict)
+    #                                   cumulative per-job meter,
+    #                                   REPLACED wholesale by the drive
+    #                                   loop at every park fence (plain
+    #                                   dict arithmetic — handler
+    #                                   threads serving GET /v1/usage
+    #                                   read one fence's meter or the
+    #                                   next, never a torn mix). Ships
+    #                                   with the snapshot wire as the
+    #                                   usage cursor, so a resumed
+    #                                   job's meter CONTINUES on the
+    #                                   survivor instead of resetting
+    first_work_t: Optional[float] = None  # first dispatch fence: the
+    #                                   queue_seconds component's end
+    last_fence_t: Optional[float] = None  # latest park fence: the next
+    #                                   cycle's park_seconds baseline
 
     def runnable(self) -> bool:
         return self.state in (JobState.PENDING, JobState.RUNNING,

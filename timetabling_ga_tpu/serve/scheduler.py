@@ -57,6 +57,7 @@ import numpy as np
 
 from timetabling_ga_tpu.obs import metrics as obs_metrics
 from timetabling_ga_tpu.obs import quality as obs_quality
+from timetabling_ga_tpu.obs import usage as usage_mod
 from timetabling_ga_tpu.obs.spans import NULL_TRACER
 from timetabling_ga_tpu.ops import ga
 from timetabling_ga_tpu.parallel import islands
@@ -98,7 +99,7 @@ class Scheduler:
 
     def __init__(self, cfg: ServeConfig, queue: JobQueue, out,
                  now=None, tracer=NULL_TRACER, profiler=None,
-                 registry=None):
+                 registry=None, usage=None):
         import jax
         self.cfg = cfg
         self.queue = queue
@@ -107,6 +108,12 @@ class Scheduler:
         self._now = now or time.monotonic
         self._dispatches = 0
         self._overflow_warned = False
+        # tt-meter (obs/usage.py UsageLedger, wired by the service
+        # under cfg.usage): the drive loop folds each job's meter
+        # inline at its park fence (fence-consistent — the snapshot
+        # wire ships it) and hands the per-tenant settlement to the
+        # ledger's own thread; None = metering off
+        self._usage = usage
         # the metrics registry this scheduler reports into — THE
         # process registry by default, a private one when several
         # in-process replicas must keep separate /readyz truths
@@ -181,12 +188,27 @@ class Scheduler:
             return
         with self.tracer.span("admit", cat="serve", job=job.id,
                               flow=job.flow):
+            extra = {}
+            if job.tenant != usage_mod.DEFAULT_TENANT:
+                # the tenant tag rides the lifecycle record so a log
+                # alone maps jobs to tenants; absent for the default
+                # tenant, keeping untagged streams byte-identical to
+                # pre-meter ones
+                extra["tenant"] = job.tenant
             self._ship_rec(job, jsonl.job_entry(
                 self.out, job.id, "admitted",
                 bucket=list(job.bucket),
                 generations=job.generations,
-                priority=job.priority))
+                priority=job.priority, **extra))
         self._metrics.counter("serve.jobs_admitted").inc()
+        if self._usage is not None and job.count_usage:
+            # a FRESH job joins its tenant's jobs count; resumed
+            # re-admissions (the early return above) and fleet
+            # RESENDS (count_usage=False — a failover REPLAY also
+            # lands here, as a fresh admission) do not: the first
+            # replica counted them, and the fleet aggregation SUMS
+            # tenant ledgers (obs/usage.aggregate)
+            self._usage.job(job.id, job.tenant)
 
     def _ship_rec(self, job: Job, rec: dict) -> None:
         """Mirror one just-emitted record into the job's ship prefix
@@ -241,6 +263,15 @@ class Scheduler:
         job.best = meta["best"]
         job.resumed_at = meta["gens_done"]
         job.state = JobState.PARKED
+        # tt-meter continuity (README "Usage metering"): the wire's
+        # usage cursor seeds the job's meter so a failed-over or
+        # preempted job CONTINUES counting instead of resetting — the
+        # per-job view and the settle total stay cumulative across
+        # incarnations (the tenant LEDGER, by contrast, only ever
+        # receives this replica's own deltas)
+        cursor = wire.get("usage")
+        if isinstance(cursor, dict):
+            job.usage = usage_mod.add(None, cursor)
         # the resumed job ships again from admission: a preempt before
         # its first local quantum re-ships the SAME snapshot (empty
         # continuation prefix — the gateway accumulates prefixes)
@@ -248,7 +279,7 @@ class Scheduler:
             state=state, bucket=job.bucket, pop_size=pop,
             seed=job.seed, gens_done=job.gens_done, chunks=job.chunks,
             emitted=job.emitted, best=job.best, records=[],
-            wire=dict(wire))
+            usage=dict(job.usage), wire=dict(wire))
         # the seam: ONE faultEntry (strip_timing drops it — the
         # resumed stream stays in the identity domain) + the
         # `recover` span tt stats turns into the job's `recovered`
@@ -438,6 +469,13 @@ class Scheduler:
                jids, flows, engine) -> None:
         lanes = self.cfg.lanes
         pop = self.cfg.pop_size
+        # tt-meter: the fence instant the wait components are measured
+        # against — queue_seconds (admission -> first dispatch) and
+        # park_seconds (previous fence -> this dispatch) are computed
+        # here but APPLIED only at the successful park below, so a
+        # faulted dispatch charges nothing twice (the lost wall lands
+        # in the next successful fence's park component)
+        t_fence0 = self._now()
         with self.tracer.span("resume", cat="serve", job=jids,
                               flow=flows):
             # parked host snapshots -> one stacked device placement
@@ -456,6 +494,7 @@ class Scheduler:
             state, trace = runner(pa_stack, seeds, chunks, state, gens)
             self._inflight = state
             trace = np.asarray(trace)   # (lanes, quantum, 2) | packed
+            tq_wall = self._now() - tq0
             # live roofline for the serve path, same gauges and same
             # formula as the engine's (obs/cost.py owns it): the lane
             # program's compile-time counts over this quantum's wall.
@@ -465,8 +504,7 @@ class Scheduler:
             if not getattr(runner, "last_compiled", False):
                 from timetabling_ga_tpu.obs import cost as obs_cost
                 obs_cost.set_live_roofline(
-                    getattr(runner, "last_cost", None),
-                    self._now() - tq0)
+                    getattr(runner, "last_cost", None), tq_wall)
         with self.tracer.span("park", cat="serve", job=jids,
                               flow=flows):
             host = engine.fetch_state(state)
@@ -514,10 +552,20 @@ class Scheduler:
                 for name, v in q_agg["gauges"].items():
                     self._metrics.gauge(name).set(v)
             now = self._now()
+            deltas, meter_payload = self._meter_quantum(
+                jobs, gens, tq_wall, runner, t_fence0)
             for lane, job in enumerate(jobs):
                 job.snapshot = _slice_state(host, lane, pop)
                 job.chunks += 1
                 job.gens_done += int(gens[lane])
+                if deltas is not None:
+                    # fold THIS lane's share into the job's cumulative
+                    # meter (a NEW dict — GET /v1/usage handlers read
+                    # one fence's meter or the next, never a torn mix)
+                    job.usage = usage_mod.add(job.usage, deltas[lane])
+                    if job.first_work_t is None:
+                        job.first_work_t = t_fence0
+                    job.last_fence_t = now
                 for _g, h, s in events[lane]:
                     rep = jsonl.reported_best(h, s)
                     if rep < job.best:
@@ -547,7 +595,74 @@ class Scheduler:
                         gens_done=job.gens_done, chunks=job.chunks,
                         emitted=job.emitted, best=job.best,
                         records=list(job.ship_records),
-                        truncated=job.ship_truncated)
+                        truncated=job.ship_truncated,
+                        usage=dict(job.usage))
+            if meter_payload is not None:
+                # per-tenant settlement rides the ledger's own thread
+                # (an O(1) bounded append — the fault-site `usage`
+                # isolation contract); the usageEntry it emits carries
+                # the EXACT per-lane shares, summing to the dispatch
+                # totals (the conservation invariant)
+                self._usage.dispatch(meter_payload)
+
+    def _meter_quantum(self, jobs, gens, tq_wall, runner, t_fence0):
+        """tt-meter attribution for one retired quantum (README "Usage
+        metering"): split the dispatch's measured device wall (minus
+        any compile the same call paid — attributed separately as
+        compile amortization), the lane program's compile-time FLOP
+        count, and the executed generations across the co-tenant lanes
+        proportionally to the generations each lane actually ran —
+        `usage_mod.split`, whose shares sum to the totals EXACTLY (the
+        pinned conservation invariant). Per-job wait components
+        (queue_seconds once at first work, park_seconds since the last
+        fence) ride the same delta. Returns (per-lane deltas, ledger
+        payload), or (None, None) with metering off. Pure host dict
+        arithmetic on the drive loop; everything slower (tenant folds,
+        registry bumps, usageEntry emission) happens on the ledger's
+        own thread."""
+        if self._usage is None:
+            return None, None
+        gens_l = [int(gens[lane]) for lane in range(len(jobs))]
+        compiled = bool(getattr(runner, "last_compiled", False))
+        compile_s = (float(getattr(runner, "last_compile_s", 0.0))
+                     if compiled else 0.0)
+        exec_s = max(0.0, float(tq_wall) - compile_s)
+        cost = getattr(runner, "last_cost", None) or {}
+        flops = float(cost.get("flops", 0.0))
+        # dyadic-grid splits (obs/usage.split): the recorded totals
+        # are the QUANTIZED ones, so lane shares sum to them exactly —
+        # seconds on the ~ns default grid, FLOPs on the integer grid
+        exec_s, dev_shares = usage_mod.split(exec_s, gens_l)
+        flops, flop_shares = usage_mod.split(flops, gens_l, quantum=1.0)
+        compile_s, comp_shares = usage_mod.split(compile_s, gens_l)
+        deltas = []
+        lanes_out = []
+        for lane, job in enumerate(jobs):
+            queued = (max(0.0, t_fence0 - job.submitted_t)
+                      if job.first_work_t is None else 0.0)
+            parked = (max(0.0, t_fence0 - job.last_fence_t)
+                      if job.last_fence_t is not None else 0.0)
+            delta = {"gens": gens_l[lane], "dispatches": 1,
+                     "device_seconds": dev_shares[lane],
+                     "compile_seconds": comp_shares[lane],
+                     "flops": flop_shares[lane],
+                     "queue_seconds": queued,
+                     "park_seconds": parked}
+            deltas.append(delta)
+            # UNROUNDED shares on the wire: the usageEntry's per-lane
+            # values must sum bit-exactly to its totals (bench
+            # extra.usage and tests/test_usage.py assert it on the
+            # emitted record, not on an internal float)
+            lanes_out.append({"job": job.id, "tenant": job.tenant,
+                              **delta})
+        payload = {"dispatch": self._dispatches,
+                   "bucket": list(jobs[0].bucket),
+                   "gens": sum(gens_l),
+                   "device_seconds": exec_s,
+                   "compile_seconds": compile_s,
+                   "flops": flops,
+                   "lanes": lanes_out}
+        return deltas, payload
 
     def _recover_quantum(self, jobs, exc) -> None:
         """Serve-path fault recovery: the engine supervisor's
@@ -675,6 +790,15 @@ class Scheduler:
                       "resumed_at": job.resumed_at,
                       "timeslots": slots.tolist(),
                       "rooms": rooms.tolist()}
+        if self._usage is not None:
+            # the settled meter travels with the result (the /v1 job
+            # view a billing consumer reads) and lands on the record
+            # stream as the job's authoritative `event: "total"`
+            # usageEntry — cumulative across incarnations for a
+            # resumed job (the wire cursor seeded it)
+            job.result["tenant"] = job.tenant
+            job.result["usage"] = usage_mod.rounded(job.usage)
+            self._usage.final(job.id, job.tenant, job.usage)
         job.snapshot = None        # parked memory released
         job.ship = None            # a settled job ships nothing — the
         job.ship_records = []      # live tail serves its records

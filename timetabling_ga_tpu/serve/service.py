@@ -17,7 +17,8 @@ the engine's JSONL protocol with each record tagged `"job"`, plus the
 `jobEntry` lifecycle records (jsonl.job_entry):
 
     {"submit": {"id": "j1", "instance": "comp01.tim", "priority": 5,
-                "seed": 42, "generations": 200, "deadline": 30.0}}
+                "seed": 42, "generations": 200, "deadline": 30.0,
+                "tenant": "acme"}}
     {"submit": {"id": "j2", "tim": "4 2 2 5\\n..."}}   inline instance
     {"cancel": "j1"}
     {"stats": true}                    live metricsEntry snapshot
@@ -45,6 +46,7 @@ import json
 import sys
 
 from timetabling_ga_tpu.obs import metrics as obs_metrics
+from timetabling_ga_tpu.obs import usage as obs_usage
 from timetabling_ga_tpu.obs.spans import SpanTracer
 from timetabling_ga_tpu.problem import load_tim, load_tim_file
 from timetabling_ga_tpu.runtime import jsonl
@@ -132,11 +134,24 @@ class SolveService:
                 default_dir=cfg.profile_dir)
             if cfg.profile_for > 0:
                 self.profile_capture.trigger(cfg.profile_for)
+        # tt-meter (obs/usage.py, README "Usage metering"): the usage
+        # ledger's own daemon thread folds per-tenant capacity
+        # attribution off the drive loop; usageEntry records ride the
+        # writer under --obs (they are TIMING records — the stream is
+        # identical with metering on or off). --no-usage drops the
+        # whole meter (the bench A/B's other leg).
+        self.usage = None
+        if cfg.usage:
+            self.usage = obs_usage.UsageLedger(
+                registry=self._registry,
+                out=(self.writer if cfg.obs else None),
+                now=self.tracer.now)
         self.queue = JobQueue(cfg.backlog, now=now)
         self.scheduler = Scheduler(cfg, self.queue, self.writer,
                                    now=now, tracer=self.tracer,
                                    profiler=self.profile_capture,
-                                   registry=self._registry)
+                                   registry=self._registry,
+                                   usage=self.usage)
         self._auto_id = 0
         self.obs_server = None
         if cfg.obs_listen:
@@ -166,6 +181,8 @@ class SolveService:
                     self.profile_capture.close()
                 if self.mem_poller is not None:
                     self.mem_poller.close()
+                if self.usage is not None:
+                    self.usage.close()
                 if self.flight is not None:
                     self.flight.close()
                 if self.history is not None:
@@ -188,7 +205,8 @@ class SolveService:
 
     def submit(self, problem, job_id=None, priority: int = 0,
                seed=None, generations=None, deadline_s=None,
-               flow: int = 0, snapshot=None) -> str:
+               flow: int = 0, snapshot=None, tenant=None,
+               count_job: bool = True) -> str:
         """Admit one job; returns its id. Raises AdmissionError when
         the backlog is full or the id is taken (admission control).
         `flow` (optional) is an inherited causal flow id — the fleet
@@ -201,7 +219,14 @@ class SolveService:
         `emitted` floor — and `generations` stays the job's TOTAL
         budget (the remaining budget is total minus the snapshot's
         gens_done). A snapshot that fails validation demotes to a
-        fresh solve with a faultEntry, never an error."""
+        fresh solve with a faultEntry, never an error. `tenant`
+        (optional) tags the job for tt-meter capacity attribution
+        (obs/usage.py — sanitized to a bounded metric-safe label;
+        None/empty = the shared default tenant). `count_job=False`
+        marks a fleet RESEND (the gateway's X-TT-Resubmit): the job
+        is metered as usual but NOT re-counted in its tenant's `jobs`
+        ledger — its first admission, possibly on a now-dead replica
+        whose cached ledger the gateway still sums, already did."""
         if job_id is None:
             self._auto_id += 1
             job_id = f"job-{self._auto_id}"
@@ -212,7 +237,9 @@ class SolveService:
                                   if generations is None
                                   else generations),
                   deadline_s=deadline_s, flow=int(flow or 0),
-                  resume_wire=snapshot)
+                  resume_wire=snapshot,
+                  tenant=obs_usage.tenant_label(tenant),
+                  count_usage=bool(count_job))
         # prepare (pad + place) BEFORE the queue takes the job: a
         # failing instance is rejected here with the queue untouched —
         # no half-admitted job can reach the scheduler
@@ -265,6 +292,12 @@ class SolveService:
             self.profile_capture.close()
         if self.mem_poller is not None:
             self.mem_poller.close()
+        if self.usage is not None:
+            # BEFORE the writer closes: the ledger drains its pending
+            # settlements (their usageEntry lines enqueue into the
+            # writer), then the writer's own close drains those to the
+            # stream; a hung ledger is abandoned, never waited out
+            self.usage.close()
         try:
             self.writer.close()
         finally:
@@ -323,7 +356,8 @@ def serve_stream(cfg: ServeConfig, in_stream, out_stream=None,
                                seed=sub.get("seed"),
                                generations=sub.get("generations"),
                                deadline_s=sub.get("deadline"),
-                               snapshot=sub.get("snapshot"))
+                               snapshot=sub.get("snapshot"),
+                               tenant=sub.get("tenant"))
                 except Exception as e:
                     # one bad tenant must not take down the service:
                     # ANY submit-side failure (parse error, admission

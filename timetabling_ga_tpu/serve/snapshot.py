@@ -17,7 +17,12 @@ Wire object (JSON-safe — it rides the /v1 protocol):
      "emitted": 873, "best": 873,              # logEntry floor (the
                                                #   duplicate-free seam)
      "crc": 2839463521, "bytes": 51712,        # integrity of the npz
-     "npz": "<base64 of np.savez(PopState fields)>"}
+     "npz": "<base64 of np.savez(PopState fields)>",
+     "usage": {"gens": 150, "device_seconds": 1.2, ...}}
+                                               # OPTIONAL tt-meter
+                                               #   cursor (obs/usage):
+                                               #   the resumed job's
+                                               #   meter continues
 
 The fingerprint pins everything that must agree for the resumed lane
 to be bit-identical to the uninterrupted one: wire version, bucket key
@@ -93,21 +98,29 @@ def wire_fingerprint(bucket, pop_size: int, seed: int) -> str:
 
 def pack_state(state, *, bucket, pop_size: int, seed: int,
                gens_done: int, chunks: int, emitted: int,
-               best: int) -> dict:
+               best: int, usage: dict | None = None) -> dict:
     """Serialize one job's host PopState + progress cursor into the
     wire object. `state` must be the all-numpy park snapshot (never a
-    device array — packing runs on replica handler threads)."""
+    device array — packing runs on replica handler threads). `usage`
+    is the job's cumulative tt-meter at this fence (obs/usage.py) —
+    an OPTIONAL wire key, not in _REQUIRED, so pre-meter snapshots
+    still validate: a resumed job without a cursor simply meters from
+    zero on the survivor (honest, never wrong-by-duplication)."""
     buf = io.BytesIO()
     np.savez(buf, **{f: np.asarray(getattr(state, f))
                      for f in _FIELDS})
     raw = buf.getvalue()
-    return {"v": WIRE_VERSION,
+    wire = {"v": WIRE_VERSION,
             "fingerprint": wire_fingerprint(bucket, pop_size, seed),
             "bucket": [int(d) for d in bucket],
             "gens_done": int(gens_done), "chunks": int(chunks),
             "emitted": int(emitted), "best": int(best),
             "crc": zlib.crc32(raw) & 0xFFFFFFFF, "bytes": len(raw),
             "npz": base64.b64encode(raw).decode("ascii")}
+    if usage:
+        from timetabling_ga_tpu.obs import usage as usage_mod
+        wire["usage"] = usage_mod.rounded(usage)
+    return wire
 
 
 def verify_wire(wire, expect_fingerprint: str | None = None) -> bytes:
@@ -200,6 +213,10 @@ class ShipUnit:
     records: list               # the job's stream through this fence
     truncated: bool = False     # records list hit its cap — a resumed
     #                             stream cannot claim identity
+    usage: dict | None = None   # the job's cumulative tt-meter at
+    #                             this fence (obs/usage.py): the wire
+    #                             usage cursor a resumed job continues
+    #                             from instead of resetting
     wire: dict | None = None    # lazy pack memo (handler threads may
     #                             race it: both compute the same dict)
     records_bytes: int | None = None  # lazy serialized-size memo of
@@ -217,5 +234,5 @@ class ShipUnit:
                 self.state, bucket=self.bucket, pop_size=self.pop_size,
                 seed=self.seed, gens_done=self.gens_done,
                 chunks=self.chunks, emitted=self.emitted,
-                best=self.best)
+                best=self.best, usage=self.usage)
         return self.wire

@@ -80,6 +80,10 @@ _METRICS = [
     ("flight dump p50 s", "flight", "dump_p50_s"),
     ("flight ring hw B", "flight", "span_ring_bytes_hw"),
     ("flight bundles", "flight", "bundles_written"),
+    ("usage ms/dispatch", "usage",
+     "usage_overhead_ms_per_dispatch"),
+    ("usage conserved", "usage", "conservation_holds"),
+    ("usage tenants", "usage", "tenants_metered"),
 ]
 
 _NUM = r"(-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)"

@@ -64,6 +64,12 @@ if [ "${1:-}" = "--fast" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_flight.py -q -p no:cacheprovider -m 'not slow' \
         || fail=1
+    # likewise the usage acceptance (kill-mid-job tenant-total match);
+    # fast mode runs the conservation/identity/continuity/fleet tier
+    step "usage metering tests (tests/test_usage.py)"
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_usage.py -q -p no:cacheprovider -m 'not slow' \
+        || fail=1
     [ "$fail" -eq 0 ] && step "OK (fast mode: full test tier skipped)"
     exit $fail
 fi
