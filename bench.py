@@ -1203,6 +1203,216 @@ def measure_fleet() -> dict:
     return out
 
 
+def measure_autoscale() -> dict:
+    """extra.scale leg (ISSUE 15, tt-scale): a bursty multi-bucket
+    job stream against an AUTOSCALED fleet (1 replica + --scale-max 3,
+    in-process spawn pool) vs the FIXED 1-replica baseline:
+
+      jobs/min (auto vs fixed)  end-to-end completion rate at the
+                                gateway for the identical stream
+      p50/p99 latency           submit-to-settled per job
+      scale actions             ups / downs / blocked_warmth /
+                                blocked_cooldown counters + scaleEntry
+                                record count off the gateway log — the
+                                actuator's decision story
+      zero lost jobs            every job of BOTH legs settles `done`
+                                exactly once (scale-down is preempt
+                                drain — lossless by construction)
+      records identical         every job's stream (modulo timing)
+                                bit-equal to a bare unrouted
+                                SolveService AND across the two legs —
+                                the scaler is a pure actuator over the
+                                job streams
+
+    In-process replicas/spawns (private registries — the CPU test
+    double for worker processes); on a serial CPU box the spawned
+    replicas share cores, so the jobs/min delta reflects scheduling
+    overlap, not hardware scaling."""
+    import io
+
+    from timetabling_ga_tpu.fleet.gateway import Gateway
+    from timetabling_ga_tpu.fleet.replicas import (
+        http_json, in_process_replica)
+    from timetabling_ga_tpu.problem import dump_tim, random_instance
+    from timetabling_ga_tpu.runtime import jsonl
+    from timetabling_ga_tpu.runtime.config import FleetConfig, ServeConfig
+    from timetabling_ga_tpu.serve.service import SolveService
+
+    # three shape buckets (default geometric floors): the burst keeps
+    # landing fresh-bucket work that spawned capacity can absorb
+    shapes = [(28, 3, 24), (52, 5, 40), (100, 8, 60)]
+    problems = [random_instance(7000 + i, n_events=e, n_rooms=r,
+                                n_features=4, n_students=s,
+                                attend_prob=0.08)
+                for i, (e, r, s) in enumerate(
+                    shapes[i % 3] for i in range(12))]
+    tims = [dump_tim(p) for p in problems]
+    gens = 30
+
+    def serve_cfg():
+        return ServeConfig(backend="cpu", lanes=2, quantum=10,
+                           pop_size=6, max_steps=16,
+                           http="127.0.0.1:0")
+
+    def leg(scaled: bool):
+        rep0, h0 = in_process_replica(serve_cfg(), "a0")
+        reps = [rep0]
+
+        def spawn_fn(name):
+            rep, handle = in_process_replica(serve_cfg(), name)
+            reps.append(rep)
+            return handle
+
+        kw = {}
+        if scaled:
+            kw = dict(scale_min=1, scale_max=3,
+                      scale_up_queue=3.0, scale_up_for=1.0,
+                      scale_down_queue=1.0, scale_down_for=2.0,
+                      scale_idle_window=2.0, scale_cooldown=1.5,
+                      scale_every=0.2, scale_warm_recent=3.0)
+        fcfg = FleetConfig(listen="127.0.0.1:0", replicas=[h0.url],
+                           probe_every=0.1, poll_every=0.05,
+                           history_every=0.2, metrics_every=0, **kw)
+        gwbuf = io.StringIO()
+        gw = Gateway(fcfg, [h0], out=gwbuf,
+                     spawn_fn=spawn_fn if scaled else None).start()
+
+        def settled():
+            deadline = time.perf_counter() + 600
+            while time.perf_counter() < deadline:
+                with gw.jobs_lock:
+                    timed_jobs = [j for j in gw.jobs.values()
+                                  if j.id.startswith("sc")]
+                    if timed_jobs and all(
+                            j.terminal() and j.records_final
+                            for j in timed_jobs):
+                        return
+                time.sleep(0.05)
+
+        t0 = time.perf_counter()
+        for i, tim in enumerate(tims):
+            http_json("POST", gw.url + "/v1/solve",
+                      {"tim": tim, "id": f"sc{i}", "seed": i,
+                       "generations": gens})
+            time.sleep(0.05)          # a burst STREAM, not one batch
+        settled()
+        wall = time.perf_counter() - t0
+        counters = {}
+        if scaled:
+            # let the idle phase retire the spawned capacity (the
+            # lossless preempt-drain down) before reading the story
+            deadline = time.perf_counter() + 30
+            while (time.perf_counter() < deadline
+                   and gw.registry.counter(
+                       "fleet.scale.downs").value < 1):
+                time.sleep(0.1)
+            counters = {name: gw.registry.counter(
+                f"fleet.scale.{name}").value
+                for name in ("ups", "downs", "blocked_warmth",
+                             "blocked_cooldown")}
+        with gw.jobs_lock:
+            timed_jobs = [j for j in gw.jobs.values()
+                          if j.id.startswith("sc")]
+            lats = sorted(j.finished_t - j.submitted_t
+                          for j in timed_jobs
+                          if j.finished_t is not None)
+            records = {j.id: jsonl.strip_timing(j.records)
+                       for j in timed_jobs}
+            states = {j.id: j.state for j in timed_jobs}
+        gw.request_drain()
+        gw.drained.wait(60)
+        gw.close()
+        for rep in reps:
+            rep.kill()
+        scale_records = sum(1 for line in gwbuf.getvalue().splitlines()
+                            if '"scaleEntry"' in line)
+        return wall, lats, records, states, counters, scale_records
+
+    # warm-up: compile each bucket's lane programs ONCE before either
+    # leg — the islands program caches are process-global, so without
+    # this the FIRST leg pays every multi-second XLA compile inside
+    # its measurement and the A/B reads as compile order, not scaling
+    wbuf = io.StringIO()
+    warm = SolveService(ServeConfig(backend="cpu", lanes=2,
+                                    quantum=10, pop_size=6,
+                                    max_steps=16), out=wbuf)
+    for w, p in enumerate(problems[:3]):
+        warm.submit(p, job_id=f"warm{w}", seed=900 + w, generations=2)
+    warm.drive()
+    warm.close()
+
+    wall_a, lat_a, recs_a, states_a, ctr, scale_recs = leg(True)
+    wall_f, lat_f, recs_f, states_f, _, _ = leg(False)
+
+    # unrouted identity baseline: the same jobs on a bare SolveService
+    buf = io.StringIO()
+    svc = SolveService(ServeConfig(backend="cpu", lanes=2, quantum=10,
+                                   pop_size=6, max_steps=16), out=buf)
+    for i, p in enumerate(problems):
+        svc.submit(p, job_id=f"sc{i}", seed=i, generations=gens)
+    svc.drive()
+    svc.close()
+    base: dict = {}
+    for line in buf.getvalue().splitlines():
+        rec = json.loads(line)
+        body = rec[next(iter(rec))]
+        if isinstance(body, dict) and body.get("job") is not None:
+            base.setdefault(body["job"], []).append(rec)
+    base = {j: jsonl.strip_timing(rs) for j, rs in base.items()}
+    identical = all(recs_a.get(j) == base.get(j)
+                    and recs_f.get(j) == base.get(j) for j in base)
+    lost = sum(1 for s in list(states_a.values())
+               + list(states_f.values()) if s != "done")
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        return round(vals[min(len(vals) - 1, int(q * len(vals)))], 3)
+
+    out = {
+        "jobs": len(problems),
+        "generations_per_job": gens,
+        "jobs_per_min_scaled": round(len(problems) / wall_a * 60, 2),
+        "jobs_per_min_fixed": round(len(problems) / wall_f * 60, 2),
+        "scale_speedup": round(wall_f / wall_a, 2) if wall_a else 0.0,
+        "p50_latency_s_scaled": pct(lat_a, 0.5),
+        "p99_latency_s_scaled": pct(lat_a, 0.99),
+        "p50_latency_s_fixed": pct(lat_f, 0.5),
+        "p99_latency_s_fixed": pct(lat_f, 0.99),
+        "scale_ups": ctr.get("ups"),
+        "scale_downs": ctr.get("downs"),
+        "scale_blocked_warmth": ctr.get("blocked_warmth"),
+        "scale_blocked_cooldown": ctr.get("blocked_cooldown"),
+        "scale_entries_logged": scale_recs,
+        "jobs_lost": lost,
+        "records_identical": bool(identical),
+        "note": "12-job 3-bucket burst stream: gateway + in-process "
+                "1-replica fleet with --scale-max 3 (in-proc spawn "
+                "pool) vs the same fleet with the scaler off; "
+                "records_identical strips timing fields and compares "
+                "every job's stream in BOTH legs to a bare unrouted "
+                "SolveService. Spawned replicas share this box's "
+                "cores, so the jobs/min delta reflects scheduling "
+                "overlap, not hardware scaling; zero lost jobs is "
+                "the scale-down losslessness claim.",
+    }
+    errs = []
+    if lost:
+        errs.append(f"{lost} job(s) not done")
+    if not identical:
+        errs.append("scaled record stream diverged from unrouted")
+    if errs:
+        out["error"] = "; ".join(errs)
+    print(f"# scale: {out['jobs_per_min_scaled']} jobs/min autoscaled "
+          f"vs {out['jobs_per_min_fixed']} fixed "
+          f"(x{out['scale_speedup']}), actions "
+          f"up={out['scale_ups']} down={out['scale_downs']} "
+          f"blocked_warmth={out['scale_blocked_warmth']}, "
+          f"lost={lost}, records identical: {identical}",
+          file=sys.stderr)
+    return out
+
+
 def measure_resume() -> dict:
     """extra.resume leg (ISSUE 12): kill-mid-stream failover A/B —
     replay (`--snapshot-hwm 0`, the pre-ISSUE-12 behavior) vs resume
@@ -1761,6 +1971,7 @@ def main(argv=None) -> None:
             ("usage", measure_usage),
             ("soak", measure_soak),
             ("fleet", measure_fleet),
+            ("scale", measure_autoscale),
             ("resume", measure_resume),
             ("scrape", measure_scrape),
             ("scale_2000ev", measure_scale),

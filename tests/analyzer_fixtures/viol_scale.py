@@ -1,0 +1,69 @@
+"""TT608 fixture: fleet actuation off the scaler thread.
+
+Not imported or executed — parsed by tests/test_analysis.py. The
+tt-scale contract (fleet/autoscaler.py): spawning, preempting, and
+adopting replicas (and the process/port mutation underneath) happen
+ONLY on the autoscaler's control-loop thread, where the decision
+carries sustained-window evidence, cooldown hysteresis, and the
+warmth guard. Handlers enqueue; the dispatcher executes enqueued
+commands.
+"""
+import http.server
+import subprocess
+
+
+class ScaleHandler(http.server.BaseHTTPRequestHandler):
+    def do_POST(self):
+        # a client POST that resizes the fleet: no policy, no guard
+        self.server.gw.preempt_replica("r0")             # EXPECT TT608
+        subprocess.Popen(["tt"])  # EXPECT TT608 # EXPECT TT602
+
+    def _grow(self):
+        # reachable via self._grow() from a do_* method — still the
+        # handler path
+        handle = spawn_one(self.server.cfg, "s9")        # EXPECT TT608
+        self.server.gw.adopt_replica(handle)             # EXPECT TT608
+
+    def do_PUT(self):
+        self._grow()
+
+
+class ScalerApi:
+    # a fleet-front api surface (handler-api-suffixes root): its
+    # methods run ON handler threads even without do_* names
+    def accept_scale(self, payload):
+        self._gw.retire_replica(payload["replica"])      # EXPECT TT608
+        return 202, {}
+
+    def scale_view(self):
+        # OK: reading the decision snapshot is exactly what a
+        # handler is for
+        return 200, self._gw.scale_snapshot()
+
+
+class FakeGateway:
+    def _dispatch_loop(self):
+        while True:
+            self._poll_jobs()
+            # originating actuation on the dispatcher tick: stalls
+            # routing/polling/failover and skips the policy's guards
+            self.preempt_replica("r1")                   # EXPECT TT608
+
+    def _handle(self, cmd):
+        port = free_port()                               # EXPECT TT608
+        return port
+
+    def _drain_tick(self):
+        for handle in self.replicas.live():
+            # executing a graceful drain COMMAND is fine — drain is
+            # not an actuator verb
+            handle.drain(timeout=2.0)
+
+
+def scaler_thread_is_fine(gw, cfg, victim):
+    # OK: not a handler path, not a tick body — the autoscaler's
+    # control loop is the sanctioned actuation site (and
+    # fleet/autoscaler.py itself is exempt wholesale)
+    handle = gw.replicas.get(victim)
+    handle.retired = True
+    gw.preempt_replica(victim)

@@ -50,7 +50,8 @@ def fixture_config() -> AnalyzerConfig:
     cfg.sharded_modules = (list(cfg.sharded_modules)
                            + ["viol_collective.py", "viol_quality.py"])
     cfg.fleet_modules = list(cfg.fleet_modules) + ["viol_fleet.py",
-                                                   "viol_gw_api.py"]
+                                                   "viol_gw_api.py",
+                                                   "viol_scale.py"]
     return cfg
 
 
@@ -89,6 +90,8 @@ def analyze_fixture(fixture: str):
     "viol_usage.py",       # TT607 usage-ledger mutation in trace
     #                        targets / handler paths + handler-side
     #                        metering clocks (tt-meter)
+    "viol_scale.py",       # TT608 fleet actuator calls on handler
+    #                        paths / dispatcher-tick bodies (tt-scale)
 ])
 def test_rule_fires_at_expected_lines(fixture):
     """Each rule family fires exactly at the marked (rule, line) pairs —

@@ -286,6 +286,44 @@ def test_respawned_replica_keeps_dead_incarnations_ledger():
     assert merged["jobs"]["j"]["usage"]["gens"] == 600
 
 
+def test_static_restart_detected_by_backward_counters():
+    """The documented PR-14 gap, closed (ISSUE 15 satellite): a STATIC
+    (non-spawned) replica restarted behind our back has no respawn
+    event to fold its ledger on — the prober now detects the restart
+    by the BACKWARD-moving usage counters of the fresh scrape
+    (obs/usage.progress, the flight-recorder dump-counter discipline)
+    and folds the dead incarnation's cached payload into usage_base,
+    so the bill survives external restarts too."""
+    from timetabling_ga_tpu.fleet.replicas import ReplicaHandle
+    h = ReplicaHandle("r0", "http://127.0.0.1:1")   # static: no proc,
+    #                                                 no respawn
+    old = {"tenants": {"acme": dict(obs_usage.new_usage(), jobs=2,
+                                    gens=300, flops=90.0)},
+           "jobs": {}}
+    h.note_usage(old)
+    # forward motion: a normal scrape replaces, never folds
+    grown = {"tenants": {"acme": dict(obs_usage.new_usage(), jobs=2,
+                                      gens=400, flops=120.0)},
+             "jobs": {}}
+    h.note_usage(grown)
+    assert h.usage_base is None
+    assert h.usage_payload()["tenants"]["acme"]["gens"] == 400
+    # the restart: counters moved BACKWARD — the fresh incarnation's
+    # near-empty ledger must ADD to the cached one, not replace it
+    fresh = {"tenants": {"acme": dict(obs_usage.new_usage(), jobs=1,
+                                      gens=50, flops=10.0)},
+             "jobs": {}}
+    h.note_usage(fresh)
+    assert h.usage_base is not None
+    merged = h.usage_payload()
+    assert merged["tenants"]["acme"]["gens"] == 450
+    assert merged["tenants"]["acme"]["jobs"] == 3
+    assert merged["tenants"]["acme"]["flops"] == 130.0
+    # progress() is the monotone restart detector itself
+    assert obs_usage.progress(fresh) < obs_usage.progress(grown)
+    assert obs_usage.progress({}) == 0.0
+
+
 def test_resubmit_header_does_not_rebill_job():
     """A gateway RESEND (X-TT-Resubmit — failover replay/resume)
     admits and METERS the job but never re-counts it in the tenant's

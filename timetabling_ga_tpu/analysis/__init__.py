@@ -53,6 +53,13 @@ Rules (see README "Static analysis & sanitizers"):
          its own thread; handlers READ the meter (`totals()`), and
          metering timestamps belong to the drive loop's fence
          brackets, never a scrape's (obs/usage.py)
+  TT608  fleet actuator calls (spawn / preempt / adopt / process+port
+         mutation) on HTTP handler paths or inside dispatcher-tick
+         bodies — the tt-scale autoscaler thread is the only legal
+         actuation site: handlers enqueue, the dispatcher executes
+         enqueued commands, and replica-count decisions carry the
+         policy's sustained-window evidence, cooldown, and warmth
+         guard (fleet/autoscaler.py)
 
 Suppress one finding inline with `# tt-analyze: ignore[TT301]` (on the
 line, or on a comment line directly above). Configure via
@@ -90,8 +97,8 @@ def _rule_modules():
     from timetabling_ga_tpu.analysis import (
         rules_api, rules_cost, rules_donate, rules_fleet,
         rules_flight, rules_http, rules_obs, rules_quality,
-        rules_recompile, rules_rng, rules_sync, rules_trace,
-        rules_usage)
+        rules_recompile, rules_rng, rules_scale, rules_sync,
+        rules_trace, rules_usage)
     return {
         "TT101": rules_trace,
         "TT102": rules_trace,
@@ -111,6 +118,7 @@ def _rule_modules():
         "TT605": rules_fleet,
         "TT606": rules_flight,
         "TT607": rules_usage,
+        "TT608": rules_scale,
     }
 
 

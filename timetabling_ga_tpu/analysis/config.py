@@ -17,7 +17,8 @@ import re
 
 ALL_RULES = ("TT101", "TT102", "TT201", "TT202", "TT203", "TT301",
              "TT302", "TT401", "TT402", "TT501", "TT502", "TT601",
-             "TT602", "TT603", "TT604", "TT605", "TT606", "TT607")
+             "TT602", "TT603", "TT604", "TT605", "TT606", "TT607",
+             "TT608")
 
 
 @dataclasses.dataclass
@@ -75,6 +76,12 @@ class AnalyzerConfig:
     # cannot see
     handler_api_suffixes: list[str] = dataclasses.field(
         default_factory=lambda: ["Api"])
+    # function-name pattern marking dispatcher-tick bodies (TT608 bans
+    # fleet actuator calls — spawn / preempt / process+port mutation —
+    # inside them: the tt-scale scaler thread is the only legal
+    # actuation site, fleet/autoscaler.py)
+    scale_tick_pattern: str = (r"^_dispatch_loop$|^_handle$|^_poll"
+                               r"|^_tick|^_drain_tick$")
 
     root: str = "."
 

@@ -46,6 +46,10 @@ JSONL record stream, never a device.
         queue/park wall, compile amortization — from usageEntry logs
         or a live replica/gateway /v1/usage endpoint (the gateway
         aggregates fleet-wide, dead replicas' ledgers included)
+    python -m timetabling_ga_tpu.cli scale gateway.jsonl
+        render the tt-scale autoscaler's decision log (README
+        "Autoscaling"): every spawn/retire/blocked decision with the
+        sustained-window evidence that justified it
     python -m timetabling_ga_tpu.cli incident ./incidents [--job ID]
         summarize the flight recorder's bundles (--incident-dir) and
         render the newest — a stitched gateway bundle renders the
@@ -116,6 +120,13 @@ def main(argv=None) -> int:
         # capture its next N dispatches (obs/cost.py ProfileCapture)
         from timetabling_ga_tpu.obs.cost import main_profile
         return main_profile(argv[1:])
+    if argv and argv[0] == "scale":
+        # deferred + jax-free like trace/stats: render the tt-scale
+        # autoscaler's decision log (scaleEntry records with their
+        # sustained-window evidence — fleet/autoscaler.py, README
+        # "Autoscaling")
+        from timetabling_ga_tpu.fleet.autoscaler import main_scale
+        return main_scale(argv[1:])
     if argv and argv[0] == "fleet":
         # the fleet gateway (README "Fleet"; timetabling_ga_tpu/fleet):
         # HTTP solve front + bucket-affine router over N replicas —

@@ -613,7 +613,7 @@ class Gateway:
     owns routing, submission, polling, failover, and drain."""
 
     def __init__(self, cfg: FleetConfig, handles, owned: bool = False,
-                 now=None, out=None):
+                 now=None, out=None, spawn_fn=None):
         # deterministic fault injection, mirroring SolveService: the
         # gateway/route sites fire under `tt fleet` too
         spec = faults.active_spec(cfg.faults)
@@ -652,8 +652,9 @@ class Gateway:
         self.writer = None
         self.front = None
         self.replicas = None
+        self.scaler = None
         try:
-            self._init_rest(cfg, handles, out)
+            self._init_rest(cfg, handles, out, spawn_fn)
         except BaseException:
             # ANY constructor failure past the thread starts — a taken
             # listen port, an unwritable -o path, a bad worker-flag
@@ -664,6 +665,8 @@ class Gateway:
             # close() is unreachable here)
             if self.front is not None:
                 self.front.close()
+            if self.scaler is not None:
+                self.scaler.close()
             if self.flight is not None:
                 self.flight.close()
             if self.history is not None:
@@ -682,7 +685,8 @@ class Gateway:
                 self.replicas.close()
             raise
 
-    def _init_rest(self, cfg: FleetConfig, handles, out) -> None:
+    def _init_rest(self, cfg: FleetConfig, handles, out,
+                   spawn_fn=None) -> None:
         # -- telemetry stream (tt-obs v5): `-o LOG` (or an explicit
         # `out` stream) gives the gateway its own AsyncWriter + tracer;
         # without one the tracer is the shared no-op and nothing emits
@@ -764,14 +768,41 @@ class Gateway:
         # served by handlers under _view_lock (never the live state)
         self._view_lock = threading.Lock()
         self._view_cache: dict = {}
+        # tt-scale inputs published alongside it: per-replica in-flight
+        # counts and the warmth-guard protections, computed ON the
+        # dispatcher (the only thread that may read router warmth) and
+        # read by the SCALER thread under the same lock
+        self._scale_cache: dict = {}
+        self._bucket_routed_t: dict = {}   # bucket -> last placement
+        #                                    time (the warmth guard's
+        #                                    'recently routed' input)
         self._thread = threading.Thread(
             target=self._dispatch_loop, name="tt-fleet-dispatch",
             daemon=True)
+        # tt-scale (fleet/autoscaler.py, README "Autoscaling"): the
+        # policy actuator, constructed before the front so /healthz
+        # can probe it, started by start(). Scale-up needs the --spawn
+        # worker pool; an injected spawn_fn is the test seam (and how
+        # a dry-run over a static fleet stays actuation-free).
+        probes = {"dispatcher": self._thread.is_alive}
+        if cfg.scale_max > 0:
+            from timetabling_ga_tpu.fleet.autoscaler import AutoScaler
+            if spawn_fn is None and self.owned \
+                    and not cfg.scale_dry_run:
+                from timetabling_ga_tpu.fleet import (
+                    replicas as replicas_mod)
+
+                def spawn_fn(name, cfg=cfg):
+                    return replicas_mod.spawn_one(cfg, name)
+
+            self.scaler = AutoScaler(self, cfg, spawn_fn=spawn_fn,
+                                     now=self.now)
+            probes["scaler"] = self.scaler.alive
         # a taken listen port raises here — __init__'s outer guard
         # closes every thread/handle started above
         self.front = obs_http.ObsServer(
             cfg.listen, registry=self.registry,
-            probes={"dispatcher": self._thread.is_alive},
+            probes=probes,
             handler=ApiHandler, api=GatewayApi(self),
             site="gateway", history=self.history)
         self._refresh_view()
@@ -786,6 +817,8 @@ class Gateway:
         self.replicas.start()
         self.front.start()
         self._thread.start()
+        if self.scaler is not None:
+            self.scaler.start()
         return self
 
     @property
@@ -796,6 +829,17 @@ class Gateway:
         self.draining = True
         self.inbox.put(("drain",))
 
+    def adopt_replica(self, handle) -> None:
+        """tt-scale scale-up (runs on the SCALER thread — the only
+        legal actuation site, TT608): register a just-spawned worker.
+        The prober picks it up next round (`--boot-grace` covers its
+        jax import, exactly like a startup spawn), the router sees it
+        once ready, and its gauges join the fleet.replica.* families
+        the history ring samples. Handle-set and registry mutations
+        only — router state stays the dispatcher's."""
+        self.replicas.add(handle)
+        self._bind_replica_gauges(handle)
+
     def preempt_replica(self, name: str) -> None:
         """Targeted lossless scale-down (README "Fleet resume"):
         preempt ONE replica — it parks + ships every job it owns, the
@@ -804,9 +848,15 @@ class Gateway:
         self.inbox.put(("preempt", name))
 
     def close(self) -> None:
+        # the scaler goes first: it emits records through the writer
+        # being drained below and actuates through the dispatcher
+        # being stopped below
+        if self.scaler is not None:
+            self.scaler.close()
         self._stop = True
         self.inbox.put(("wake",))
-        self._thread.join(timeout=5.0)
+        if self._thread.ident is not None:   # never-started (close
+            self._thread.join(timeout=5.0)   # before start): no join
         if self.writer is not None:
             # final registry snapshot, then drain the telemetry log —
             # raise_error=False: a latched writer error must not mask
@@ -904,20 +954,77 @@ class Gateway:
         """Rebuild the /v1/fleet snapshot ON the dispatcher (the only
         thread mutating router/job state) and publish it under the
         view lock — fleet_view handlers read the copy, racing
-        nothing."""
+        nothing. The tt-scale snapshot is computed here too: the
+        warmth guard reads router warmth and the job table, both
+        owned by this thread, so the SCALER thread only ever sees a
+        published copy."""
         with self.jobs_lock:
             states: dict = {}
+            inflight_by_rep: dict = {}
+            hot: set = set()
             for j in self.jobs.values():
                 states[j.state] = states.get(j.state, 0) + 1
+                if not j.terminal():
+                    if j.replica is not None:
+                        inflight_by_rep[j.replica] = (
+                            inflight_by_rep.get(j.replica, 0) + 1)
+                    if j.bucket is not None:
+                        hot.add(j.bucket)
+        # the tt-scale snapshot is only ever read by the scaler
+        # thread — with the autoscaler off this dispatcher tick does
+        # none of the warmth/load bookkeeping
+        scale = None
+        if self.scaler is not None:
+            # hot buckets: in-flight jobs' buckets plus anything
+            # routed within --scale-warm-recent (entries beyond the
+            # window are pruned — the dict stays bounded by live
+            # bucket churn)
+            now = self.now()
+            for bucket, t in list(self._bucket_routed_t.items()):
+                if now - t <= self.cfg.scale_warm_recent:
+                    hot.add(bucket)
+                else:
+                    del self._bucket_routed_t[bucket]
+            # warmth protection considers SURVIVING capacity only: a
+            # retiring replica is still draining (and warm), but it
+            # is leaving — counting it as a warm owner would leave a
+            # hot bucket's last remaining home unprotected
+            live = [h for h in self.replicas.live()
+                    if not getattr(h, "retired", False)]
+            protected: dict = {}
+            for bucket in hot:
+                owner = self.router.sole_warm_owner(
+                    bucket, [h.name for h in live])
+                if owner is not None:
+                    protected.setdefault(owner, []).append(
+                        list(bucket))
+            scale = {
+                "replicas": {
+                    h.name: {"dead": h.dead,
+                             "retired": getattr(h, "retired", False),
+                             "inflight": inflight_by_rep.get(h.name,
+                                                             0),
+                             "pins": self.router.pin_counts.get(
+                                 h.name, 0)}
+                    for h in self.replicas.all()},
+                "protected": protected}
         view = {"replicas": [h.view() for h in self.replicas.all()],
                 "router": self.router.stats(),
                 "jobs": states, "draining": self.draining}
         with self._view_lock:
             self._view_cache = view
+            if scale is not None:
+                self._scale_cache = scale
 
     def fleet_snapshot(self) -> dict:
         with self._view_lock:
             return self._view_cache
+
+    def scale_snapshot(self) -> dict:
+        """The autoscaler's warmth/load inputs, as last published by
+        the dispatcher tick (read on the SCALER thread)."""
+        with self._view_lock:
+            return self._scale_cache
 
     def _slo_tick(self) -> None:
         """--slo-p99 rolling-window monitor: p99 over the last
@@ -1130,6 +1237,12 @@ class Gateway:
             return
         job.replica = handle.name
         job.state = "routed"
+        # the warmth guard's 'recently routed' input (tt-scale): a
+        # bucket placed within --scale-warm-recent is HOT — its sole
+        # warm replica must survive scale-down (scaler-off gateways
+        # skip the bookkeeping; _refresh_view never prunes it there)
+        if self.scaler is not None:
+            self._bucket_routed_t[job.bucket] = self.now()
         self.registry.counter("fleet.jobs_routed").inc()
         # the `routed` span: admit-at-gateway → accepted-by-replica
         # for the FIRST placement, failover-instant → re-accepted for

@@ -744,6 +744,15 @@ class ReplicaHandle:
         self.restarts = 0
         self.fails = 0               # consecutive failed probes
         self.dead = False
+        self.retired = False         # tt-scale scale-down: this
+        #                              replica was DELIBERATELY
+        #                              preempt-drained (fleet/
+        #                              autoscaler.py) — its exit is
+        #                              expected, so the prober must
+        #                              not respawn it (and the scaler
+        #                              stops counting it toward the
+        #                              live target the moment the
+        #                              retire decision lands)
         self.ok_once = False         # ever answered a probe
         self.born = time.monotonic()  # (re)spawn time: boot grace
         # -- router inputs (refreshed by probe()) -----------------------
@@ -781,11 +790,17 @@ class ReplicaHandle:
         #                              never replace it — _declare_dead
         #                              folds last_usage in here before
         #                              the respawn, and usage_payload()
-        #                              serves the sum (a STATIC replica
-        #                              restarted behind our back still
-        #                              loses its pre-restart ledger:
-        #                              there is no respawn event to
-        #                              fold on — documented limit).
+        #                              serves the sum. A STATIC
+        #                              replica restarted behind our
+        #                              back has no respawn event to
+        #                              fold on — the prober instead
+        #                              detects the restart by its
+        #                              BACKWARD-moving usage counters
+        #                              on the next scrape
+        #                              (obs/usage.progress, the
+        #                              flight-dump counter
+        #                              discipline) and folds the
+        #                              cached payload then.
         #                              The (base, last) PAIR is read
         #                              and written under _usage_lock:
         #                              unlike the single-attribute
@@ -872,8 +887,7 @@ class ReplicaHandle:
         try:
             fresh = self.get_usage(timeout=timeout)
             if fresh is not None:
-                with self._usage_lock:
-                    self.last_usage = fresh
+                self.note_usage(fresh)
         except Exception:
             pass                     # keep the previous copy
 
@@ -965,6 +979,28 @@ class ReplicaHandle:
             if e.status == 404:
                 return None
             raise
+
+    def note_usage(self, fresh) -> None:
+        """Cache a just-scraped `/v1/usage` payload (PROBER thread).
+        BACKWARD-moving usage counters mean the replica is a fresh
+        incarnation: a STATIC replica restarted behind our back has no
+        respawn event for retire_usage to ride (the PR-14 documented
+        gap), so the restart is detected HERE, by the counters
+        themselves (obs/usage.progress — the flight-dump
+        counter-baseline discipline), and the cached payload — the
+        dead incarnation's final ledger — folds into `usage_base`
+        before the fresh one replaces it. The bill survives external
+        restarts too (tests/test_usage.py pins it)."""
+        from timetabling_ga_tpu.obs import usage as obs_usage
+        with self._usage_lock:
+            if (self.last_usage is not None
+                    and obs_usage.progress(fresh)
+                    < obs_usage.progress(self.last_usage)):
+                self.usage_base = (
+                    self.last_usage if self.usage_base is None
+                    else obs_usage.combine(
+                        [self.usage_base, self.last_usage]))
+            self.last_usage = fresh
 
     def usage_payload(self):
         """This handle's whole metered history: retired incarnations'
@@ -1081,6 +1117,14 @@ class ReplicaSet:
     def get(self, name: str):
         return self._handles.get(name)
 
+    def add(self, handle: ReplicaHandle) -> None:
+        """Adopt a replica mid-run (the tt-scale autoscaler's scale-up
+        seam): the prober picks it up on its next round, `--boot-grace`
+        covers its jax import exactly like a startup spawn. A single
+        dict store — the probe loop iterates over list() copies, so no
+        lock is needed."""
+        self._handles[handle.name] = handle
+
     # -- probing --------------------------------------------------------
 
     def start(self) -> "ReplicaSet":
@@ -1091,7 +1135,8 @@ class ReplicaSet:
         for handle in list(self._handles.values()):
             if not handle.dead:
                 self._probe_one(handle)
-            elif handle.respawn is None and handle.proc is None:
+            elif (handle.respawn is None and handle.proc is None
+                  and not handle.retired):
                 # a STATIC (externally managed) replica keeps being
                 # probed after death: a network blip that failed
                 # dead_after probes must not remove a healthy process
@@ -1128,7 +1173,8 @@ class ReplicaSet:
 
     def _declare_dead(self, handle: ReplicaHandle) -> None:
         respawned = False
-        if (not self._no_restart and handle.respawn is not None
+        if (not self._no_restart and not handle.retired
+                and handle.respawn is not None
                 and handle.restarts < self.max_restarts):
             try:
                 handle.terminate()   # reap a half-dead process first
@@ -1184,31 +1230,35 @@ def free_port() -> int:
         s.close()
 
 
+def spawn_one(cfg: FleetConfig, name: str) -> ReplicaHandle:
+    """One `tt serve --http` worker process on a fresh local port:
+    the unit behind `--spawn N` startup AND the tt-scale autoscaler's
+    scale-up actuation (fleet/autoscaler.py — the scaler thread is
+    the only mid-run caller, TT608). The worker's record stream goes
+    to ./tt-fleet-<name>.jsonl unless the passthrough serve flags
+    already set -o; the respawn closure reuses the same port, so a
+    restarted replica keeps its URL."""
+    port = free_port()
+    argv = [sys.executable, "-m", "timetabling_ga_tpu", "serve",
+            "--http", f"127.0.0.1:{port}",
+            "--backend", cfg.backend]
+    if "-o" not in cfg.serve_args:
+        argv += ["-o", f"tt-fleet-{name}.jsonl"]
+    argv += list(cfg.serve_args)
+
+    def respawn(argv=tuple(argv)):
+        return subprocess.Popen(
+            list(argv), stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+
+    return ReplicaHandle(name, f"http://127.0.0.1:{port}",
+                         proc=respawn(), respawn=respawn)
+
+
 def spawn_local(cfg: FleetConfig) -> list:
-    """`tt fleet --spawn N`: one `tt serve --http` worker per replica.
-    Each worker's record stream goes to ./tt-fleet-<name>.jsonl unless
-    the passthrough serve flags already set -o; the respawn closure
-    reuses the same port, so a restarted replica keeps its URL."""
-    handles = []
-    for i in range(cfg.spawn):
-        name = f"r{i}"
-        port = free_port()
-        argv = [sys.executable, "-m", "timetabling_ga_tpu", "serve",
-                "--http", f"127.0.0.1:{port}",
-                "--backend", cfg.backend]
-        if "-o" not in cfg.serve_args:
-            argv += ["-o", f"tt-fleet-{name}.jsonl"]
-        argv += list(cfg.serve_args)
-
-        def respawn(argv=tuple(argv)):
-            return subprocess.Popen(
-                list(argv), stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL)
-
-        handles.append(ReplicaHandle(
-            name, f"http://127.0.0.1:{port}", proc=respawn(),
-            respawn=respawn))
-    return handles
+    """`tt fleet --spawn N`: one `tt serve --http` worker per
+    replica (spawn_one each)."""
+    return [spawn_one(cfg, f"r{i}") for i in range(cfg.spawn)]
 
 
 def in_process_replica(cfg: ServeConfig, name: str, now=None

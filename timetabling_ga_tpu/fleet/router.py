@@ -204,6 +204,16 @@ class Router:
             del self._pins[bucket]
         self.pin_counts[name] = 0
 
+    def sole_warm_owner(self, bucket: tuple, live_names) -> str | None:
+        """The ONE live replica warm for `bucket`, or None when zero
+        or several are — the tt-scale warmth guard's input
+        (fleet/autoscaler.py): scale-down must never retire a hot
+        bucket's only warm home. Dispatcher-thread only, like every
+        other read of the warmth map."""
+        owners = [n for n in live_names
+                  if bucket in self._warm.get(n, ())]
+        return owners[0] if len(owners) == 1 else None
+
     # -- accounting -----------------------------------------------------
 
     def hit_rate(self) -> float:

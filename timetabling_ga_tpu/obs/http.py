@@ -400,4 +400,5 @@ class ObsServer:
             except Exception:
                 pass
         self._srv.server_close()
-        self._thread.join(timeout=2.0)
+        if self._thread.ident is not None:   # never-started: no join
+            self._thread.join(timeout=2.0)

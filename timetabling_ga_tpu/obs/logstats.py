@@ -173,6 +173,9 @@ def summarize(records) -> str:
     routes: list = []       # routeEntry bodies (placement summary)
     compiles: list = []     # costEntry bodies (compile accounting)
     usage_recs: list = []   # whole records (obs/usage.py summarize)
+    scale_recs: list = []   # whole records (fleet/autoscaler.py
+    #                         summarize_entries — the tt-scale
+    #                         decision log)
     quality_recs: list = []  # whole records (obs/quality.py summarize)
     counts: dict = {}
     last_metrics = None
@@ -203,6 +206,8 @@ def summarize(records) -> str:
             compiles.append(body)
         elif kind == "usageEntry":
             usage_recs.append(rec)
+        elif kind == "scaleEntry":
+            scale_recs.append(rec)
         elif kind == "qualityEntry":
             quality_recs.append(rec)
         elif kind == "metricsEntry":
@@ -379,6 +384,13 @@ def summarize(records) -> str:
         # queue/park wall, compile amortization
         from timetabling_ga_tpu.obs import usage as obs_usage
         lines.append(obs_usage.summarize_entries(usage_recs))
+
+    if scale_recs:
+        # tt-scale (fleet/autoscaler.py owns the report): the
+        # autoscaler decision log with its sustained-window evidence
+        from timetabling_ga_tpu.fleet.autoscaler import (
+            summarize_entries as scale_summary)
+        lines.append(scale_summary(scale_recs))
 
     if quality_recs:
         # search-quality observatory (obs/quality.py owns the report):

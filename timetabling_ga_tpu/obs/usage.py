@@ -431,6 +431,25 @@ class UsageLedger:
 # ------------------------------------------------- fleet-wide aggregation
 
 
+def progress(payload) -> float:
+    """A monotone scalar over one `/v1/usage` payload's tenant ledgers
+    (gens + dispatches + jobs — counters that only ever grow within
+    one process incarnation). Two consecutive scrapes of the SAME
+    replica URL where this number moves BACKWARD mean the process
+    restarted behind our back and the fresh incarnation's ledger
+    started over — the flight-recorder dump-counter discipline,
+    applied to billing: fleet/replicas.py folds the dead incarnation's
+    cached payload into `usage_base` when it sees one, so a static
+    (non-spawned) replica's bill survives external restarts too."""
+    total = 0.0
+    for t in ((payload or {}).get("tenants") or {}).values():
+        for f in ("gens", "dispatches", "jobs"):
+            v = t.get(f, 0)
+            if isinstance(v, (int, float)) and v == v:
+                total += float(v)
+    return total
+
+
 def combine(payloads) -> dict:
     """Merge {tenants, jobs} usage payloads into one: tenant meters
     SUM (each payload counted only its own metered work), per-job

@@ -1024,6 +1024,60 @@ class FleetConfig:
     history_every: float = 1.0
     incident_dir: Optional[str] = None
     incident_min_interval: float = 30.0
+    # ---- tt-scale (fleet/autoscaler.py, README "Autoscaling"): the
+    # policy-driven actuator that spawns and retires `--spawn` workers
+    # off SUSTAINED fleet signals (the obs/history.py window queries
+    # over the gateway's own registry). Enabled iff --scale-max > 0;
+    # actuation needs the --spawn worker pool (a static --replica
+    # fleet has no pool to grow) unless --scale-dry-run, which
+    # evaluates and logs decisions without acting. Every decision is a
+    # scaleEntry record on the gateway log (TIMING domain — job
+    # streams are bit-identical with the scaler on or off) plus the
+    # fleet.scale.* metrics families, which the history rings sample
+    # like everything else.
+    scale_min: int = 1               # never retire below this many
+    #                                  live replicas
+    scale_max: int = 0               # never spawn above this many;
+    #                                  0 = autoscaler off
+    scale_up_queue: float = 8.0      # spawn trigger: gateway
+    #                                  serve.queue_depth (active jobs)
+    #                                  sustained >= this ...
+    scale_up_for: float = 30.0       # ... for this many seconds
+    #                                  (also the sustained window for
+    #                                  the fleet.slo_burn spawn
+    #                                  trigger)
+    scale_down_queue: float = 1.0    # retire trigger: queue_depth
+    #                                  sustained <= this ...
+    scale_down_for: float = 120.0    # ... for this many seconds
+    scale_idle_window: float = 300.0  # a retire VICTIM must also show
+    #                                  mean_over(fleet.replica.<n>.
+    #                                  backlog, this window) <= the
+    #                                  scale-down threshold — per-
+    #                                  replica idleness, not just
+    #                                  fleet-wide calm
+    scale_cooldown: float = 60.0     # hysteresis: seconds after any
+    #                                  scale action before the next
+    #                                  may fire (spawn OR retire —
+    #                                  blocked attempts count
+    #                                  fleet.scale.blocked_cooldown);
+    #                                  the below-min floor heal
+    #                                  bypasses it
+    scale_every: float = 1.0         # policy evaluation cadence on
+    #                                  the scaler thread
+    scale_warm_recent: float = 120.0  # warmth guard: a bucket routed
+    #                                  within this many seconds (or
+    #                                  with in-flight jobs) is HOT —
+    #                                  scale-down never retires its
+    #                                  only warm replica
+    #                                  (fleet.scale.blocked_warmth)
+    scale_starve_rate: float = 0.0   # premium-tier starvation spawn:
+    #                                  a tenant whose usage.tenant.<t>
+    #                                  .queue_seconds grows at/above
+    #                                  this rate (s/s) over the
+    #                                  scale-up window triggers a
+    #                                  spawn; 0 = off
+    scale_dry_run: bool = False      # evaluate + log scaleEntry
+    #                                  decisions, actuate nothing
     serve_args: list = dataclasses.field(default_factory=list)
     #                                  verbatim worker flags (after --)
 
@@ -1054,8 +1108,21 @@ _FLEET_FLAG_MAP = {
     "--backlog": ("backlog", int),
     "--snapshot-hwm": ("snapshot_hwm", int),
     "--snapshot-timeout": ("snapshot_timeout", float),
+    "--scale-min": ("scale_min", int),
+    "--scale-max": ("scale_max", int),
+    "--scale-up-queue": ("scale_up_queue", float),
+    "--scale-up-for": ("scale_up_for", float),
+    "--scale-down-queue": ("scale_down_queue", float),
+    "--scale-down-for": ("scale_down_for", float),
+    "--scale-idle-window": ("scale_idle_window", float),
+    "--scale-cooldown": ("scale_cooldown", float),
+    "--scale-every": ("scale_every", float),
+    "--scale-warm-recent": ("scale_warm_recent", float),
+    "--scale-starve-rate": ("scale_starve_rate", float),
     "--faults": ("faults", str),
 }
+
+_FLEET_BOOL_FLAGS = {"--scale-dry-run": "scale_dry_run"}
 
 
 def _fleet_usage() -> str:
@@ -1065,7 +1132,9 @@ def _fleet_usage() -> str:
          "fleet gateway: HTTP solve front + bucket-affine router over "
          "N replicas (`--replica` may repeat; flags after `--` pass "
          "through to spawned `tt serve --http` workers):"],
-        {"--replica": ("replicas (repeatable)", str), **_FLEET_FLAG_MAP})
+        {"--replica": ("replicas (repeatable)", str),
+         **_FLEET_FLAG_MAP},
+        (_FLEET_BOOL_FLAGS,))
 
 
 def parse_fleet_args(argv) -> FleetConfig:
@@ -1089,7 +1158,8 @@ def parse_fleet_args(argv) -> FleetConfig:
         else:
             rest.append(argv[i])
             i += 1
-    _parse_flag_stream(rest, cfg, _FLEET_FLAG_MAP, _fleet_usage)
+    _parse_flag_stream(rest, cfg, _FLEET_FLAG_MAP, _fleet_usage,
+                       _FLEET_BOOL_FLAGS)
     _validate_obs_listen(cfg.listen)
     if cfg.backend not in ("tpu", "cpu"):
         raise SystemExit(f"unknown backend: {cfg.backend}")
@@ -1141,6 +1211,42 @@ def parse_fleet_args(argv) -> FleetConfig:
         raise SystemExit("--stall-after must be >= 0 seconds (0 "
                          "disables the dispatcher watchdog)")
     _validate_flight(cfg)
+    if cfg.scale_max < 0:
+        raise SystemExit("--scale-max must be >= 0 replicas "
+                         "(0 disables the autoscaler)")
+    if cfg.scale_max > 0:
+        # tt-scale (fleet/autoscaler.py): the actuator needs a worker
+        # pool to grow/shrink and a history ring to evaluate against
+        if cfg.scale_min < 1:
+            raise SystemExit("--scale-min must be >= 1 replica (the "
+                             "fleet must keep something to route to)")
+        if cfg.scale_min > cfg.scale_max:
+            raise SystemExit("--scale-min must not exceed --scale-max")
+        if not cfg.spawn and not cfg.scale_dry_run:
+            raise SystemExit(
+                "--scale-max needs the --spawn worker pool (the "
+                "actuator spawns/retires local workers; a static "
+                "--replica fleet has no pool) — or --scale-dry-run "
+                "to evaluate the policy without acting")
+        if cfg.history_every <= 0:
+            raise SystemExit("--scale-max needs --history-every > 0 "
+                             "(the policy evaluates obs/history.py "
+                             "sustained()/rate()/mean_over() windows)")
+        if cfg.scale_every <= 0:
+            raise SystemExit("--scale-every must be > 0 seconds")
+        if cfg.scale_up_for <= 0 or cfg.scale_down_for <= 0:
+            raise SystemExit("--scale-up-for / --scale-down-for must "
+                             "be > 0 seconds (a sustained window)")
+        if cfg.scale_up_queue <= cfg.scale_down_queue:
+            raise SystemExit(
+                "--scale-up-queue must exceed --scale-down-queue "
+                "(overlapping trigger bands guarantee flapping)")
+        if (cfg.scale_cooldown < 0 or cfg.scale_idle_window < 0
+                or cfg.scale_warm_recent < 0
+                or cfg.scale_starve_rate < 0):
+            raise SystemExit("--scale-cooldown / --scale-idle-window "
+                             "/ --scale-warm-recent / "
+                             "--scale-starve-rate must be >= 0")
     # the worker flags must themselves parse (a typo would otherwise
     # only surface as N crashed spawns); the parsed copy also gives
     # the gateway its bucket spec, so router and workers agree

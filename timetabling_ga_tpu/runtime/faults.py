@@ -74,7 +74,11 @@ a hung or dead sampler/dumper never stalls dispatch, settlement, or
 writer drain — tests/test_flight.py pins it) and `usage` (once per
 drained event batch on the tt-meter usage ledger thread —
 obs/usage.py; same contract: a hung or dead ledger leaves stale
-meters, never a stalled dispatch — tests/test_usage.py pins it).
+meters, never a stalled dispatch — tests/test_usage.py pins it) and
+`scaler` (once per policy-evaluation tick on the tt-scale autoscaler
+thread — fleet/autoscaler.py; same isolation contract: a hung or dead
+scaler freezes the fleet at its current replica count, never routing,
+dispatch, settlement, or writer drain — tests/test_scale.py pins it).
 
 The plan is installed per engine.run call (`install`), which resets the
 per-site counters — invocation indices are deterministic within one
@@ -154,10 +158,16 @@ ACTIONS = ("unavailable", "hang", "die", "truncate", "error")
 # events drop into the honest `usage.dropped` counter), a die ends it
 # silently; dispatch, job settlement, and writer drain never wait on it
 # (tests/test_usage.py pins the isolation).
+# `scaler` fires once per policy-evaluation tick on the tt-scale
+# autoscaler thread (fleet/autoscaler.py) — the history/usage
+# discipline: a hang parks the scaler (the fleet stops scaling but
+# keeps serving at its current replica count), a die ends it silently;
+# routing, dispatch, job settlement, and writer drain never wait on it
+# (tests/test_scale.py pins the isolation).
 SITES = ("dispatch", "fetch", "writer", "ckpt", "init", "obs_listen",
          "scrape", "mem_poll", "profile", "gateway", "route",
          "gw_writer", "gw_scrape", "quantum", "snapshot_ship",
-         "resume", "history", "flight_dump", "usage")
+         "resume", "history", "flight_dump", "usage", "scaler")
 
 
 class FaultInjected(Exception):

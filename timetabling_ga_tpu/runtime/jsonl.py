@@ -461,6 +461,33 @@ def route_entry(stream: IO, job: str, bucket, replica: str,
     _write(stream, {"routeEntry": rec})
 
 
+def scale_entry(stream: IO, action: str, reason: str, ts=None,
+                **extra) -> dict:
+    """Observability EXTENSION record (tt-scale, fleet/autoscaler.py;
+    emitted only when the gateway runs with `-o LOG` and the
+    autoscaler is enabled): one line per autoscaler decision —
+
+      {"scaleEntry":{"action":"up","reason":"queue_depth",
+                     "replica":"s1","live":1,"target":2,
+                     "dry_run":false,"evidence":{
+                       "serve.queue_depth":{"op":">=","threshold":8.0,
+                                            "for_s":30.0,"mean":12.4}},
+                     "ts":41.2}}
+
+    `action` is up / down / blocked_warmth / blocked_cooldown / hold;
+    `evidence` carries the sustained-window queries that justified (or
+    blocked) the decision — the numbers `tt scale` renders next to
+    each action. Control-plane telemetry, not protocol output:
+    strip_timing drops the whole record, so the job record streams are
+    bit-identical with the scaler on or off by construction."""
+    rec = {"action": str(action), "reason": str(reason)}
+    for k, v in extra.items():
+        rec[k] = v
+    if ts is not None:
+        rec["ts"] = round(max(0.0, float(ts)), 6)
+    return _write(stream, {"scaleEntry": rec})
+
+
 def usage_entry(stream: IO, payload: dict, ts=None) -> None:
     """Observability EXTENSION record (tt-meter, obs/usage.py; emitted
     by the usage ledger thread when an emitter is bound — i.e. under
@@ -519,7 +546,7 @@ TIMING_FIELDS = {"logEntry": ("time",), "solution": ("totalTime",),
 # qualityEntry/timing records — tests/test_quality.py).
 TIMING_RECORDS = ("phase", "faultEntry", "spanEntry", "metricsEntry",
                   "costEntry", "qualityEntry", "routeEntry",
-                  "usageEntry")
+                  "usageEntry", "scaleEntry")
 
 
 def strip_timing(records: List[dict]) -> List[dict]:

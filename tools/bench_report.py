@@ -84,6 +84,13 @@ _METRICS = [
      "usage_overhead_ms_per_dispatch"),
     ("usage conserved", "usage", "conservation_holds"),
     ("usage tenants", "usage", "tenants_metered"),
+    ("scale jobs/min auto", "scale", "jobs_per_min_scaled"),
+    ("scale jobs/min fixed", "scale", "jobs_per_min_fixed"),
+    ("scale p99 s auto", "scale", "p99_latency_s_scaled"),
+    ("scale ups", "scale", "scale_ups"),
+    ("scale downs", "scale", "scale_downs"),
+    ("scale jobs lost", "scale", "jobs_lost"),
+    ("scale identical", "scale", "records_identical"),
 ]
 
 _NUM = r"(-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)"

@@ -70,6 +70,13 @@ if [ "${1:-}" = "--fast" ]; then
     timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_usage.py -q -p no:cacheprovider -m 'not slow' \
         || fail=1
+    # and the autoscaler acceptance (burst scale-up + preempt
+    # scale-down e2e) is slow-tiered; fast mode runs the policy/
+    # warmth-guard/cooldown/isolation tier
+    step "autoscaler tests (tests/test_scale.py)"
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_scale.py -q -p no:cacheprovider -m 'not slow' \
+        || fail=1
     [ "$fail" -eq 0 ] && step "OK (fast mode: full test tier skipped)"
     exit $fail
 fi
