@@ -1,0 +1,4 @@
+"""Fixture package for the whole-program rules (TT303/TT304/TT305):
+`core.py` plays the dispatch core (factories, donation, sanctioned
+fetch), `loop.py` plays a dispatch loop in another module that breaks
+the taint/donation/fence discipline across the package boundary."""

@@ -1,0 +1,36 @@
+"""The clean side of the interproc fixtures: a miniature dispatch
+core. Factories matching the `taint-sources` patterns return compiled
+programs (one donating its state slot), `fetch` is the sanctioned
+readback helper, and `advance` is summarized device-returning — all
+facts the whole-program layer must carry into loop.py."""
+
+import jax
+import numpy as np
+
+
+def step(state, seed):
+    return state
+
+
+def cached_runner(mesh):
+    """Factory: a compiled dispatch program donating its state arg."""
+    runner = jax.jit(step, donate_argnums=(0,))
+    return runner
+
+
+def make_lane_runner(mesh, lanes):
+    """Caching factory returning the `(runner, cache_hit)` tuple."""
+    runner = jax.jit(step, donate_argnums=(0,))
+    return runner, False
+
+
+def fetch(x):
+    """Sanctioned packed device->host readback."""
+    return np.asarray(x)
+
+
+def advance(state, seed):
+    """Device-returning helper: its result is a dispatch program's
+    output, so callers in other modules inherit the taint."""
+    runner = cached_runner(None)
+    return runner(state, seed)

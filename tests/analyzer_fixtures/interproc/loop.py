@@ -1,0 +1,75 @@
+"""Dispatch loops breaking the whole-program discipline ACROSS the
+module boundary into interproc/core.py: every violating fact here —
+the program's factory, its donate_argnums, the sanctioned fetch — is
+declared in the other module, which is exactly what the single-module
+rules (TT301/TT203) cannot see."""
+
+import jax
+
+from interproc import core
+
+
+def taint_sink_loop(pa, steps):
+    """TT303: host-forcing sinks on values a cross-module dispatch
+    program produced, inside the dispatch loop."""
+    runner = core.cached_runner(None)
+    state = pa
+    for i in range(steps):
+        state = runner(state, i)
+        cur = float(state)                          # EXPECT TT303
+        hist = state.tolist()                       # EXPECT TT303
+        if state > cur:                             # EXPECT TT303
+            break
+    return state, hist
+
+
+def summary_taint(pa, steps):
+    """TT303 through a device-returning SUMMARY: core.advance wraps
+    the program call, the taint still arrives here."""
+    state = pa
+    for i in range(steps):
+        state = core.advance(state, i)
+        done = bool(state)                          # EXPECT TT303
+        if done:
+            break
+    return state
+
+
+def donated_read_loop(pa, steps):
+    """TT304: the donating jit lives in core.make_lane_runner; reading
+    the donated buffer after the dispatch is a cross-module kill."""
+    runner, hit = core.make_lane_runner(None, 2)
+    state = pa
+    out = None
+    prev = None
+    for i in range(steps):
+        out = runner(state, i)
+        prev = core.fetch(state)                    # EXPECT TT304
+        state = out
+    return state, prev
+
+
+def telemetry_fence_loop(pa, steps):
+    """TT305(a): a telemetry-only fetch BEFORE the dispatch fences it —
+    only control reads may precede a dispatch."""
+    runner = core.cached_runner(None)
+    state = pa
+    rows = []
+    for i in range(steps):
+        trace = core.fetch(state)                   # EXPECT TT305
+        rows.append(trace)
+        state = runner(state, i)
+    return state, rows
+
+
+def blocking_control_loop(pa, steps):
+    """TT305(b): control flow steered through block_until_ready instead
+    of the sanctioned packed fetch."""
+    runner = core.cached_runner(None)
+    state = pa
+    for i in range(steps):
+        state = runner(state, i)
+        done = jax.block_until_ready(state)         # EXPECT TT305
+        if not done:
+            break
+    return state

@@ -1,0 +1,115 @@
+"""Shared run recipes for the dispatch-core parity suite.
+
+Each recipe is a pure function of its config and seeds: it runs one of
+the three dispatch loops (engine, serve scheduler, fleet replica) and
+returns the record stream projected into the strip_timing domain — the
+bit-identity domain of every pipeline/obs/fault A/B in the suite
+(runtime/jsonl.py TIMING_RECORDS).
+
+The module doubles as the capture tool: `python -m tests.parity_recipes`
+writes the streams as JSON under tests/parity_fixtures/.  The committed
+fixtures were captured from the PRE-refactor tree (before
+runtime/dispatch_core.py existed); tests/test_dispatch_core.py re-runs
+the same recipes on the current tree and asserts byte-identity, so any
+behavioural drift introduced by the shared-core port shows up as a
+record diff, not a vague failure.
+"""
+
+import io
+import json
+import os
+
+from timetabling_ga_tpu.runtime import jsonl
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+FIXDIR = os.path.join(_HERE, "parity_fixtures")
+TIM_FIXTURE = os.path.join(os.path.dirname(_HERE), "fixtures",
+                           "comp01s.tim")
+
+
+def _records(buf):
+    return [json.loads(x) for x in buf.getvalue().splitlines()]
+
+
+def engine_stream():
+    """The conftest engine_stream_baseline config, stripped: comp01s,
+    seed 3, pop 8, islands 2, 30 gens at migration period 10, full
+    trace."""
+    from timetabling_ga_tpu.runtime import engine as eng
+    from timetabling_ga_tpu.runtime.config import RunConfig
+    buf = io.StringIO()
+    cfg = RunConfig(input=TIM_FIXTURE, seed=3, pop_size=8, islands=2,
+                    generations=30, migration_period=10, max_steps=8,
+                    time_limit=300, backend="cpu", auto_tune=False,
+                    trace=True)
+    eng.run(cfg, out=buf)
+    return jsonl.strip_timing(_records(buf))
+
+
+def _serve_problems():
+    from timetabling_ga_tpu.problem import random_instance
+    p1 = random_instance(11, n_events=14, n_rooms=3, n_features=2,
+                         n_students=10, attend_prob=0.2)
+    p2 = random_instance(12, n_events=12, n_rooms=3, n_features=2,
+                         n_students=9, attend_prob=0.2)
+    return p1, p2
+
+
+def serve_stream():
+    """Two same-bucket jobs through the packing scheduler: packing,
+    time-slicing, park/resume and the telemetry decode all exercise the
+    lane dispatch path."""
+    from timetabling_ga_tpu.runtime.config import ServeConfig
+    from timetabling_ga_tpu.serve.service import SolveService
+    p1, p2 = _serve_problems()
+    buf = io.StringIO()
+    svc = SolveService(ServeConfig(backend="cpu", lanes=2, quantum=5,
+                                   pop_size=4, max_steps=8), out=buf)
+    svc.submit(p1, job_id="pa", seed=1, generations=15)
+    svc.submit(p2, job_id="pb", seed=2, generations=15)
+    svc.drive()
+    svc.close()
+    return jsonl.strip_timing(_records(buf))
+
+
+def fleet_stream():
+    """The same two jobs through a foreground in-process Replica drive
+    loop (no HTTP front): inbox submit -> drive -> drain covers the
+    fleet fence protocol end to end."""
+    from timetabling_ga_tpu.fleet.replicas import Replica
+    from timetabling_ga_tpu.problem import dump_tim
+    from timetabling_ga_tpu.runtime.config import ServeConfig
+    p1, p2 = _serve_problems()
+    buf = io.StringIO()
+    rep = Replica(ServeConfig(backend="cpu", lanes=2, quantum=5,
+                              pop_size=4, max_steps=8),
+                  name="parity", out=buf)
+    rep.inbox.put(("submit", "fa",
+                   {"tim": dump_tim(p1), "seed": 1, "generations": 15}))
+    rep.inbox.put(("submit", "fb",
+                   {"tim": dump_tim(p2), "seed": 2, "generations": 15}))
+    rep.inbox.put(("drain",))
+    rep.run()
+    return jsonl.strip_timing(_records(buf))
+
+
+RECIPES = {
+    "engine": engine_stream,
+    "serve": serve_stream,
+    "fleet": fleet_stream,
+}
+
+
+def main():
+    os.makedirs(FIXDIR, exist_ok=True)
+    for name, recipe in RECIPES.items():
+        path = os.path.join(FIXDIR, f"{name}_stream.json")
+        records = recipe()
+        with open(path, "w") as f:
+            json.dump(records, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {path} ({len(records)} records)")
+
+
+if __name__ == "__main__":
+    main()

@@ -46,7 +46,8 @@ def fixture_config() -> AnalyzerConfig:
     cfg.dispatch_modules = list(cfg.dispatch_modules) + ["viol_sync.py",
                                                          "viol_cost.py",
                                                          "viol_quality.py",
-                                                         "viol_flight.py"]
+                                                         "viol_flight.py",
+                                                         "interproc/loop.py"]
     cfg.sharded_modules = (list(cfg.sharded_modules)
                            + ["viol_collective.py", "viol_quality.py"])
     cfg.fleet_modules = list(cfg.fleet_modules) + ["viol_fleet.py",
@@ -104,6 +105,59 @@ def test_rule_fires_at_expected_lines(fixture):
 
 def test_clean_fixture_has_zero_findings():
     assert analyze_fixture("clean.py") == []
+
+
+def test_interproc_rules_fire_across_module_boundary():
+    """The whole-program rules (TT303/TT304/TT305) must localize each
+    seeded CROSS-MODULE violation — factory, donation and sanctioned
+    fetch all declared in interproc/core.py, broken in
+    interproc/loop.py — to the exact file:line, and the clean core
+    module must stay silent."""
+    pkg = os.path.join(FIXTURES, "interproc")
+    expected = set()
+    for name in sorted(os.listdir(pkg)):
+        if name.endswith(".py"):
+            for rule, line in expected_findings(
+                    os.path.join("interproc", name)):
+                expected.add((rule, name, line))
+    assert expected, "interproc fixtures declare no EXPECT markers"
+    got = {(f.rule, os.path.basename(f.path), f.line)
+           for f in run_analysis([pkg], fixture_config())}
+    assert got == expected
+    # all three whole-program rules exercised, nothing in core.py
+    assert {r for r, _, _ in got} == {"TT303", "TT304", "TT305"}
+    assert all(name == "loop.py" for _, name, _ in got)
+
+
+def test_warn_unused_ignores(tmp_path):
+    """--warn-unused-ignores: a marker that suppresses nothing is
+    TT901; the USED marker in viol_api.py stays silent."""
+    cfg = fixture_config()
+    cfg.warn_unused_ignores = True
+    findings = run_analysis(
+        [os.path.join(FIXTURES, "viol_api.py")], cfg)
+    assert not any(f.rule == "TT901" for f in findings)
+
+    stale = tmp_path / "stale.py"
+    stale.write_text("x = 1  # tt-analyze: ignore[TT301]\n"
+                     '"""prose mentioning # tt-analyze: ignore is not '
+                     'a marker"""\n', encoding="utf-8")
+    findings = run_analysis([str(stale)], cfg)
+    assert [(f.rule, f.line) for f in findings] == [("TT901", 1)]
+
+
+def test_sarif_export_matches_golden():
+    """`--sarif` output is pinned by a golden file: schema/version,
+    the rules table, and 1-based columns must not drift."""
+    from timetabling_ga_tpu.analysis import _rule_docs
+    from timetabling_ga_tpu.analysis.sarif import to_sarif
+    findings = analyze_fixture("viol_api.py")
+    assert findings, "golden needs a non-empty findings list"
+    got = json.dumps(to_sarif(findings, _rule_docs()),
+                     indent=2, sort_keys=True) + "\n"
+    with open(os.path.join(FIXTURES, "sarif_golden.json"),
+              encoding="utf-8") as f:
+        assert got == f.read()
 
 
 def test_shipped_package_is_strict_clean():
@@ -168,6 +222,9 @@ def test_cli_json_and_exit_codes(tmp_path):
     report = json.loads(r.stdout)
     assert report["count"] == len(report["findings"]) > 0
     assert all(f["rule"] == "TT501" for f in report["findings"])
+    # single-parse driver reports analyzer cost like a bench leg
+    assert report["timing"]["total_s"] > 0
+    assert report["timing"]["per_rule_s"]
 
     # non-strict is advisory: findings reported, exit 0
     r = subprocess.run(

@@ -1,0 +1,179 @@
+"""Dispatch-core parity + fault matrix (runtime/dispatch_core.py).
+
+The extraction contract (ROADMAP item 1): porting all three dispatch
+loops — engine run loop, serve scheduler, fleet replica drive — onto
+the shared core must not move a single record. The parity tests replay
+the committed PRE-refactor captures (tests/parity_fixtures/, written by
+`python -m tests.parity_recipes` on the pre-core tree) and assert
+bit-identity in the strip_timing domain. The fault matrix re-runs the
+dispatch/fetch x hang/die cells through the shared core's injection
+points (runtime/faults.py), pinning that extraction moved the fetch
+watchdog and the fault sites, not just the happy path.
+"""
+
+import io
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import parity_recipes  # noqa: E402  (tests/ is not a package)
+from timetabling_ga_tpu.problem import dump_tim, random_instance
+from timetabling_ga_tpu.runtime import dispatch_core as dcore
+from timetabling_ga_tpu.runtime import faults, jsonl
+from timetabling_ga_tpu.runtime.config import RunConfig
+
+FIXDIR = parity_recipes.FIXDIR
+
+
+def _golden(name):
+    with open(os.path.join(FIXDIR, f"{name}_stream.json"),
+              encoding="utf-8") as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------- parity
+
+def test_engine_stream_parity(engine_stream_baseline):
+    """The engine loop on the shared core emits the pre-refactor
+    record stream bit-identically (strip_timing domain). Reuses the
+    session baseline run — same config as the committed capture."""
+    _, records = engine_stream_baseline
+    assert jsonl.strip_timing(records) == _golden("engine")
+
+
+def test_serve_stream_parity():
+    """Packing scheduler on the shared core: two same-bucket jobs
+    through packing / time-slicing / park-resume / telemetry decode
+    reproduce the pre-refactor stream exactly."""
+    assert parity_recipes.serve_stream() == _golden("serve")
+
+
+def test_fleet_stream_parity():
+    """Replica drive loop on the shared core (CommandFence inbox,
+    submit -> drive -> drain): stream identical to pre-refactor."""
+    assert parity_recipes.fleet_stream() == _golden("fleet")
+
+
+# ------------------------------------------------------- core unit tests
+
+def test_pipeline_depth2_discipline():
+    """DispatchPipeline: at most one in-flight chunk; pipelined submit
+    retires the predecessor WITH the successor dispatched (passed as
+    inflight), drain is the loop-exit barrier, abandon forgets without
+    retiring — the recovery teardown."""
+    calls = []
+    pipe = dcore.DispatchPipeline(
+        lambda chunk, inflight=None: calls.append((chunk, inflight)),
+        enabled=True)
+    pipe.submit("a")
+    pipe.submit("b")
+    pipe.submit("c")
+    assert calls == [("a", "b"), ("b", "c")]
+    pipe.drain()
+    assert calls[-1] == ("c", None) and pipe.pending is None
+
+    calls.clear()
+    pipe.enabled = False
+    pipe.submit("d")                 # serial: retire immediately
+    assert calls == [("d", None)]
+
+    pipe.enabled = True
+    pipe.submit("e")
+    assert pipe.abandon() == "e"     # recovery: forget, never process
+    assert pipe.pending is None and len(calls) == 1
+
+
+def test_command_fence_poll_and_wait():
+    """CommandFence: poll is the non-blocking busy-fence drain, wait
+    the bounded idle tick — both return None on an empty inbox."""
+    fence = dcore.CommandFence()
+    assert fence.poll() is None
+    fence.put(("submit", "j1"))
+    assert fence.poll() == ("submit", "j1")
+    t0 = time.monotonic()
+    assert fence.wait(timeout=0.05) is None
+    assert time.monotonic() - t0 < 5.0
+    fence.put(("drain",))
+    assert fence.wait(timeout=0.05) == ("drain",)
+
+
+# ------------------------------------------------ fault matrix: fetch x
+
+@pytest.fixture
+def _fault_cleanup():
+    yield
+    faults.install(None)
+    dcore.set_fetch_timeout(None)
+
+
+def test_fetch_hang_times_out_through_core(_fault_cleanup):
+    """fetch x hang: the shared core's watchdog abandons a hung
+    control-fence read at the deadline and raises the classified
+    FetchTimeout — the hang is never slept through."""
+    faults.install("fetch:1:hang")
+    dcore.set_fetch_timeout(0.3)
+    t0 = time.monotonic()
+    with pytest.raises(dcore.FetchTimeout) as ei:
+        dcore.fetch(np.arange(8))
+    assert time.monotonic() - t0 < faults.HANG_S
+    assert ei.value.tt_site == "fetch"
+    from timetabling_ga_tpu.runtime import retry
+    assert retry.is_transient(ei.value)
+
+
+def test_fetch_die_surfaces_on_main_thread(_fault_cleanup):
+    """fetch x die: a SystemExit on the watchdog thread must not
+    vanish with the thread — the core re-raises it on the main loop,
+    classified with the fetch site."""
+    faults.install("fetch:1:die")
+    dcore.set_fetch_timeout(5.0)
+    with pytest.raises(SystemExit) as ei:
+        dcore.fetch(np.arange(8))
+    assert ei.value.tt_site == "fetch"
+
+
+# --------------------------------------------- fault matrix: dispatch x
+
+@pytest.fixture(scope="module")
+def tim_file(tmp_path_factory):
+    problem = random_instance(77, n_events=15, n_rooms=5, n_features=2,
+                              n_students=10, attend_prob=0.1)
+    path = tmp_path_factory.mktemp("dcore") / "tiny.tim"
+    path.write_text(dump_tim(problem))
+    return str(path)
+
+
+def _go(tim_file, **kw):
+    from timetabling_ga_tpu.runtime import engine
+    buf = io.StringIO()
+    cfg = RunConfig(input=tim_file, seed=3, pop_size=8, islands=1,
+                    generations=30, migration_period=10, max_steps=8,
+                    time_limit=300, backend="cpu", auto_tune=False,
+                    trace=True, **kw)
+    best = engine.run(cfg, out=buf)
+    return best, [json.loads(x) for x in buf.getvalue().splitlines()]
+
+
+def test_dispatch_hang_is_timing_only(tim_file, monkeypatch):
+    """dispatch x hang: a dispatch-site stall (shortened hang) delays
+    the run but changes nothing it emits — hang is a timing fault, and
+    strip_timing is exactly the domain that proves it."""
+    monkeypatch.setattr(faults, "HANG_S", 0.2)
+    clean_best, clean = _go(tim_file, pipeline=False)
+    best, lines = _go(tim_file, pipeline=False, faults="dispatch:2:hang")
+    assert best == clean_best
+    assert jsonl.strip_timing(lines) == jsonl.strip_timing(clean)
+    assert not any("faultEntry" in x for x in lines)
+
+
+def test_dispatch_die_aborts_run(tim_file):
+    """dispatch x die: SystemExit at a dispatch site is NOT transient —
+    the supervisor must re-raise, not recover; the run aborts."""
+    with pytest.raises(SystemExit):
+        _go(tim_file, pipeline=False, faults="dispatch:2:die")
+    faults.install(None)

@@ -1,7 +1,8 @@
 """tt-analyze — JAX-aware static analysis for this codebase.
 
 Usage:
-    python -m timetabling_ga_tpu.analysis [--strict] [--json] [paths...]
+    python -m timetabling_ga_tpu.analysis [--strict] [--json] [--sarif]
+        [--warn-unused-ignores] [paths...]
 
 Rules (see README "Static analysis & sanitizers"):
 
@@ -16,6 +17,20 @@ Rules (see README "Static analysis & sanitizers"):
   TT302  collective-bearing random ops (permutation/shuffle/choice) in
          shard_map-executed code — replicated-sort all-reduces that
          merge island RNG streams and deadlock varying while_loops
+  TT303  WHOLE-PROGRAM device taint (analysis/project.py): values a
+         dispatch program produced in another module hitting a
+         host-forcing sink — float()/int()/bool(), np.asarray,
+         .item()/.tolist(), control-flow-steering comparisons — inside
+         a dispatch loop; the sanctioned fetch helpers clear taint
+  TT304  interprocedurally-donated buffer read after the donating
+         dispatch — the cross-module upgrade of TT203: the factory
+         declaring donate_argnums and the call site reading the dead
+         buffer may live in different modules
+  TT305  dispatch-fence discipline: a control host read must precede
+         the next dispatch, telemetry must not — telemetry fetches
+         fencing a later dispatch in the same loop iteration, and
+         control flow steered through jax.block_until_ready instead
+         of the sanctioned packed fetch
   TT401  PRNG key reuse (two consumers, no split/fold_in between)
   TT402  loop-carried key reuse (one call site consuming the same key
          across `for` iterations without fold_in on the loop index)
@@ -62,9 +77,16 @@ Rules (see README "Static analysis & sanitizers"):
          guard (fleet/autoscaler.py)
 
 Suppress one finding inline with `# tt-analyze: ignore[TT301]` (on the
-line, or on a comment line directly above). Configure via
-`[tool.tt-analyze]` in pyproject.toml. Exit status: 0, or 1 under
---strict when findings remain.
+line, or on a comment line directly above); `--warn-unused-ignores`
+reports markers that suppress nothing (TT901) so stale suppressions
+cannot rot in place. Configure via `[tool.tt-analyze]` in
+pyproject.toml. Exit status: 0, or 1 under --strict when findings
+remain.
+
+Every file is parsed exactly once per run; the parsed trees are shared
+across all rules AND the whole-program layer (analysis/project.py), and
+`--json` reports per-rule and total wall time so analyzer cost is
+tracked like a bench leg.
 
 Stdlib-only by design: linting must not require JAX or a device.
 """
@@ -76,29 +98,36 @@ import ast
 import json
 import os
 import sys
+import time
 
 from timetabling_ga_tpu.analysis.config import (
     ALL_RULES, AnalyzerConfig, load_compat_table, load_config)
-from timetabling_ga_tpu.analysis.core import Finding, filter_suppressed
+from timetabling_ga_tpu.analysis.core import (
+    Finding, filter_suppressed, unused_suppressions)
 
 __all__ = ["Finding", "AnalyzerConfig", "run_analysis", "main",
            "ALL_RULES"]
 
 
 class _Context:
-    """Per-run state shared by the rules."""
+    """Per-run state shared by the rules: config, the pinned API
+    table, and — set by run_analysis — the shared parsed sources the
+    whole-program rules (TT303/TT304/TT305) build their Project
+    from."""
 
     def __init__(self, config: AnalyzerConfig):
         self.config = config
         self.compat_table = load_compat_table(config)
+        self.sources: list[tuple] = []        # (path, rel, tree, src)
+        self.interproc_findings = None        # rules_interproc cache
 
 
 def _rule_modules():
     from timetabling_ga_tpu.analysis import (
         rules_api, rules_cost, rules_donate, rules_fleet,
-        rules_flight, rules_http, rules_obs, rules_quality,
-        rules_recompile, rules_rng, rules_scale, rules_sync,
-        rules_trace, rules_usage)
+        rules_flight, rules_http, rules_interproc, rules_obs,
+        rules_quality, rules_recompile, rules_rng, rules_scale,
+        rules_sync, rules_trace, rules_usage)
     return {
         "TT101": rules_trace,
         "TT102": rules_trace,
@@ -107,6 +136,9 @@ def _rule_modules():
         "TT203": rules_donate,
         "TT301": rules_sync,
         "TT302": rules_sync,
+        "TT303": rules_interproc,
+        "TT304": rules_interproc,
+        "TT305": rules_interproc,
         "TT401": rules_rng,
         "TT402": rules_rng,
         "TT501": rules_api,
@@ -120,6 +152,14 @@ def _rule_modules():
         "TT607": rules_usage,
         "TT608": rules_scale,
     }
+
+
+def _rule_docs() -> dict[str, str]:
+    docs = {rule: (mod.__doc__ or rule).strip().splitlines()[0]
+            for rule, mod in _rule_modules().items()}
+    docs["TT000"] = "syntax error"
+    docs["TT901"] = "unused `# tt-analyze: ignore` suppression marker"
+    return docs
 
 
 def _iter_py_files(paths, root):
@@ -136,42 +176,83 @@ def _iter_py_files(paths, root):
                         yield os.path.join(dirpath, fname)
 
 
-def analyze_file(path: str, ctx: _Context) -> list[Finding]:
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [Finding("TT000", path, e.lineno or 0, e.offset or 0,
-                        f"syntax error: {e.msg}")]
-    rel = os.path.relpath(path, ctx.config.root)
-    if rel.startswith(".."):
-        rel = path
-    findings: list[Finding] = []
-    seen_modules = []
-    for rule in ctx.config.rules:
-        mod = _rule_modules().get(rule)
-        if mod is None or mod in seen_modules:
+def _rule_groups(config):
+    """(label, module) pairs for the enabled rules, one entry per rule
+    module, labels joining the rule ids the module implements."""
+    mods = _rule_modules()
+    groups: list[tuple[list[str], object]] = []
+    for rule in config.rules:
+        mod = mods.get(rule)
+        if mod is None:
             continue
-        seen_modules.append(mod)
-        findings.extend(mod.check(tree, src, rel, ctx))
-    # rules sharing a module (TT201/TT202) can duplicate; dedupe exactly
-    findings = sorted(set(findings),
-                      key=lambda f: (f.path, f.line, f.col, f.rule))
-    findings = [f for f in findings if f.rule in ctx.config.rules
-                or f.rule == "TT000"]
-    return filter_suppressed(findings, src)
+        for rules, m in groups:
+            if m is mod:
+                rules.append(rule)
+                break
+        else:
+            groups.append(([rule], mod))
+    return [("+".join(rules), mod) for rules, mod in groups]
 
 
-def run_analysis(paths=None, config: AnalyzerConfig | None = None
-                 ) -> list[Finding]:
-    """Analyze `paths` (files or directories); returns all findings."""
+def run_analysis(paths=None, config: AnalyzerConfig | None = None,
+                 timings: dict | None = None) -> list[Finding]:
+    """Analyze `paths` (files or directories); returns all findings.
+
+    Single-parse: every file is read and parsed exactly once, and the
+    trees are shared by all per-file rules and the whole-program layer.
+    Pass a dict as `timings` to receive {"total_s", "per_rule_s"}.
+    """
     if config is None:
         config = load_config(".")
     ctx = _Context(config)
-    findings: list[Finding] = []
+    t_total = time.perf_counter()
+
+    order: list[str] = []             # rel paths in walk order
+    srcs: dict[str, str] = {}
+    syntax_errors: dict[str, Finding] = {}
     for path in _iter_py_files(paths or config.paths, config.root):
-        findings.extend(analyze_file(path, ctx))
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        rel = os.path.relpath(path, config.root)
+        if rel.startswith(".."):
+            rel = path
+        order.append(rel)
+        srcs[rel] = src
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            syntax_errors[rel] = Finding(
+                "TT000", rel, e.lineno or 0, e.offset or 0,
+                f"syntax error: {e.msg}")
+            continue
+        ctx.sources.append((path, rel, tree, src))
+
+    per_file: dict[str, list[Finding]] = {rel: [] for rel in order}
+    per_rule_s: dict[str, float] = {}
+    for label, mod in _rule_groups(config):
+        t0 = time.perf_counter()
+        for _, rel, tree, src in ctx.sources:
+            per_file[rel].extend(mod.check(tree, src, rel, ctx))
+        per_rule_s[label] = round(time.perf_counter() - t0, 6)
+
+    findings: list[Finding] = []
+    enabled = set(config.rules)
+    for rel in order:
+        if rel in syntax_errors:
+            findings.append(syntax_errors[rel])
+            continue
+        # rules sharing a module (TT201/TT202) can duplicate; dedupe
+        # exactly, then keep only the enabled ids
+        fs = sorted({f for f in per_file[rel] if f.rule in enabled},
+                    key=lambda f: (f.line, f.col, f.rule))
+        kept = filter_suppressed(fs, srcs[rel])
+        if config.warn_unused_ignores:
+            kept += unused_suppressions(fs, srcs[rel], rel)
+        findings.extend(kept)
+
+    if timings is not None:
+        timings["per_rule_s"] = per_rule_s
+        timings["total_s"] = round(time.perf_counter() - t_total, 6)
     return findings
 
 
@@ -179,14 +260,21 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="tt-analyze",
         description="JAX-aware static analysis (tracer safety, recompile "
-                    "hazards, host syncs, RNG discipline, pinned API)")
+                    "hazards, host syncs, whole-program device taint, "
+                    "RNG discipline, pinned API)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to scan (default: [tool.tt-analyze] "
                          "paths)")
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 if any finding remains")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable JSON report on stdout")
+                    help="machine-readable JSON report on stdout "
+                         "(includes per-rule wall time)")
+    ap.add_argument("--sarif", action="store_true", dest="as_sarif",
+                    help="SARIF 2.1.0 report on stdout (CI annotations)")
+    ap.add_argument("--warn-unused-ignores", action="store_true",
+                    help="report stale `# tt-analyze: ignore` markers "
+                         "(TT901)")
     ap.add_argument("--root", default=".",
                     help="project root holding pyproject.toml")
     ap.add_argument("--rules", default=None,
@@ -205,13 +293,21 @@ def main(argv=None) -> int:
     config = load_config(args.root)
     if args.rules:
         config.rules = [r.strip() for r in args.rules.split(",")]
-    findings = run_analysis(args.paths or None, config)
+    if args.warn_unused_ignores:
+        config.warn_unused_ignores = True
+    timings: dict = {}
+    findings = run_analysis(args.paths or None, config, timings=timings)
 
-    if args.as_json:
+    if args.as_sarif:
+        from timetabling_ga_tpu.analysis.sarif import to_sarif
+        print(json.dumps(to_sarif(findings, _rule_docs()), indent=2,
+                         sort_keys=True))
+    elif args.as_json:
         print(json.dumps({
             "findings": [f.to_json() for f in findings],
             "count": len(findings),
             "strict": args.strict,
+            "timing": timings,
         }, indent=2))
     else:
         for f in findings:

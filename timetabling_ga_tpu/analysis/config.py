@@ -16,9 +16,9 @@ import os
 import re
 
 ALL_RULES = ("TT101", "TT102", "TT201", "TT202", "TT203", "TT301",
-             "TT302", "TT401", "TT402", "TT501", "TT502", "TT601",
-             "TT602", "TT603", "TT604", "TT605", "TT606", "TT607",
-             "TT608")
+             "TT302", "TT303", "TT304", "TT305", "TT401", "TT402",
+             "TT501", "TT502", "TT601", "TT602", "TT603", "TT604",
+             "TT605", "TT606", "TT607", "TT608")
 
 
 @dataclasses.dataclass
@@ -45,6 +45,20 @@ class AnalyzerConfig:
     # yields device arrays) for TT301's taint seeding
     device_producers: list[str] = dataclasses.field(
         default_factory=lambda: [r"^cached_\w+$", r"^jax\.jit$", r"^jit$"])
+    # factory-name patterns seeding the WHOLE-PROGRAM taint pass
+    # (TT303/TT304/TT305, analysis/project.py): a function matching one
+    # returns a compiled dispatch program, and calling that program in
+    # ANY module yields device-tainted values
+    taint_sources: list[str] = dataclasses.field(
+        default_factory=lambda: [r"^cached_\w+$", r"^make_\w+_runner$"])
+    # host-forcing sink callables TT303 flags on tainted values inside
+    # dispatch loops (method names match `.x()` receivers)
+    taint_sinks: list[str] = dataclasses.field(
+        default_factory=lambda: ["float", "int", "bool", "np.asarray",
+                                 "np.array", "item", "tolist"])
+    # report stale `# tt-analyze: ignore[...]` markers (CLI
+    # --warn-unused-ignores sets this)
+    warn_unused_ignores: bool = False
     # module-level compile-cache dict names for TT202
     cache_name_pattern: str = r"^_?[A-Z0-9_]*CACHES?$"
     # factory callees whose results get cached (TT202 key completeness)

@@ -34,6 +34,38 @@ _SUPPRESS_RE = re.compile(
     r"#\s*tt-analyze:\s*ignore(?:\[([A-Za-z0-9_,\s]+)\])?")
 
 
+def iter_markers(src: str):
+    """Every `# tt-analyze: ignore` marker in `src` as
+    (marker_line, rules | None, covered_lines): a marker covers its own
+    line, and — on a comment-only line — the line below it too.
+
+    A marker is a COMMENT TOKEN that begins with the marker text:
+    tokenizing (not line-grepping) keeps docstrings and prose comments
+    that merely MENTION the syntax from acting as suppressions — and,
+    under --warn-unused-ignores, from being reported as stale."""
+    import io
+    import tokenize
+    lines = src.splitlines()
+    try:
+        toks = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return
+    for tok in toks:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.match(tok.string)
+        if not m:
+            continue
+        i = tok.start[0]
+        rules = (None if m.group(1) is None
+                 else {r.strip() for r in m.group(1).split(",")
+                       if r.strip()})
+        covered = {i}
+        if i <= len(lines) and lines[i - 1].lstrip().startswith("#"):
+            covered.add(i + 1)
+        yield i, rules, covered
+
+
 def suppressions(src: str) -> dict[int, set[str] | None]:
     """Map 1-based line number -> suppressed rule ids (None = all rules).
 
@@ -41,20 +73,11 @@ def suppressions(src: str) -> dict[int, set[str] | None]:
     comment-only line also suppresses findings on the line below it.
     """
     out: dict[int, set[str] | None] = {}
-    for i, line in enumerate(src.splitlines(), start=1):
-        m = _SUPPRESS_RE.search(line)
-        if not m:
-            continue
-        rules = (None if m.group(1) is None
-                 else {r.strip() for r in m.group(1).split(",") if r.strip()})
-
-        def merge(ln: int, rules=rules):
+    for _, rules, covered in iter_markers(src):
+        for ln in covered:
             cur = out.get(ln, set())
-            out[ln] = None if (rules is None or cur is None) else cur | rules
-
-        merge(i)
-        if line.lstrip().startswith("#"):
-            merge(i + 1)
+            out[ln] = None if (rules is None or cur is None) \
+                else cur | rules
     return out
 
 
@@ -67,6 +90,29 @@ def filter_suppressed(findings: list[Finding], src: str) -> list[Finding]:
             continue
         kept.append(f)
     return kept
+
+
+def unused_suppressions(findings: list[Finding], src: str, path: str
+                        ) -> list[Finding]:
+    """Markers that suppress nothing — the unused-noqa analogue.
+
+    `findings` must be the PRE-suppression list for this file: a marker
+    is used iff some finding on a covered line matches its rule scope.
+    A marker scoped to a disabled rule is unused (like flake8)."""
+    out = []
+    for line, rules, covered in iter_markers(src):
+        used = any(f.line in covered
+                   and (rules is None or f.rule in rules)
+                   for f in findings)
+        if not used:
+            scope = "all rules" if rules is None \
+                else ",".join(sorted(rules))
+            out.append(Finding(
+                "TT901", path, line, 0,
+                f"unused suppression: `# tt-analyze: ignore` marker "
+                f"({scope}) suppresses no finding — drop the stale "
+                f"marker"))
+    return out
 
 
 def qualname(node: ast.AST) -> str | None:

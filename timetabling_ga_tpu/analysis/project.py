@@ -1,0 +1,376 @@
+"""The whole-program layer under TT303/TT304/TT305: module graph,
+per-function summaries, and a cross-module call graph.
+
+Every rule before this layer was single-module AST scanning; the three
+interprocedural rules need to see a compiled program built by a factory
+in `runtime/engine.py` get CALLED in `serve/scheduler.py`, a
+`donate_argnums` declared in `parallel/islands.py` kill a buffer two
+modules away, and a fetch helper defined in `runtime/dispatch_core.py`
+clear device taint wherever it is imported. This module provides the
+minimum machinery for that:
+
+  Project        all scanned files loaded as one unit. Modules get
+                 dotted names rooted at their outermost package (the
+                 nearest ancestor directory without an __init__.py),
+                 so resolution works identically for the shipped
+                 package and for test fixture packages.
+  import maps    per-module alias -> dotted target, from `import a.b`,
+                 `import a.b as c`, `from a.b import c [as d]`, and
+                 explicit-relative forms. Star imports are ignored
+                 (the package bans them; the analyzer must not guess).
+  resolve()      a call expression's dotted name, resolved through the
+                 importing module's alias map to a FunctionInfo in
+                 another scanned module — the generalization of the
+                 TT602 `_reachable` idiom from "same module only" to
+                 the whole scan set. Tail matching mirrors
+                 core.qual_matches: `timetabling_ga_tpu.runtime.engine`
+                 resolves an import written as `runtime.engine` or
+                 `engine` alike.
+  summaries      fixpoint-computed per-function facts the rules
+                 consume: `program_factories` (returns a compiled
+                 dispatch program — the `cached_*`/`make_*_runner`
+                 contract), `device_returning` (returns a value a
+                 dispatch program produced), `donators` (returns a
+                 callable that donates specific positional args — read
+                 off `jax.jit(..., donate_argnums=...)` /
+                 `donate_argnames` through the factory's return, one
+                 tuple level deep: the `return runner, False` caching
+                 idiom).
+
+Stdlib-only, like every other analysis module: linting must never need
+JAX or a device.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+from timetabling_ga_tpu.analysis.core import func_params, qualname
+
+_JIT_NAMES = ("jax.jit", "jit")
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str                     # dotted, rooted at outermost package
+    path: str
+    rel: str                      # path relative to config root
+    tree: ast.Module
+    src: str
+    imports: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qname: str                    # "pkg.mod.func" / "pkg.mod.Cls.func"
+    name: str                     # bare function name
+    module: ModuleInfo
+    node: ast.AST
+    cls: str | None = None
+
+
+@dataclasses.dataclass
+class DonationSpec:
+    positions: tuple              # donated positional indices
+    tuple_result: bool            # factory returns (callable, flag)
+    origin: str                   # qname of the jit-declaring factory
+
+
+def _module_name(path: str) -> str:
+    """Dotted module name rooted at the outermost enclosing package."""
+    path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    d = os.path.dirname(path)
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    name = ".".join(reversed(parts))
+    return name[:-len(".__init__")] if name.endswith(".__init__") else name
+
+
+def _import_map(tree: ast.Module, modname: str) -> dict[str, str]:
+    """Local alias -> dotted target for one module's import statements."""
+    pkg = modname.rsplit(".", 1)[0] if "." in modname else ""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    out[alias.asname] = alias.name
+                else:
+                    # `import a.b.c` binds `a`; attribute chains off it
+                    # spell the full dotted path themselves
+                    out[alias.name.split(".")[0]] = \
+                        alias.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                up = pkg.split(".") if pkg else []
+                up = up[:len(up) - (node.level - 1)] if node.level > 1 \
+                    else up
+                base = ".".join(x for x in [".".join(up), base] if x)
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                local = alias.asname or alias.name
+                out[local] = f"{base}.{alias.name}" if base \
+                    else alias.name
+    return out
+
+
+class Project:
+    """All scanned sources as one unit; built once per analyzer run."""
+
+    def __init__(self, sources, config):
+        # sources: iterable of (path, rel, tree, src) for parsed files
+        self.config = config
+        self.modules: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        for path, rel, tree, src in sources:
+            name = _module_name(path)
+            mod = ModuleInfo(name, path, rel, tree, src)
+            mod.imports = _import_map(tree, name)
+            self.modules[name] = mod
+        for mod in self.modules.values():
+            self._index_functions(mod)
+        self._factory_res = [re.compile(p) for p in getattr(
+            config, "taint_sources", [r"^cached_\w+$",
+                                      r"^make_\w+_runner$"])]
+        self.program_factories: set[str] = set()
+        self.device_returning: set[str] = set()
+        self.donators: dict[str, DonationSpec] = {}
+        self._summarize()
+
+    # -- loading --------------------------------------------------------
+
+    def _index_functions(self, mod: ModuleInfo) -> None:
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{mod.name}.{node.name}"
+                self.functions[qn] = FunctionInfo(qn, node.name, mod,
+                                                  node)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        qn = f"{mod.name}.{node.name}.{sub.name}"
+                        self.functions[qn] = FunctionInfo(
+                            qn, sub.name, mod, sub, cls=node.name)
+
+    # -- resolution -----------------------------------------------------
+
+    def _module_by_tail(self, dotted: str) -> ModuleInfo | None:
+        if dotted in self.modules:
+            return self.modules[dotted]
+        best = None
+        for name, mod in self.modules.items():
+            if name.endswith("." + dotted):
+                if best is None or len(name) < len(best.name):
+                    best = mod
+        return best
+
+    def resolve(self, caller_mod: ModuleInfo, func_expr: ast.AST
+                ) -> FunctionInfo | None:
+        """The FunctionInfo a call expression resolves to, through the
+        calling module's import aliases; None when the callee is not a
+        scanned module-level function (method calls, builtins, foreign
+        libraries)."""
+        qn = qualname(func_expr)
+        if qn is None:
+            return None
+        parts = qn.split(".")
+        if len(parts) == 1:
+            # bare name: same-module function, or `from mod import f`
+            fi = self.functions.get(f"{caller_mod.name}.{parts[0]}")
+            if fi is not None:
+                return fi
+            target = caller_mod.imports.get(parts[0])
+            if target is None:
+                return None
+            parts = target.split(".")
+        else:
+            target = caller_mod.imports.get(parts[0])
+            if target is not None:
+                parts = target.split(".") + parts[1:]
+        if len(parts) < 2:
+            return None
+        mod = self._module_by_tail(".".join(parts[:-1]))
+        if mod is None:
+            return None
+        return self.functions.get(f"{mod.name}.{parts[-1]}")
+
+    def is_cross_module(self, caller_mod: ModuleInfo,
+                        callee: FunctionInfo) -> bool:
+        return callee.module.name != caller_mod.name
+
+    # -- summaries ------------------------------------------------------
+
+    def _jit_donations(self, fn: ast.AST) -> dict[str, tuple]:
+        """Names (and '<return>') bound in `fn` to a jit call carrying
+        donate_argnums/donate_argnames, mapped to donated positions."""
+        out: dict[str, tuple] = {}
+
+        def spec(call: ast.Call, wrapped: ast.AST | None) -> tuple:
+            nums, names = [], []
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    nums += [n.value for n in ast.walk(kw.value)
+                             if isinstance(n, ast.Constant)
+                             and isinstance(n.value, int)]
+                elif kw.arg == "donate_argnames":
+                    names += [n.value for n in ast.walk(kw.value)
+                             if isinstance(n, ast.Constant)
+                             and isinstance(n.value, str)]
+            if names and wrapped is not None:
+                wname = (qualname(wrapped) or "").rsplit(".", 1)[-1]
+                for node in ast.walk(fn):
+                    if (isinstance(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                            and node.name == wname):
+                        params = func_params(node)
+                        nums += [params.index(p) for p in names
+                                 if p in params]
+            return tuple(sorted(set(nums)))
+
+        def jit_spec(expr: ast.AST) -> tuple | None:
+            if not isinstance(expr, ast.Call):
+                return None
+            qn = qualname(expr.func)
+            if qn is None or qn.rsplit(".", 1)[-1] not in (
+                    "jit",) and qn not in _JIT_NAMES:
+                return None
+            s = spec(expr, expr.args[0] if expr.args else None)
+            return s or None
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                s = jit_spec(node.value)
+                if s:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            out[tgt.id] = s
+            elif isinstance(node, ast.Return) and node.value is not None:
+                s = jit_spec(node.value)
+                if s:
+                    out["<return>"] = s
+        return out
+
+    def _return_exprs(self, fn: ast.AST):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                yield node.value
+
+    def _summarize(self) -> None:
+        # seed: name-pattern factories (the cached_*/make_*_runner
+        # contract) and functions whose body returns a donating jit
+        for qn, fi in self.functions.items():
+            if any(r.match(fi.name) for r in self._factory_res):
+                self.program_factories.add(qn)
+            jits = self._jit_donations(fi.node)
+            for ret in self._return_exprs(fi.node):
+                spec, tup = self._donation_of(ret, jits)
+                if spec:
+                    self.donators[qn] = DonationSpec(spec, tup, qn)
+                    break
+        # fixpoint: returning another factory's product / another
+        # donator's callable / a device value propagates the fact
+        for _ in range(len(self.modules) + 2):
+            changed = False
+            for qn, fi in self.functions.items():
+                for ret in self._return_exprs(fi.node):
+                    changed |= self._propagate(qn, fi, ret)
+            if not changed:
+                break
+
+    def _donation_of(self, ret: ast.AST, jits: dict) -> tuple:
+        """(positions, tuple_result) a return expression carries from
+        this function's own jit bindings."""
+        def direct(expr: ast.AST):
+            if isinstance(expr, ast.Name) and expr.id in jits:
+                return jits[expr.id]
+            if isinstance(expr, ast.Call):
+                # return jax.jit(f, donate_argnums=...) handled via the
+                # '<return>' pseudo-binding
+                return jits.get("<return>") \
+                    if ret is expr and "<return>" in jits else None
+            return None
+
+        s = direct(ret)
+        if s:
+            return s, False
+        if isinstance(ret, ast.Tuple) and ret.elts:
+            s = direct(ret.elts[0])
+            if s:
+                return s, True
+        return (), False
+
+    def _propagate(self, qn: str, fi: FunctionInfo, ret: ast.AST
+                   ) -> bool:
+        changed = False
+
+        def callee_of(expr):
+            if isinstance(expr, ast.Call):
+                return self.resolve(fi.module, expr.func)
+            return None
+
+        head = ret.elts[0] if (isinstance(ret, ast.Tuple) and ret.elts) \
+            else ret
+        tup = head is not ret
+        callee = callee_of(head)
+        if callee is not None:
+            # factory-product passthrough: return other_factory(...)
+            if (callee.qname in self.program_factories
+                    and qn not in self.program_factories):
+                self.program_factories.add(qn)
+                changed = True
+            if (callee.qname in self.donators
+                    and qn not in self.donators):
+                inner = self.donators[callee.qname]
+                self.donators[qn] = DonationSpec(
+                    inner.positions, tup or inner.tuple_result,
+                    inner.origin)
+                changed = True
+            if (callee.qname in self.device_returning
+                    and qn not in self.device_returning):
+                self.device_returning.add(qn)
+                changed = True
+        # device value: return <program>(...) where <program> was bound
+        # from a factory call inside this function
+        if isinstance(head, ast.Call) and qn not in self.device_returning:
+            inner = head.func
+            prog_names = self._program_bindings(fi)
+            if ((isinstance(inner, ast.Name) and inner.id in prog_names)
+                    or (isinstance(inner, ast.Call)
+                        and callee_of(inner) is not None
+                        and callee_of(inner).qname
+                        in self.program_factories)):
+                self.device_returning.add(qn)
+                changed = True
+        return changed
+
+    def _program_bindings(self, fi: FunctionInfo) -> set[str]:
+        """Names bound inside `fi` to a dispatch program (a factory
+        call's result, first element on tuple unpack)."""
+        names: set[str] = set()
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            callee = self.resolve(fi.module, node.value.func)
+            if callee is None \
+                    or callee.qname not in self.program_factories:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+                elif isinstance(tgt, (ast.Tuple, ast.List)) and tgt.elts \
+                        and isinstance(tgt.elts[0], ast.Name):
+                    names.add(tgt.elts[0].id)
+        return names

@@ -1,0 +1,487 @@
+"""TT303/TT304/TT305 — whole-program device-taint, donation, and fence
+discipline (the interprocedural upgrade of TT301/TT203).
+
+All three rules run over `analysis/project.py`'s view of the scan set —
+module graph, import resolution, per-function summaries — so a program
+built by a factory in one module is tracked into the module that calls
+it. They deliberately cover ONLY what the single-module rules cannot:
+taint and donation whose source resolves ACROSS a module boundary
+(local producers stay TT301's and TT203's job, so no line ever carries
+both the local and the interprocedural finding).
+
+TT303 — cross-module device-taint reaching a host-forcing sink.
+Values produced by dispatch programs (results of calling a
+`cached_*`/`make_*_runner` factory product, or of a function summarized
+as device-returning) are device-tainted through assignments, tuple
+unpacks, and calls. Inside a loop of a configured dispatch module,
+`float()`/`int()`/`bool()`, `np.asarray`/`np.array`, `.item()`/
+`.tolist()`, and control-flow-steering comparisons on a tainted value
+each force a device round trip that serializes the dispatch pipeline —
+exactly the syncs the sanctioned fetch helpers (`sync_helpers` config;
+calling one clears taint) exist to batch.
+
+TT304 — interprocedurally-donated buffer read after the donating
+dispatch. A factory whose returned callable carries
+`jax.jit(..., donate_argnums=...)` — directly, through a passthrough
+return, or as the first element of the `(runner, cache_hit)` caching
+tuple — donates those positions AT EVERY CALL SITE in every module.
+A bare-name argument in a donated slot is deleted at dispatch; any
+later read before a rebind flags. The engine/scheduler idiom
+`state, trace = runner(pa, seeds, chunks, state, gens)` (donate and
+rebind in one statement) is clean by construction.
+
+TT305 — fence discipline inside dispatch loops: a control-classified
+host read must precede the next dispatch, telemetry must not.
+  (a) a sanctioned-fetch result that never steers control flow
+      (telemetry) fetched BEFORE a later dispatch in the same loop
+      iteration fences that dispatch for data nobody decides on —
+      move it after the dispatch, off the fence path. A bare
+      `fetch(x)` expression statement is exempt: an unbound fetch is
+      a deliberate fence.
+  (b) control flow steered through `jax.block_until_ready(...)` — a
+      whole-buffer blocking wait where the discipline wants the
+      sanctioned packed readback (`fetch`) that batches the round
+      trip and feeds the watchdog.
+
+Scope notes: function bodies named in `sync_helpers` are exempt (they
+ARE the sanctioned sync points); nested closures are not scanned
+(the dispatch loops under audit live in module-level functions and
+methods).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from timetabling_ga_tpu.analysis.core import (
+    Finding, qual_matches, qualname, target_names)
+from timetabling_ga_tpu.analysis.project import Project
+
+RULE_SYNC = "TT303"
+RULE_DONATE = "TT304"
+RULE_FENCE = "TT305"
+
+_METHOD_SINKS = {"item", "tolist"}
+_BLOCKING_WAIT = {"jax.block_until_ready", "block_until_ready"}
+
+
+def _sink_sets(config):
+    """Partition the configured `taint_sinks` into bare conversion
+    calls (`float`), dotted call names (`np.asarray`, tail-matched),
+    and method sinks (`item`/`tolist`)."""
+    converts, dotted, methods = set(), set(), set()
+    for s in getattr(config, "taint_sinks",
+                     ["float", "int", "bool", "np.asarray", "np.array",
+                      "item", "tolist"]):
+        if s in _METHOD_SINKS:
+            methods.add(s)
+        elif "." in s:
+            dotted.add(s)
+        else:
+            converts.add(s)
+    return converts, dotted, methods
+
+
+def _is_dispatch_module(mod, config) -> bool:
+    norm = mod.rel.replace("\\", "/")
+    return any(norm.endswith(sfx) for sfx in config.dispatch_modules)
+
+
+class _FuncFacts:
+    """Cross-module bindings of one function body: dispatch programs,
+    donating callables, and sanctioned-fetch classification."""
+
+    def __init__(self, proj: Project, fi):
+        self.proj = proj
+        self.fi = fi
+        self.sync_helpers = set(proj.config.sync_helpers)
+        # names bound to a dispatch program built by a factory resolved
+        # in ANOTHER module, name -> factory qname
+        self.cross_progs: dict[str, str] = {}
+        # names bound to a cross-module donating callable,
+        # name -> (positions, origin qname)
+        self.cross_donators: dict[str, tuple] = {}
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            callee = proj.resolve(fi.module, node.value.func)
+            if callee is None \
+                    or not proj.is_cross_module(fi.module, callee):
+                continue
+            spec = proj.donators.get(callee.qname)
+            is_factory = callee.qname in proj.program_factories
+            for tgt in node.targets:
+                head = None
+                tup = False
+                if isinstance(tgt, ast.Name):
+                    head = tgt.id
+                elif isinstance(tgt, (ast.Tuple, ast.List)) and tgt.elts \
+                        and isinstance(tgt.elts[0], ast.Name):
+                    head, tup = tgt.elts[0].id, True
+                if head is None:
+                    continue
+                if is_factory:
+                    self.cross_progs[head] = callee.qname
+                if spec is not None and tup == spec.tuple_result:
+                    self.cross_donators[head] = (spec.positions,
+                                                 spec.origin)
+
+    def is_sanctioned(self, call: ast.Call) -> bool:
+        qn = qualname(call.func)
+        if qn is not None \
+                and qn.rsplit(".", 1)[-1] in self.sync_helpers:
+            return True
+        callee = self.proj.resolve(self.fi.module, call.func)
+        return callee is not None and callee.name in self.sync_helpers
+
+    def device_call_origin(self, call: ast.Call) -> str | None:
+        """Factory/function qname when `call` produces a device value
+        whose producer lives in another module, else None."""
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in self.cross_progs:
+            return self.cross_progs[f.id]
+        callee = self.proj.resolve(self.fi.module, f)
+        if (callee is not None
+                and self.proj.is_cross_module(self.fi.module, callee)
+                and callee.qname in self.proj.device_returning):
+            return callee.qname
+        return None
+
+
+class _TaintChecker:
+    """TT303: linear statement walk tracking cross-module device taint
+    into host-forcing sinks inside loops."""
+
+    def __init__(self, facts: _FuncFacts, path, findings):
+        self.facts = facts
+        self.path = path
+        self.findings = findings
+        self.device: dict[str, str] = {}   # tainted name -> origin
+        (self._converts, self._dotted,
+         self._methods) = _sink_sets(facts.proj.config)
+
+    def _flag(self, node, what, origin):
+        self.findings.append(Finding(
+            RULE_SYNC, self.path, node.lineno, node.col_offset,
+            f"hidden host-device sync: {what} on a value produced by "
+            f"`{origin}` (another module's dispatch program) inside a "
+            f"dispatch loop — route the readback through a sanctioned "
+            f"fetch helper"))
+
+    def _origin(self, node: ast.AST) -> str | None:
+        """Origin qname when the expression carries cross-module device
+        taint, else None."""
+        if isinstance(node, ast.Name):
+            return self.device.get(node.id)
+        if isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+            return self._origin(node.value)
+        if isinstance(node, ast.Call):
+            if self.facts.is_sanctioned(node):
+                return None
+            origin = self.facts.device_call_origin(node)
+            if origin is not None:
+                return origin
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                o = self._origin(a)
+                if o is not None:
+                    return o
+            return None
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                o = self._origin(child)
+                if o is not None:
+                    return o
+        return None
+
+    def _check_sinks(self, node: ast.AST, in_loop: bool):
+        if not in_loop:
+            return
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            qn = qualname(sub.func)
+            if (isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in self._methods):
+                o = self._origin(sub.func.value)
+                if o is not None:
+                    self._flag(sub, f"`.{sub.func.attr}()`", o)
+            elif qn in self._converts and sub.args:
+                o = self._origin(sub.args[0])
+                if o is not None:
+                    self._flag(sub, f"`{qn}()`", o)
+            elif qual_matches(qn, self._dotted) and sub.args:
+                o = self._origin(sub.args[0])
+                if o is not None:
+                    self._flag(sub, f"`{qn}()`", o)
+
+    def _check_test(self, test: ast.AST, in_loop: bool):
+        """Control-flow-steering comparison on a tainted value."""
+        if not in_loop:
+            return
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Compare):
+                for opnd in [sub.left] + list(sub.comparators):
+                    o = self._origin(opnd)
+                    if o is not None:
+                        self._flag(
+                            sub, "control-flow-steering comparison", o)
+                        return
+
+    def _bind(self, targets, value):
+        origin = self._origin(value)
+        for tgt in targets:
+            for name in target_names(tgt):
+                if origin is not None:
+                    self.device[name] = origin
+                else:
+                    self.device.pop(name, None)
+
+    def run(self):
+        self._stmts(self.facts.fi.node.body, in_loop=False)
+
+    def _stmts(self, stmts, in_loop):
+        for st in stmts:
+            self._stmt(st, in_loop)
+
+    def _stmt(self, st, in_loop):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return
+        if isinstance(st, ast.Assign):
+            self._check_sinks(st.value, in_loop)
+            self._bind(st.targets, st.value)
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign, ast.Expr,
+                             ast.Return)):
+            if getattr(st, "value", None) is not None:
+                self._check_sinks(st.value, in_loop)
+        elif isinstance(st, (ast.If, ast.While)):
+            self._check_test(st.test, in_loop)
+            self._check_sinks(st.test, in_loop)
+            inner = in_loop or isinstance(st, ast.While)
+            self._stmts(st.body, inner)
+            self._stmts(st.orelse, inner)
+        elif isinstance(st, ast.For):
+            self._check_sinks(st.iter, in_loop)
+            self._stmts(st.body, True)
+            self._stmts(st.orelse, in_loop)
+        elif isinstance(st, ast.With):
+            self._stmts(st.body, in_loop)
+        elif isinstance(st, ast.Try):
+            self._stmts(st.body, in_loop)
+            for h in st.handlers:
+                self._stmts(h.body, in_loop)
+            self._stmts(st.orelse, in_loop)
+            self._stmts(st.finalbody, in_loop)
+
+
+class _DonationChecker:
+    """TT304: donated-slot arguments of cross-module donating callables
+    die at the call; later reads flag until a rebind."""
+
+    def __init__(self, facts: _FuncFacts, path, findings):
+        self.facts = facts
+        self.path = path
+        self.findings = findings
+        self.dead: dict[str, tuple] = {}   # name -> (lineno, origin)
+
+    def _flag(self, node, name):
+        lineno, origin = self.dead.pop(name)
+        self.findings.append(Finding(
+            RULE_DONATE, self.path, node.lineno, node.col_offset,
+            f"`{name}` was donated on line {lineno} to a dispatch "
+            f"program whose factory `{origin}` declares donate_argnums "
+            f"in another module — the buffer is deleted at dispatch; "
+            f"use the call's output or clone before donating"))
+
+    def _check_reads(self, node: ast.AST):
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Name)
+                    and isinstance(sub.ctx, ast.Load)
+                    and sub.id in self.dead):
+                self._flag(sub, sub.id)
+
+    def _handle_calls(self, node: ast.AST):
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call) \
+                    or not isinstance(sub.func, ast.Name):
+                continue
+            entry = self.facts.cross_donators.get(sub.func.id)
+            if entry is None:
+                continue
+            positions, origin = entry
+            for pos in positions:
+                if pos < len(sub.args) \
+                        and isinstance(sub.args[pos], ast.Name):
+                    self.dead[sub.args[pos].id] = (sub.lineno, origin)
+
+    def run(self):
+        self._stmts(self.facts.fi.node.body)
+
+    def _stmts(self, stmts):
+        for st in stmts:
+            self._stmt(st)
+
+    def _stmt(self, st):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return
+        if isinstance(st, ast.Assign):
+            self._check_reads(st.value)
+            self._handle_calls(st.value)
+            for tgt in st.targets:
+                for name in target_names(tgt):
+                    self.dead.pop(name, None)   # rebind revives
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign, ast.Expr,
+                             ast.Return, ast.Raise, ast.Assert)):
+            val = getattr(st, "value", None) or getattr(st, "test", None)
+            if val is not None:
+                self._check_reads(val)
+                self._handle_calls(val)
+        elif isinstance(st, (ast.If, ast.While)):
+            self._check_reads(st.test)
+            self._handle_calls(st.test)
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, ast.For):
+            self._check_reads(st.iter)
+            self._handle_calls(st.iter)
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, ast.With):
+            for item in st.items:
+                self._check_reads(item.context_expr)
+                self._handle_calls(item.context_expr)
+            self._stmts(st.body)
+        elif isinstance(st, ast.Try):
+            self._stmts(st.body)
+            for h in st.handlers:
+                self._stmts(h.body)
+            self._stmts(st.orelse)
+            self._stmts(st.finalbody)
+
+
+class _FenceChecker:
+    """TT305: telemetry fetches that fence the next dispatch, and
+    control flow steered through block_until_ready."""
+
+    def __init__(self, facts: _FuncFacts, path, findings):
+        self.facts = facts
+        self.path = path
+        self.findings = findings
+        # every name read by a control-flow test anywhere in the scope
+        self.control_names: set[str] = set()
+        for node in ast.walk(facts.fi.node):
+            if isinstance(node, (ast.If, ast.While)):
+                self.control_names |= {
+                    n.id for n in ast.walk(node.test)
+                    if isinstance(n, ast.Name)}
+
+    def run(self):
+        for node in ast.walk(self.facts.fi.node):
+            if isinstance(node, (ast.For, ast.While)):
+                self._check_loop(node)
+
+    def _flat(self, stmts):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            yield st
+            for attr in ("body", "orelse", "finalbody"):
+                yield from self._flat(getattr(st, attr, []) or [])
+            for h in getattr(st, "handlers", []) or []:
+                yield from self._flat(h.body)
+
+    def _is_dispatch(self, st) -> bool:
+        for sub in ast.walk(st):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Name)
+                    and sub.func.id in self.facts.cross_progs):
+                return True
+        return False
+
+    def _fetch_binding(self, st):
+        """(call, bound_names) when `st` assigns a sanctioned-fetch
+        result; bare Expr fetches are deliberate fences (exempt)."""
+        if not isinstance(st, ast.Assign) \
+                or not isinstance(st.value, ast.Call):
+            return None
+        if not self.facts.is_sanctioned(st.value):
+            return None
+        names = {n for tgt in st.targets for n in target_names(tgt)}
+        return (st.value, names) if names else None
+
+    def _check_loop(self, loop):
+        stmts = list(self._flat(loop.body))
+        dispatch_at = [i for i, st in enumerate(stmts)
+                       if self._is_dispatch(st)]
+        if dispatch_at:
+            last_dispatch = dispatch_at[-1]
+            for i, st in enumerate(stmts[:last_dispatch]):
+                hit = self._fetch_binding(st)
+                if hit is None:
+                    continue
+                call, names = hit
+                if names & self.control_names:
+                    continue   # control read before dispatch: the rule
+                self.findings.append(Finding(
+                    RULE_FENCE, self.path, call.lineno,
+                    call.col_offset,
+                    f"telemetry host read "
+                    f"`{qualname(call.func)}(...)` fences the next "
+                    f"dispatch — only control reads may precede a "
+                    f"dispatch; move telemetry after it (or drop the "
+                    f"binding to make the fence explicit)"))
+        for st in stmts:
+            for sub in ast.walk(st):
+                if (isinstance(sub, ast.Call)
+                        and qual_matches(qualname(sub.func),
+                                         _BLOCKING_WAIT)
+                        and sub.args):
+                    bound = set()
+                    if isinstance(st, ast.Assign):
+                        bound = {n for tgt in st.targets
+                                 for n in target_names(tgt)}
+                    arg = sub.args[0]
+                    steered = bound & self.control_names or (
+                        isinstance(arg, ast.Name)
+                        and arg.id in self.control_names)
+                    if steered:
+                        self.findings.append(Finding(
+                            RULE_FENCE, self.path, sub.lineno,
+                            sub.col_offset,
+                            "control flow steered through "
+                            "`jax.block_until_ready` — a whole-buffer "
+                            "blocking wait; control fences must use "
+                            "the sanctioned packed fetch helper"))
+
+
+def _analyze_project(proj: Project, ctx) -> dict[str, list[Finding]]:
+    out: dict[str, list[Finding]] = {}
+    rules = ctx.config.rules
+    sync_helpers = set(ctx.config.sync_helpers)
+    for fi in proj.functions.values():
+        if fi.name in sync_helpers:
+            continue   # the sanctioned sync points themselves
+        facts = _FuncFacts(proj, fi)
+        findings = out.setdefault(fi.module.rel, [])
+        if "TT304" in rules and facts.cross_donators:
+            _DonationChecker(facts, fi.module.rel, findings).run()
+        if _is_dispatch_module(fi.module, ctx.config):
+            if "TT303" in rules:
+                _TaintChecker(facts, fi.module.rel, findings).run()
+            if "TT305" in rules:
+                _FenceChecker(facts, fi.module.rel, findings).run()
+    return out
+
+
+def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
+    cache = getattr(ctx, "interproc_findings", None)
+    if cache is None:
+        sources = getattr(ctx, "sources", None) \
+            or [(path, path, tree, src)]
+        proj = Project(sources, ctx.config)
+        cache = _analyze_project(proj, ctx)
+        ctx.interproc_findings = cache
+    return list(cache.get(path, []))

@@ -41,7 +41,6 @@ import io
 import itertools
 import json
 import os
-import queue as queue_mod
 import socket
 import subprocess
 import sys
@@ -433,6 +432,7 @@ class Replica:
 
         # deferred: this is the one fleet entry point that pulls in
         # the solver stack (jax) — gateways and clients never do
+        from timetabling_ga_tpu.runtime import dispatch_core
         from timetabling_ga_tpu.serve.service import SolveService
         self.name = name
         self.cfg = cfg
@@ -456,7 +456,7 @@ class Replica:
         self.svc = SolveService(
             dataclasses.replace(cfg, output=None), out=self.tail,
             now=now, registry=registry)
-        self.inbox = queue_mod.Queue()
+        self.inbox = dispatch_core.CommandFence()
         self.index: dict = {}        # pre-admission / rejected states
         self.index_lock = threading.Lock()
         self.auto_id = itertools.count(1)
@@ -547,10 +547,7 @@ class Replica:
                             self._preempt()
                         else:
                             self._set_draining()
-                    try:
-                        cmd = self.inbox.get_nowait()
-                    except queue_mod.Empty:
-                        cmd = None
+                    cmd = self.inbox.poll()
                     if cmd is not None:
                         self._handle(cmd)
                         continue
@@ -562,11 +559,9 @@ class Replica:
                         busy = bool(self.svc.step())
                     self._reap_terminal()
                     if not busy:
-                        try:
-                            self._handle(
-                                self.inbox.get(timeout=0.05))
-                        except queue_mod.Empty:
-                            pass
+                        cmd = self.inbox.wait(timeout=0.05)
+                        if cmd is not None:
+                            self._handle(cmd)
                 except KeyboardInterrupt:
                     # foreground mode: ^C = drain request, not a crash
                     self._set_draining()

@@ -1,0 +1,504 @@
+"""The single dispatch core shared by all three dispatch loops.
+
+Before this module, `runtime/engine.py` (the run loop), `serve/
+scheduler.py` (the packing/time-slicing scheduler) and `fleet/
+replicas.py` (the replica drive loop) each re-implemented the
+control-vs-telemetry fence rule that keeps the device busy — three
+hand-copied variants of the same discipline (ROADMAP item 1). This
+module owns the pieces they share:
+
+  - the compiled-program caches (RUNNER_CACHE / INIT_CACHE) and the
+    fault-recovery program purge bound to a mesh;
+  - the fetch watchdog (`fetch`): every classified CONTROL-fence host
+    read runs under a deadline so a hung fetch RPC becomes a
+    recoverable FetchTimeout, with deterministic fault injection
+    (runtime/faults.py `fetch` site) on the same path;
+  - the sanctioned TELEMETRY read (`fetch_leaf`): a plain host
+    materialization of an already-transferred telemetry leaf — never a
+    control fence, never injected, never deadline-guarded;
+  - the packed one-round-trip readbacks (`fetch_final`, `fetch_state`)
+    and the resume-side rehydrate (`reshard_state`);
+  - the snapshot/rehydrate fault-recovery policy (Snapshot /
+    Supervisor) the engine's supervised region and the serve path's
+    per-job recovery both apply;
+  - the depth-2 dispatch pipeline discipline (Chunk /
+    DispatchPipeline): at most one in-flight chunk, retired with the
+    next chunk already enqueued;
+  - the command fence (CommandFence) of the fleet drive loop: commands
+    from other threads are consumed only at control-fence boundaries,
+    never mid-dispatch;
+  - the shared telemetry decode (`decode_telemetry`): quality-block
+    split, event decode under the effective trace mode, and on-device
+    event-capacity overflow surfacing — one implementation for the
+    engine's retire path and the scheduler's park path.
+
+The split matters beyond deduplication: tt-analyze's interprocedural
+device-taint pass (TT303/TT304/TT305 — analysis/project.py) treats
+this module as THE dispatch surface. `fetch`/`fetch_final`/
+`fetch_state` are the sanctioned control fences that clear device
+taint; `fetch_leaf` is the sanctioned telemetry read; any other
+host-forcing sink on a device-tainted value inside a dispatch loop is
+a finding.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+
+from timetabling_ga_tpu.obs.spans import NULL_TRACER
+from timetabling_ga_tpu.ops import ga
+from timetabling_ga_tpu.parallel import islands
+from timetabling_ga_tpu.runtime import faults
+from timetabling_ga_tpu.runtime import retry
+from timetabling_ga_tpu.runtime.config import RunConfig
+
+# Compiled-program caches, shared across engine.run calls AND the serve
+# path's lane programs. A jitted island runner costs seconds to tens of
+# seconds to compile at race scale; rebuilding it per run (as round 2
+# did, with a run-local dict) made every timed run recompile inside its
+# own wall-clock budget even after a warm-up run with identical shapes.
+# Keyed on the mesh's device identity plus every static that changes
+# the traced program. The engine's cached_* factories populate them;
+# they live HERE so recovery's purge_programs covers every loop's
+# programs with one rule.
+RUNNER_CACHE: dict = {}
+INIT_CACHE: dict = {}
+
+
+def mesh_key(mesh):
+    return tuple((d.platform, d.id) for d in mesh.devices.flat)
+
+
+def purge_programs(mesh) -> None:
+    """Drop every compiled program bound to `mesh`'s devices from the
+    module caches. After a transient device failure the cached
+    executables may reference poisoned device state (a killed kernel's
+    buffers, a dead tunnel stream); recovery rebuilds them — the
+    recompile costs seconds and is charged against the trial budget,
+    which beats resuming through an executable in an unknown state.
+    Shared by the run supervisor and the serve-path per-job recovery
+    (serve/scheduler.py _recover_quantum): both apply the same rule."""
+    mk = mesh_key(mesh)
+    for cache in (RUNNER_CACHE, INIT_CACHE):
+        for k in [k for k in cache if mk in k]:
+            del cache[k]
+
+
+def clone_state(state):
+    """Fresh device copy of a state pytree, sharding preserved.
+
+    precompile's warm-up calls run through the DONATING runners (timed
+    runs reuse exactly these compiled programs, so the warmed programs
+    must be the donating ones), and donation DELETES its input buffers
+    at dispatch. Every state a warm-up consumes is therefore either a
+    clone of a state that is needed again, or the previous warm-up
+    call's output — never a buffer someone else still holds."""
+    import jax.numpy as jnp
+    return jax.tree.map(jnp.copy, state)
+
+
+# one dispatched-but-not-yet-retired chunk of the pipelined run loop
+# (see DispatchPipeline): `trace` is the chunk's DEVICE-side telemetry
+# array, fenced only when the chunk is retired; `flow` is the chunk's
+# causal flow id (obs/spans.py new_flow) connecting its dispatch /
+# fetch / fetch-read / process spans across threads; `cost` is the
+# dispatched program's compile-time cost dict (obs/cost.py
+# CostProgram.last_cost — flops/bytes), joined with the chunk's
+# measured wall time into the live roofline gauges at retire
+Chunk = collections.namedtuple(
+    "Chunk", "td0 n_ep gens_run dyn_gens trace warm do_prof flow cost")
+
+
+class DispatchPipeline:
+    """Depth-2 asynchronous dispatch pipeline discipline (the engine
+    module docstring's control-vs-telemetry split, distilled): at most
+    ONE chunk is in flight; submitting chunk N+1 retires chunk N with
+    N+1 already enqueued on the device, so N's telemetry processing
+    overlaps N+1's compute. `enabled` is mutable mid-run — the fault
+    supervisor's degradation ladder serializes the loop at level >= 1
+    and restores the configured pipelining when the ladder relaxes
+    back to level 0 — and disabling only changes WHEN chunks retire,
+    never what was dispatched, which is why serial and pipelined runs
+    emit identical records modulo timing (jsonl.strip_timing)."""
+
+    def __init__(self, process, enabled: bool):
+        self.process = process       # process(chunk, inflight=None)
+        self.enabled = enabled
+        self.pending = None          # the one in-flight chunk
+
+    def submit(self, chunk) -> None:
+        """Dispatch-side handoff: pipelined, park the chunk and retire
+        its predecessor (which `process` sees with this chunk already
+        running, passed as `inflight`); serial, retire immediately —
+        exactly the classic loop-body order."""
+        if self.enabled:
+            if self.pending is not None:
+                self.process(self.pending, inflight=chunk)
+            self.pending = chunk
+        else:
+            self.process(chunk)
+
+    def drain(self) -> None:
+        """Retire the in-flight chunk, if any — the loop-exit barrier,
+        and the serial fallback when a control read needs every chunk
+        retired before the next dispatch decision."""
+        if self.pending is not None:
+            self.process(self.pending)
+            self.pending = None
+
+    def abandon(self):
+        """Recovery-side teardown: forget the in-flight chunk WITHOUT
+        retiring it (its device buffers may be poisoned) and return it
+        so the caller can delete its trace. The supervisor calls this
+        before rehydrating from the snapshot."""
+        chunk, self.pending = self.pending, None
+        return chunk
+
+
+class CommandFence:
+    """Bounded command inbox drained at control fences — the fleet
+    drive loop's concurrency discipline (fleet/replicas.py). The drive
+    loop is the ONLY thread that touches the device; HTTP handlers,
+    signal flags and test drivers communicate by enqueueing commands,
+    which the loop consumes only BETWEEN dispatched quanta (every job
+    is at a park fence there), never mid-dispatch. `poll` is the busy
+    fence tick; `wait` is the idle tick, bounded so drain/kill flags
+    are still observed promptly."""
+
+    def __init__(self):
+        import queue
+        self._q = queue.Queue()
+        self._empty = queue.Empty
+
+    def put(self, cmd) -> None:
+        self._q.put(cmd)
+
+    def poll(self):
+        """Non-blocking fence drain: the next queued command, or None
+        when the inbox is empty (the loop proceeds to dispatch)."""
+        try:
+            return self._q.get_nowait()
+        except self._empty:
+            return None
+
+    def wait(self, timeout: float):
+        """Idle fence tick: block up to `timeout` for a command, or
+        None — the loop re-checks its drain/kill flags either way."""
+        try:
+            return self._q.get(timeout=timeout)
+        except self._empty:
+            return None
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """Rolling in-memory host snapshot of the last control-fenced run
+    state — what the supervisor rehydrates from. All-numpy: nothing
+    here references device buffers, so a device kill cannot poison it.
+    Captured at the points where the host state is already in hand
+    (init/resume, every checkpoint fence), so steady-state snapshotting
+    adds no extra device round trips."""
+    state: ga.PopState          # host (numpy) population
+    key: np.ndarray             # raw key_data at this point
+    gens_done: int
+    epochs_done: int
+    epochs_at_ckpt: int
+    best_seen: list             # control bests AT this point
+    post: bool                  # post-feasibility phase active
+    kick: tuple                 # (kick_stall, kick_best, kick_streak)
+    # a pipelined checkpoint fence covers the in-flight chunk's STATE
+    # but its logEntries are not yet emitted; the already-fetched trace
+    # is kept so recovery can emit them before resuming (the JSONL
+    # stream then matches an uninjected run's, modulo timing)
+    inflight_trace: object = None
+    # True only for the init-time snapshot of a run whose LAHC endgame
+    # already ran before the generation loop (feasible at init): replay
+    # must skip the loop, not re-breed
+    lahc_done: bool = False
+
+
+class Supervisor:
+    """In-run fault recovery policy (README "Fault tolerance").
+
+    Holds the rolling Snapshot, classifies failures via
+    retry.is_transient (cause chain included), budgets recoveries
+    (--max-recoveries), and drives the degradation ladder on repeated
+    failures within a window:
+
+        level 0  pipelined dispatch (as configured)
+        level 1  strictly serial loop (--no-pipeline equivalent)
+        level 2+ serial AND dispatch chunks halved per level (the
+                 DISPATCH_CAP_S machinery's dynamic runner serves the
+                 shrunk chunks — smaller dispatches both finish under a
+                 sick device's watchdog and lose less work per kill)
+
+    Single-process only: recovery decisions read local clocks and local
+    errors, and multi-host processes would have to agree on them before
+    diverging from the collective program order (future work — the
+    ROADMAP's multi-host pipelining item has the same shape)."""
+
+    WINDOW_S = float(os.environ.get("TT_FAULT_WINDOW_S", "300"))
+    MAX_LEVEL = 4
+
+    def __init__(self, cfg: RunConfig):
+        self.cfg = cfg
+        self.enabled = (cfg.max_recoveries > 0
+                        and jax.process_count() == 1)
+        self.snap: Snapshot | None = None
+        self.recoveries = 0
+        self.level = 0
+        self.failures: list = []     # monotonic fail times (ladder window)
+        self._relaxed_at: float | None = None   # last step-back-UP time
+
+    def snapshot(self, **kw) -> None:
+        if self.enabled:
+            self.snap = Snapshot(**kw)
+
+    def dispatch_scale(self) -> float:
+        """Chunk-size multiplier for ladder levels >= 2."""
+        return 0.5 ** max(0, self.level - 1)
+
+    def classify(self, exc: BaseException):
+        """The faultEntry site when `exc` is recoverable here, else
+        None (caller re-raises). Recoverable = supervisor enabled, a
+        snapshot exists to rehydrate from, and the error classifies
+        transient over its whole cause chain."""
+        if not self.enabled or self.snap is None:
+            return None
+        if not retry.is_transient(exc):
+            return None
+        return getattr(exc, "tt_site", "dispatch")
+
+    def escalate(self, now: float) -> bool:
+        """Record a failure; step the ladder when failures cluster
+        inside WINDOW_S. Returns True when the level changed."""
+        self.failures.append(now)
+        recent = [t for t in self.failures if now - t <= self.WINDOW_S]
+        new_level = min(len(recent) - 1, self.MAX_LEVEL)
+        if new_level > self.level:
+            self.level = new_level
+            return True
+        return False
+
+    def maybe_relax(self, now: float) -> bool:
+        """Step the ladder back UP (one level per clean WINDOW_S):
+        before this the ladder only ever worsened within a run, so one
+        early sick window left the whole rest of a long run serialized
+        and chunk-halved — and /readyz stuck on `degraded` — even
+        after the device recovered (carried ROADMAP item). A stretch
+        of WINDOW_S with no failure since the last failure OR the last
+        relax earns one level back; the engine re-enables pipelining
+        when level 0 is reached and the degrade_level gauge follows
+        live, so the /readyz reason clears. Returns True when the
+        level changed (the caller emits the faultEntry `restore`
+        record)."""
+        if self.level <= 0:
+            return False
+        anchor = self.failures[-1] if self.failures else None
+        if self._relaxed_at is not None:
+            anchor = (self._relaxed_at if anchor is None
+                      else max(anchor, self._relaxed_at))
+        if anchor is not None and now - anchor < self.WINDOW_S:
+            return False
+        self.level -= 1
+        self._relaxed_at = now
+        return True
+
+
+def reshard_state(state: ga.PopState, mesh) -> ga.PopState:
+    """Place a host (numpy) PopState onto the mesh as GLOBAL
+    island-sharded arrays. Multi-host safe: every process holds the full
+    host copy (the checkpoint stores the global population), and
+    `make_array_from_callback` slices out each process's local shards —
+    the resume-side counterpart of the checkpoint allgather."""
+    from jax.sharding import NamedSharding
+    sh = NamedSharding(mesh, jax.sharding.PartitionSpec(islands.AXIS))
+    return jax.tree.map(
+        lambda x: jax.make_array_from_callback(
+            np.asarray(x).shape, sh, lambda idx, x=x: np.asarray(x)[idx]),
+        state)
+
+
+# deadline (seconds) for the fetch watchdog below; set per run from
+# RunConfig.fetch_timeout (0/None disables, via set_fetch_timeout).
+# Module-level because fetch is called from every layer of every
+# dispatch loop.
+_FETCH_TIMEOUT: float | None = None
+
+
+def set_fetch_timeout(timeout: float | None) -> None:
+    """Install the control-fence fetch deadline for this process
+    (engine.run / engine.precompile call this from
+    RunConfig.fetch_timeout; 0/None disables the watchdog)."""
+    global _FETCH_TIMEOUT
+    _FETCH_TIMEOUT = timeout if timeout else None
+
+
+class FetchTimeout(TimeoutError):
+    """A classified control-fence host read exceeded the watchdog
+    deadline. The message carries retry.TRANSIENT_MARKERS' 'fetch
+    watchdog' so the supervisor classifies it transient: a hung fetch
+    on the tunneled device (the BENCH_r05 mid-stream RPC death's worst
+    case) is a sick window, not a program bug."""
+
+
+def fetch(x, tracer=NULL_TRACER, flow=None) -> np.ndarray:
+    """Device->host CONTROL fetch that also works for multi-host global
+    arrays: single-process it is a plain np.asarray; multi-process the
+    shards are allgathered so every process sees the global value (the
+    reference ships full solutions between ranks the same way,
+    ga.cpp:318-368).
+
+    Single-process fetches run under a deadline watchdog (RunConfig.
+    fetch_timeout): the read happens on a monitored thread, and when it
+    outlives the deadline the MAIN loop abandons it and raises
+    FetchTimeout — a hung fetch RPC becomes a classified, recoverable
+    error instead of a silent stall. The abandoned daemon thread parks
+    on the dead RPC; its eventual result is discarded. Multi-host
+    fetches are collectives and must stay on the main thread (every
+    process must enter them in program order), so the watchdog is
+    single-process only. `faults.maybe_fail('fetch')` is the injection
+    point for both the hang and the kill flavor."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        faults.maybe_fail("fetch")
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    timeout = _FETCH_TIMEOUT
+    if not timeout:
+        faults.maybe_fail("fetch")
+        return np.asarray(x)
+    box: dict = {}
+
+    def _read():
+        tr0 = time.monotonic()
+        try:
+            faults.maybe_fail("fetch")
+            box["value"] = np.asarray(x)
+            if flow is not None:
+                # the watchdog THREAD's half of the fetch: a span on its
+                # own tid, tied to the dispatch's flow id so `tt trace`
+                # draws the arrow across the thread boundary
+                tracer.record("fetch-read", tr0,
+                              time.monotonic() - tr0, cat="engine",
+                              flow=flow)
+        except BaseException as e:   # re-raised on the main thread
+            box["error"] = e
+
+    th = threading.Thread(target=_read, name="tt-fetch-watchdog",
+                          daemon=True)
+    th.start()
+    th.join(timeout)
+    if th.is_alive():
+        err = FetchTimeout(
+            f"fetch watchdog: control-fence host read exceeded "
+            f"{timeout:.0f}s deadline")
+        err.tt_site = "fetch"
+        raise err
+    if "error" in box:
+        e = box["error"]
+        e.tt_site = "fetch"
+        raise e
+    return box["value"]
+
+
+def fetch_leaf(x) -> np.ndarray:
+    """Sanctioned TELEMETRY read: materialize an already-dispatched
+    telemetry leaf on the host. Deliberately NOT `fetch`: a telemetry
+    read must never become a classified control fence — no fault
+    injection (adding a `fetch` site here would shift every
+    deterministic TT_FAULTS invocation index), no watchdog deadline,
+    no allgather (telemetry is process-local by construction). The
+    interprocedural taint pass (TT303/TT305) treats this as the
+    telemetry-side sink that CLEARS device taint without fencing the
+    dispatch stream."""
+    return np.asarray(x)
+
+
+def fetch_final(state, n_islands: int, pop: int):
+    """endTry device->host readback as ONE round trip: concatenate
+    slots/rooms/hcv/scv into a single (N*P, 2E+2) device array and fetch
+    it once (each separate fetch is a multi-second round trip on
+    tunneled devices — the same cost the polish loop's stacked stats
+    fetch avoids). Returns (slots (N,P,E), rooms (N,P,E), best-row hcv
+    (N,), best-row scv (N,)) as numpy."""
+    import jax.numpy as jnp
+    packed = fetch(jnp.concatenate(
+        [state.slots, state.rooms,
+         state.hcv[:, None], state.scv[:, None]], axis=1))
+    E = (packed.shape[1] - 2) // 2
+    slots = packed[:, :E].reshape(n_islands, pop, E)
+    rooms = packed[:, E:2 * E].reshape(n_islands, pop, E)
+    hcv = packed[:, 2 * E].reshape(n_islands, pop)[:, 0]
+    scv = packed[:, 2 * E + 1].reshape(n_islands, pop)[:, 0]
+    return slots, rooms, hcv, scv
+
+
+def fetch_state(state) -> ga.PopState:
+    """Host (numpy) snapshot of a PopState as ONE device round trip —
+    the checkpoint-path sibling of `fetch_final` (each separate fetch
+    is a multi-second round trip on tunneled devices, VERDICT round-3
+    weak #3, and this fetch sits on the pipelined dispatch path):
+    concatenate slots/rooms/penalty/hcv/scv into a single
+    (N*P, 2E+3) int32 array, fetch once, slice apart. The returned
+    all-numpy PopState is the same tuple checkpoint.save takes and
+    reshard_state re-places."""
+    import jax.numpy as jnp
+    packed = fetch(jnp.concatenate(
+        [state.slots, state.rooms, state.penalty[:, None],
+         state.hcv[:, None], state.scv[:, None]], axis=1))
+    E = (packed.shape[1] - 3) // 2
+    return ga.PopState(
+        slots=packed[:, :E], rooms=packed[:, E:2 * E],
+        penalty=packed[:, 2 * E], hcv=packed[:, 2 * E + 1],
+        scv=packed[:, 2 * E + 2])
+
+
+def decode_telemetry(trace, quality: bool, trace_mode: str,
+                     metrics=None, overflow_counter: str = "",
+                     overflow_warned: bool = True,
+                     warn_label: str = "", dyn_gens=None):
+    """Shared telemetry decode for a retired chunk/quantum — the block
+    the engine's `_process` and the scheduler's park path used to
+    hand-copy. Splits the trailing quality rows off the fetched leaf
+    (numpy slice; the fetch stayed one leaf), trims a dynamic
+    dispatch's full-trace tail, decodes events under the EFFECTIVE
+    trace mode (a full trace upgrades to deltas under quality —
+    islands.effective_trace_mode; the record stream is unchanged), and
+    surfaces on-device event-capacity overflow: the count says how
+    many improvements happened, the event block holds at most
+    TRACE_DELTAS_CAP — never under-report silently.
+
+    Returns (events, ev_moments, qrows, overflow_warned). Pass
+    `metrics`/`overflow_counter` to count dropped events (engine:
+    engine.trace_delta_overflow, serve: serve.trace_delta_overflow);
+    `warn_label` prefixes the one-shot stderr warning ("" for the
+    engine, "serve " for the scheduler) so the messages stay exactly
+    what each loop printed before the extraction."""
+    trace, qrows = islands.split_quality(trace, quality)
+    ev_mode = islands.effective_trace_mode(trace_mode, quality)
+    if dyn_gens is not None and ev_mode == "full":
+        # compressed leaves carry their own validity (sentinel event
+        # rows); only the full trace needs the tail slice
+        trace = trace[:, :, :dyn_gens]
+    events, ev_counts, ev_moments = islands.trace_events(trace, ev_mode)
+    if ev_counts is not None and metrics is not None:
+        dropped = int(sum(max(0, int(c) - len(e))
+                          for c, e in zip(ev_counts, events)))
+        if dropped:
+            metrics.counter(overflow_counter).inc(dropped)
+            if not overflow_warned:
+                overflow_warned = True
+                print(f"warning: {warn_label}--trace-mode {trace_mode} "
+                      f"dropped {dropped} improvement event(s) "
+                      f"this dispatch (cap "
+                      f"{islands.TRACE_DELTAS_CAP}; raise "
+                      f"TT_TRACE_DELTAS_CAP)", file=sys.stderr)
+    return events, ev_moments, qrows, overflow_warned

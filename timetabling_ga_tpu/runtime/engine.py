@@ -96,11 +96,9 @@ A/B across modes, pipelining, and obs).
 
 from __future__ import annotations
 
-import collections
 import dataclasses
 import os
 import sys
-import threading
 import time
 
 import jax
@@ -114,34 +112,33 @@ from timetabling_ga_tpu.ops import ga
 from timetabling_ga_tpu.parallel import islands
 from timetabling_ga_tpu.problem import load_tim_file
 from timetabling_ga_tpu.runtime import checkpoint as ckpt
+from timetabling_ga_tpu.runtime import dispatch_core as dcore
 from timetabling_ga_tpu.runtime import faults
 from timetabling_ga_tpu.runtime import jsonl
 from timetabling_ga_tpu.runtime import retry
 from timetabling_ga_tpu.runtime.config import RunConfig
+from timetabling_ga_tpu.runtime.dispatch_core import FetchTimeout  # noqa: F401 (re-export: the supervised region and tests import it from here)
 
 INT_MAX = 2 ** 31 - 1
 # a reported best below this is feasible (reported form = hcv*1e6 + scv,
 # jsonl.reported_best; ga.cpp:191)
 FEASIBLE_LIMIT = 1_000_000
 
-# Compiled-program caches, shared across engine.run calls. A jitted
-# island runner costs seconds to tens of seconds to compile at race
-# scale; rebuilding it per run (as round 2 did, with a run-local dict)
-# made every timed run recompile inside its own wall-clock budget even
-# after a warm-up run with identical shapes. Keyed on the mesh's device
-# identity plus every static that changes the traced program.
+# Compiled-program caches, shared across engine.run calls — now owned
+# by the dispatch core (runtime/dispatch_core.py) so the serve path's
+# lane programs and the run loop's island programs live under one
+# purge rule; aliased here (the SAME dict objects) because callers and
+# tests clear/iterate them through the engine module.
 # Every program cached here is wrapped by the cost observatory
 # (obs/cost.py instrument): an AOT-dispatching proxy that times each
 # lower+compile, extracts the executable's cost/memory analyses into
 # the compile.* / cost.* metric families (and costEntry records under
 # --obs), and counts warm dispatches — the compile-hit rate the serve
 # path steers on. TT_COST_OBS=0 bypasses the wrapping (plain jit).
-_RUNNER_CACHE: dict = {}
-_INIT_CACHE: dict = {}
+_RUNNER_CACHE: dict = dcore.RUNNER_CACHE
+_INIT_CACHE: dict = dcore.INIT_CACHE
 
-
-def _mesh_key(mesh):
-    return tuple((d.platform, d.id) for d in mesh.devices.flat)
+_mesh_key = dcore.mesh_key
 
 
 def _pow2_floor(n: int) -> int:
@@ -166,17 +163,9 @@ def _shape_sig(problem):
             problem.n_days, problem.slots_per_day)
 
 
-def _clone(state):
-    """Fresh device copy of a state pytree, sharding preserved.
-
-    precompile's warm-up calls run through the DONATING runners (timed
-    runs reuse exactly these compiled programs, so the warmed programs
-    must be the donating ones), and donation DELETES its input buffers
-    at dispatch. Every state a warm-up consumes is therefore either a
-    clone of a state that is needed again, or the previous warm-up
-    call's output — never a buffer someone else still holds."""
-    import jax.numpy as jnp
-    return jax.tree.map(jnp.copy, state)
+# fresh device copy of a state pytree, sharding preserved — see
+# dispatch_core.clone_state for the donation discipline it serves
+_clone = dcore.clone_state
 
 
 def cached_runner(mesh, gacfg: ga.GAConfig, n_epochs: int, gens: int,
@@ -507,15 +496,10 @@ def build_post_config(cfg: RunConfig, gacfg: ga.GAConfig):
 
 
 # one dispatched-but-not-yet-retired chunk of the pipelined run loop
-# (see _run_tries): `trace` is the chunk's DEVICE-side telemetry array,
-# fenced only when the chunk is retired by _process; `flow` is the
-# chunk's causal flow id (obs/spans.py new_flow) connecting its
-# dispatch / fetch / fetch-read / process spans across threads;
-# `cost` is the dispatched program's compile-time cost dict
-# (obs/cost.py CostProgram.last_cost — flops/bytes), joined with the
-# chunk's measured wall time into the live roofline gauges at retire
-_Chunk = collections.namedtuple(
-    "_Chunk", "td0 n_ep gens_run dyn_gens trace warm do_prof flow cost")
+# (see _run_tries) — dispatch_core.Chunk, aliased for the tests and
+# callers that build chunks through the engine module
+_Chunk = dcore.Chunk
+
 
 def run_counters() -> dict:
     """Back-compat view of the process robustness counters, now held by
@@ -528,17 +512,14 @@ def run_counters() -> dict:
             "faults_injected": faults.injected_total()}
 
 
-def _purge_programs(mesh) -> None:
-    """Drop every compiled program bound to `mesh`'s devices from the
-    module caches. After a transient device failure the cached
-    executables may reference poisoned device state (a killed kernel's
-    buffers, a dead tunnel stream); recovery rebuilds them — the
-    recompile costs seconds and is charged against the trial budget,
-    which beats resuming through an executable in an unknown state."""
-    mk = _mesh_key(mesh)
-    for cache in (_RUNNER_CACHE, _INIT_CACHE):
-        for k in [k for k in cache if mk in k]:
-            del cache[k]
+# program purge + rolling-snapshot fault-recovery policy: extracted to
+# the dispatch core (one purge rule and one supervisor policy for the
+# run loop AND the serve path), aliased here because the recovery
+# tests monkeypatch them through the engine module — _run_tries
+# resolves `_Supervisor` at call time for exactly that reason
+_purge_programs = dcore.purge_programs
+_Snapshot = dcore.Snapshot
+_Supervisor = dcore.Supervisor
 
 
 def purge_programs(mesh) -> None:
@@ -548,122 +529,7 @@ def purge_programs(mesh) -> None:
     failure, every compiled program bound to the mesh (including the
     cached lane runners/inits) may reference poisoned state and is
     rebuilt on the next dispatch."""
-    _purge_programs(mesh)
-
-
-@dataclasses.dataclass
-class _Snapshot:
-    """Rolling in-memory host snapshot of the last control-fenced run
-    state — what the supervisor rehydrates from. All-numpy: nothing
-    here references device buffers, so a device kill cannot poison it.
-    Captured at the points where the host state is already in hand
-    (init/resume, every checkpoint fence), so steady-state snapshotting
-    adds no extra device round trips."""
-    state: ga.PopState          # host (numpy) population
-    key: np.ndarray             # raw key_data at this point
-    gens_done: int
-    epochs_done: int
-    epochs_at_ckpt: int
-    best_seen: list             # control bests AT this point
-    post: bool                  # post-feasibility phase active
-    kick: tuple                 # (kick_stall, kick_best, kick_streak)
-    # a pipelined checkpoint fence covers the in-flight chunk's STATE
-    # but its logEntries are not yet emitted; the already-fetched trace
-    # is kept so recovery can emit them before resuming (the JSONL
-    # stream then matches an uninjected run's, modulo timing)
-    inflight_trace: object = None
-    # True only for the init-time snapshot of a run whose LAHC endgame
-    # already ran before the generation loop (feasible at init): replay
-    # must skip the loop, not re-breed
-    lahc_done: bool = False
-
-
-class _Supervisor:
-    """In-run fault recovery policy (README "Fault tolerance").
-
-    Holds the rolling _Snapshot, classifies failures via
-    retry.is_transient (cause chain included), budgets recoveries
-    (--max-recoveries), and drives the degradation ladder on repeated
-    failures within a window:
-
-        level 0  pipelined dispatch (as configured)
-        level 1  strictly serial loop (--no-pipeline equivalent)
-        level 2+ serial AND dispatch chunks halved per level (the
-                 DISPATCH_CAP_S machinery's dynamic runner serves the
-                 shrunk chunks — smaller dispatches both finish under a
-                 sick device's watchdog and lose less work per kill)
-
-    Single-process only: recovery decisions read local clocks and local
-    errors, and multi-host processes would have to agree on them before
-    diverging from the collective program order (future work — the
-    ROADMAP's multi-host pipelining item has the same shape)."""
-
-    WINDOW_S = float(os.environ.get("TT_FAULT_WINDOW_S", "300"))
-    MAX_LEVEL = 4
-
-    def __init__(self, cfg: RunConfig):
-        self.cfg = cfg
-        self.enabled = (cfg.max_recoveries > 0
-                        and jax.process_count() == 1)
-        self.snap: _Snapshot | None = None
-        self.recoveries = 0
-        self.level = 0
-        self.failures: list = []     # monotonic fail times (ladder window)
-        self._relaxed_at: float | None = None   # last step-back-UP time
-
-    def snapshot(self, **kw) -> None:
-        if self.enabled:
-            self.snap = _Snapshot(**kw)
-
-    def dispatch_scale(self) -> float:
-        """Chunk-size multiplier for ladder levels >= 2."""
-        return 0.5 ** max(0, self.level - 1)
-
-    def classify(self, exc: BaseException):
-        """The faultEntry site when `exc` is recoverable here, else
-        None (caller re-raises). Recoverable = supervisor enabled, a
-        snapshot exists to rehydrate from, and the error classifies
-        transient over its whole cause chain."""
-        if not self.enabled or self.snap is None:
-            return None
-        if not retry.is_transient(exc):
-            return None
-        return getattr(exc, "tt_site", "dispatch")
-
-    def escalate(self, now: float) -> bool:
-        """Record a failure; step the ladder when failures cluster
-        inside WINDOW_S. Returns True when the level changed."""
-        self.failures.append(now)
-        recent = [t for t in self.failures if now - t <= self.WINDOW_S]
-        new_level = min(len(recent) - 1, self.MAX_LEVEL)
-        if new_level > self.level:
-            self.level = new_level
-            return True
-        return False
-
-    def maybe_relax(self, now: float) -> bool:
-        """Step the ladder back UP (one level per clean WINDOW_S):
-        before this the ladder only ever worsened within a run, so one
-        early sick window left the whole rest of a long run serialized
-        and chunk-halved — and /readyz stuck on `degraded` — even
-        after the device recovered (carried ROADMAP item). A stretch
-        of WINDOW_S with no failure since the last failure OR the last
-        relax earns one level back; the engine re-enables pipelining
-        when level 0 is reached and the degrade_level gauge follows
-        live, so the /readyz reason clears. Returns True when the
-        level changed (the caller emits the faultEntry `restore`
-        record)."""
-        if self.level <= 0:
-            return False
-        anchor = self.failures[-1] if self.failures else None
-        if self._relaxed_at is not None:
-            anchor = (self._relaxed_at if anchor is None
-                      else max(anchor, self._relaxed_at))
-        if anchor is not None and now - anchor < self.WINDOW_S:
-            return False
-        self.level -= 1
-        self._relaxed_at = now
-        return True
+    dcore.purge_programs(mesh)
 
 
 _DISTRIBUTED_DONE = False
@@ -696,128 +562,17 @@ def maybe_init_distributed(cfg: RunConfig) -> None:
     _DISTRIBUTED_DONE = True
 
 
-def _reshard_state(state: ga.PopState, mesh) -> ga.PopState:
-    """Place a host (numpy) PopState onto the mesh as GLOBAL
-    island-sharded arrays. Multi-host safe: every process holds the full
-    host copy (the checkpoint stores the global population), and
-    `make_array_from_callback` slices out each process's local shards —
-    the resume-side counterpart of the checkpoint allgather."""
-    from jax.sharding import NamedSharding
-    sh = NamedSharding(mesh, jax.sharding.PartitionSpec(islands.AXIS))
-    return jax.tree.map(
-        lambda x: jax.make_array_from_callback(
-            np.asarray(x).shape, sh, lambda idx, x=x: np.asarray(x)[idx]),
-        state)
-
-
-# deadline (seconds) for the fetch watchdog below; set per run from
-# RunConfig.fetch_timeout (0/None disables). Module-level because
-# _fetch is called from every layer of the run loop.
-_FETCH_TIMEOUT: float | None = None
-
-
-class FetchTimeout(TimeoutError):
-    """A classified control-fence host read exceeded the watchdog
-    deadline. The message carries retry.TRANSIENT_MARKERS' 'fetch
-    watchdog' so the supervisor classifies it transient: a hung fetch
-    on the tunneled device (the BENCH_r05 mid-stream RPC death's worst
-    case) is a sick window, not a program bug."""
-
-
-def _fetch(x, tracer=NULL_TRACER, flow=None) -> np.ndarray:
-    """Device->host fetch that also works for multi-host global arrays:
-    single-process it is a plain np.asarray; multi-process the shards
-    are allgathered so every process sees the global value (the
-    reference ships full solutions between ranks the same way,
-    ga.cpp:318-368).
-
-    Single-process fetches run under a deadline watchdog (RunConfig.
-    fetch_timeout): the read happens on a monitored thread, and when it
-    outlives the deadline the MAIN loop abandons it and raises
-    FetchTimeout — a hung fetch RPC becomes a classified, recoverable
-    error instead of a silent stall. The abandoned daemon thread parks
-    on the dead RPC; its eventual result is discarded. Multi-host
-    fetches are collectives and must stay on the main thread (every
-    process must enter them in program order), so the watchdog is
-    single-process only. `faults.maybe_fail('fetch')` is the injection
-    point for both the hang and the kill flavor."""
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        faults.maybe_fail("fetch")
-        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
-    timeout = _FETCH_TIMEOUT
-    if not timeout:
-        faults.maybe_fail("fetch")
-        return np.asarray(x)
-    box: dict = {}
-
-    def _read():
-        tr0 = time.monotonic()
-        try:
-            faults.maybe_fail("fetch")
-            box["value"] = np.asarray(x)
-            if flow is not None:
-                # the watchdog THREAD's half of the fetch: a span on its
-                # own tid, tied to the dispatch's flow id so `tt trace`
-                # draws the arrow across the thread boundary
-                tracer.record("fetch-read", tr0,
-                              time.monotonic() - tr0, cat="engine",
-                              flow=flow)
-        except BaseException as e:   # re-raised on the main thread
-            box["error"] = e
-
-    th = threading.Thread(target=_read, name="tt-fetch-watchdog",
-                          daemon=True)
-    th.start()
-    th.join(timeout)
-    if th.is_alive():
-        err = FetchTimeout(
-            f"fetch watchdog: control-fence host read exceeded "
-            f"{timeout:.0f}s deadline")
-        err.tt_site = "fetch"
-        raise err
-    if "error" in box:
-        e = box["error"]
-        e.tt_site = "fetch"
-        raise e
-    return box["value"]
-
-
-def _fetch_final(state, n_islands: int, pop: int):
-    """endTry device->host readback as ONE round trip: concatenate
-    slots/rooms/hcv/scv into a single (N*P, 2E+2) device array and fetch
-    it once (each separate fetch is a multi-second round trip on
-    tunneled devices — the same cost the polish loop's stacked stats
-    fetch avoids). Returns (slots (N,P,E), rooms (N,P,E), best-row hcv
-    (N,), best-row scv (N,)) as numpy."""
-    import jax.numpy as jnp
-    packed = _fetch(jnp.concatenate(
-        [state.slots, state.rooms,
-         state.hcv[:, None], state.scv[:, None]], axis=1))
-    E = (packed.shape[1] - 2) // 2
-    slots = packed[:, :E].reshape(n_islands, pop, E)
-    rooms = packed[:, E:2 * E].reshape(n_islands, pop, E)
-    hcv = packed[:, 2 * E].reshape(n_islands, pop)[:, 0]
-    scv = packed[:, 2 * E + 1].reshape(n_islands, pop)[:, 0]
-    return slots, rooms, hcv, scv
-
-
-def _fetch_state(state) -> ga.PopState:
-    """Host (numpy) snapshot of a PopState as ONE device round trip —
-    the checkpoint-path sibling of `_fetch_final` (each separate fetch
-    is a multi-second round trip on tunneled devices, VERDICT round-3
-    weak #3, and this fetch sits on the pipelined dispatch path):
-    concatenate slots/rooms/penalty/hcv/scv into a single
-    (N*P, 2E+3) int32 array, fetch once, slice apart."""
-    import jax.numpy as jnp
-    packed = _fetch(jnp.concatenate(
-        [state.slots, state.rooms, state.penalty[:, None],
-         state.hcv[:, None], state.scv[:, None]], axis=1))
-    E = (packed.shape[1] - 3) // 2
-    return ga.PopState(
-        slots=packed[:, :E], rooms=packed[:, E:2 * E],
-        penalty=packed[:, 2 * E], hcv=packed[:, 2 * E + 1],
-        scv=packed[:, 2 * E + 2])
+# The fetch machinery — the control-fence watchdog (`_fetch`), the
+# packed one-round-trip readbacks, and the resume-side rehydrate — is
+# the dispatch core's (runtime/dispatch_core.py): one sanctioned fence
+# surface for the run loop, the serve scheduler, and the fleet drive
+# loop, and the sync-helper set tt-analyze's taint rules key on.
+# Aliased under the established engine names (analysis sync_helpers
+# config, tests, and the recovery handler all reach them here).
+_reshard_state = dcore.reshard_state
+_fetch = dcore.fetch
+_fetch_final = dcore.fetch_final
+_fetch_state = dcore.fetch_state
 
 
 # --- the resumable run-chunk surface ---------------------------------
@@ -831,15 +586,16 @@ def _fetch_state(state) -> ga.PopState:
 
 def fetch_state(state) -> ga.PopState:
     """Public host-snapshot fetch: one packed device round trip (see
-    _fetch_state). The returned all-numpy PopState is the same tuple
-    checkpoint.save takes and reshard_state re-places."""
-    return _fetch_state(state)
+    dispatch_core.fetch_state). The returned all-numpy PopState is the
+    same tuple checkpoint.save takes and reshard_state re-places."""
+    return dcore.fetch_state(state)
 
 
 def reshard_state(state: ga.PopState, mesh) -> ga.PopState:
     """Public rehydrate: place a host (numpy) PopState back onto the
-    mesh as global island/lane-sharded arrays (see _reshard_state)."""
-    return _reshard_state(state, mesh)
+    mesh as global island/lane-sharded arrays (see
+    dispatch_core.reshard_state)."""
+    return dcore.reshard_state(state, mesh)
 
 
 def _setup(cfg: RunConfig):
@@ -912,8 +668,7 @@ def precompile(cfg: RunConfig) -> None:
     time (mpicxx does its compiling before the race too)."""
     if cfg.backend == "cpu":
         jax.config.update("jax_platforms", "cpu")
-    global _FETCH_TIMEOUT
-    _FETCH_TIMEOUT = cfg.fetch_timeout if cfg.fetch_timeout > 0 else None
+    dcore.set_fetch_timeout(cfg.fetch_timeout)
     maybe_init_distributed(cfg)
     (problem, pa, mesh, n_islands, gacfg, gacfg_post, fingerprint,
      spg_key) = _setup(cfg)
@@ -1114,8 +869,7 @@ def run(cfg: RunConfig, out=None) -> int:
     # TT_FAULTS env var) installed per run: invocation counters reset
     # here, so a plan's site indices are deterministic within one run
     faults.install(faults.active_spec(cfg.faults))
-    global _FETCH_TIMEOUT
-    _FETCH_TIMEOUT = cfg.fetch_timeout if cfg.fetch_timeout > 0 else None
+    dcore.set_fetch_timeout(cfg.fetch_timeout)
     if cfg.ls_time_limit != 99999.0:
         # -l is formally retired on this path: the fixed-shape batched LS
         # is bounded by candidate count (-m maxSteps), not wall clock —
@@ -1725,15 +1479,11 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
         # (it picks whether the next dispatch is a kick program), so it
         # serializes the loop exactly like a post config does; the
         # detector WITHOUT auto-kick is pure telemetry and pipelines
-        pipelined = bool(cfg.pipeline and gacfg_post is None
-                         and jax.process_count() == 1
-                         and cfg.trace_profile is None
-                         and not (quality and cfg.auto_kick_on_stall))
-        # what the ladder restores to when it steps back to level 0
-        # (maybe_relax): the run's CONFIGURED pipelining, not whatever
-        # a degraded stretch left behind
-        pipelined_cfg = pipelined
-        pending = None     # the one in-flight chunk (pipelined mode)
+        pipelined_cfg = bool(cfg.pipeline and gacfg_post is None
+                             and jax.process_count() == 1
+                             and cfg.trace_profile is None
+                             and not (quality
+                                      and cfg.auto_kick_on_stall))
         n_dispatch = 0
         last_fence = None  # wall time of the previous chunk's fence
         host_gap_s = 0.0   # device-idle time between chunks (obs gauges
@@ -1760,14 +1510,6 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
             tf0 = time.monotonic()
             trace = _fetch(trace_dev, tracer=tracer,
                            flow=flow or None)  # blocks on the dispatch
-            # quality observatory: the trailing quality block comes off
-            # the fetched leaf first (numpy slice; the fetch stayed one
-            # leaf), the event half keeps the ev_mode layout
-            trace, qrows = islands.split_quality(trace, quality)
-            if dyn_gens is not None and ev_mode == "full":
-                # compressed leaves carry their own validity (sentinel
-                # event rows); only the full trace needs the tail slice
-                trace = trace[:, :, :dyn_gens]
             td1 = time.monotonic()
             tracer.record("fetch", tf0, td1 - tf0, cat="engine",
                           gens=gens_run, flow=flow)
@@ -1786,7 +1528,7 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
             # bests up to one dispatch earlier than they occurred,
             # flattering time-to-feasible)
             t_start = (last_fence
-                       if pipelined and last_fence is not None
+                       if pipe.enabled and last_fence is not None
                        else td0)
             dt = td1 - t_start
             if last_fence is not None:
@@ -1857,8 +1599,17 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
             # along), so the floors skip exactly what they would have
             # skipped on the full trace — the record stream is identical
             # across modes (tests/test_obs.py pins it).
-            events, ev_counts, ev_moments = islands.trace_events(
-                trace, ev_mode)
+            # the shared telemetry decode (dispatch_core): quality
+            # split, dynamic-tail trim, event decode under the
+            # effective packing, and on-device event-capacity overflow
+            # surfacing — one implementation with the scheduler's park
+            # path
+            events, ev_moments, qrows, overflow_warned = \
+                dcore.decode_telemetry(
+                    trace, quality, trace_mode, metrics=mreg,
+                    overflow_counter="engine.trace_delta_overflow",
+                    overflow_warned=overflow_warned,
+                    dyn_gens=dyn_gens)
             total = gens_run
             for i in range(n_islands):
                 for g, h, s in events[i]:
@@ -1870,23 +1621,6 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
                         tg = ((t_start - t_try)
                               + (g + 1) / total * (td1 - t_start))
                         jsonl.log_entry(out, i, 0, rep, tg)
-            if ev_counts is not None:
-                # on-device event capacity overflow: the count says how
-                # many improvements happened, the event block holds at
-                # most TRACE_DELTAS_CAP — surface the dropped tail
-                # instead of silently under-reporting
-                dropped = int(sum(max(0, int(c) - len(e))
-                                  for c, e in zip(ev_counts, events)))
-                if dropped:
-                    mreg.counter("engine.trace_delta_overflow").inc(
-                        dropped)
-                    if not overflow_warned:
-                        overflow_warned = True
-                        print(f"warning: --trace-mode {trace_mode} "
-                              f"dropped {dropped} improvement event(s) "
-                              f"this dispatch (cap "
-                              f"{islands.TRACE_DELTAS_CAP}; raise "
-                              f"TT_TRACE_DELTAS_CAP)", file=sys.stderr)
             if ev_moments is not None:
                 # streamed on-device moments of the per-generation best
                 # (stats mode): aggregate across islands into gauges
@@ -2146,6 +1880,11 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
                               cat="engine", gens=gens_done, flow=ck_flow)
                 mreg.counter("engine.checkpoints").inc()
 
+        # the depth-2 pipeline discipline lives in the dispatch core;
+        # `enabled` is toggled by the degradation ladder below and in
+        # _process's t_start anchoring
+        pipe = dcore.DispatchPipeline(_process, enabled=pipelined_cfg)
+
         # ---- supervised region (in-run fault recovery) ----------------
         # Everything from here to the endTry fetch can die of a
         # transient device failure (an UNAVAILABLE dispatch kill, a hung
@@ -2175,24 +1914,23 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
                                   "serial" if sup.level == 1 else
                                   f"chunk-1/{2 ** (sup.level - 1)}"))
                         if sup.level < 1:
-                            pipelined = pipelined_cfg
-                    if pending is not None and sec_per_gen is None:
+                            pipe.enabled = pipelined_cfg
+                    if pipe.pending is not None and sec_per_gen is None:
                         # no cost estimate for the in-flight chunk (e.g.
                         # --no-precompile before the first warm measurement):
                         # enqueueing a SECOND unmeasured dispatch could overrun
                         # -t by two chunks where the serial loop risks one, so
                         # retire the in-flight chunk first — the loop runs
                         # serially until a measurable chunk seeds the estimate
-                        _process(pending)
-                        pending = None
+                        pipe.drain()
                     remaining_t = (cfg.time_limit - reserve
                                    - (time.monotonic() - t_try))
-                    if pending is not None and sec_per_gen is not None:
+                    if pipe.pending is not None and sec_per_gen is not None:
                         # an in-flight chunk consumes budget the clock has not
                         # charged yet: reserve its predicted cost before sizing
                         # the next dispatch (the pipelined analogue of the
                         # serial loop's between-dispatch clock check)
-                        remaining_t -= sec_per_gen * pending.gens_run
+                        remaining_t -= sec_per_gen * pipe.pending.gens_run
                     stop = remaining_t <= 0
                     if (sec_per_gen is not None
                             and sec_per_gen > DISPATCH_CAP_S):
@@ -2347,22 +2085,16 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
                                    None if getattr(runner, "last_compiled",
                                                    False)
                                    else getattr(runner, "last_cost", None))
-                    if pipelined:
-                        # retire the PREVIOUS chunk with this one already
-                        # running: its telemetry cost hides behind device
-                        # compute instead of serializing the dispatch stream
-                        if pending is not None:
-                            _process(pending, inflight=chunk)
-                        pending = chunk
-                    else:
-                        _process(chunk)
+                    # pipelined: retire the PREVIOUS chunk with this one
+                    # already running — its telemetry cost hides behind
+                    # device compute instead of serializing the dispatch
+                    # stream (dispatch_core.DispatchPipeline)
+                    pipe.submit(chunk)
 
-                if pending is not None:
-                    _process(pending)          # drain the in-flight chunk
-                    pending = None
+                pipe.drain()           # retire the in-flight chunk
                 _phase(out, cfg.trace, "gen-loop", trial,
                        time.monotonic() - t_loop, dispatches=n_dispatch,
-                       pipelined=pipelined)
+                       pipelined=pipe.enabled)
 
                 # BUDGET-TAIL POLISH: the generation loop stops when not even
                 # one more generation fits, stranding up to sec_per_gen seconds
@@ -2448,15 +2180,15 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
                         mode=("serial" if sup.level == 1 else
                               f"chunk-1/{2 ** (sup.level - 1)}"))
                 if sup.level >= 1:
-                    pipelined = False
+                    pipe.enabled = False
                 # teardown: the failed dispatch may have donated (and
                 # deleted) buffers, and whatever survives is in an
                 # unknown state — drop it all, rebuild the mesh, purge
                 # the compiled programs bound to it
                 islands.delete_state(state)
-                if pending is not None:
-                    islands.delete_state(pending.trace)
-                    pending = None
+                lost = pipe.abandon()
+                if lost is not None:
+                    islands.delete_state(lost.trace)
                 _purge_programs(mesh)
                 mesh = islands.make_mesh(min(n_islands,
                                              len(jax.devices())))

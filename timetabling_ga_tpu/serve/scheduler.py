@@ -20,10 +20,10 @@ dispatches:
             one-run-per-process engine cannot offer.
 
   PARKING   between quanta every job's population lives as a host
-            snapshot (engine.fetch_state — the same all-numpy tuple the
-            PR-3 fault supervisor rolls and checkpoint.save serializes)
-            and is re-placed with engine.reshard_state at its next
-            slice. Parked jobs cost zero device memory, so the backlog
+            snapshot (dispatch_core.fetch_state — the same all-numpy
+            tuple the PR-3 fault supervisor rolls and checkpoint.save
+            serializes) and is re-placed with
+            dispatch_core.reshard_state at its next slice. Parked jobs cost zero device memory, so the backlog
             can exceed the lanes by any factor. Fetch/re-place per
             quantum is the v1 cost model (exact, simple, and measured
             by bench.py extra.serve); keeping a resident group on
@@ -467,6 +467,7 @@ class Scheduler:
 
     def _cycle(self, jobs, pa_stack, seeds, chunks, gens, Ep,
                jids, flows, engine) -> None:
+        from timetabling_ga_tpu.runtime import dispatch_core as dcore
         lanes = self.cfg.lanes
         pop = self.cfg.pop_size
         # tt-meter: the fence instant the wait components are measured
@@ -481,8 +482,8 @@ class Scheduler:
             # parked host snapshots -> one stacked device placement
             host0 = _stack_states([j.snapshot for j in jobs], pop,
                                   lanes, Ep)
-            state = self._inflight = engine.reshard_state(host0,
-                                                          self.mesh)
+            state = self._inflight = dcore.reshard_state(host0,
+                                                         self.mesh)
         with self.tracer.span("quantum", cat="device", job=jids,
                               flow=flows, gens=int(gens.sum())):
             faults.maybe_fail("quantum")
@@ -493,7 +494,7 @@ class Scheduler:
             tq0 = self._now()
             state, trace = runner(pa_stack, seeds, chunks, state, gens)
             self._inflight = state
-            trace = np.asarray(trace)   # (lanes, quantum, 2) | packed
+            trace = dcore.fetch_leaf(trace)  # (lanes, quantum, 2)|packed
             tq_wall = self._now() - tq0
             # live roofline for the serve path, same gauges and same
             # formula as the engine's (obs/cost.py owns it): the lane
@@ -507,37 +508,18 @@ class Scheduler:
                     getattr(runner, "last_cost", None), tq_wall)
         with self.tracer.span("park", cat="serve", job=jids,
                               flow=flows):
-            host = engine.fetch_state(state)
-            # quality observatory: split the trailing quality block off
-            # the fetched leaf, then decode events with the effective
-            # packing (a full trace upgrades to deltas under quality —
-            # stream-identical, the established trace-mode contract)
-            trace, qrows = islands.split_quality(trace,
-                                                 self.cfg.quality)
-            # the telemetry decode shared with the engine: full traces
-            # list every executed generation, compressed leaves the
-            # pre-selected improvement events — the per-job emitted
-            # floor below makes the record stream identical either way
-            events, ev_counts, _ = islands.trace_events(
-                trace, islands.effective_trace_mode(
-                    self.cfg.trace_mode, self.cfg.quality))
-            if ev_counts is not None:
-                # same overflow surfacing as the engine: the count says
-                # how many improvements happened on device, the event
-                # block holds at most TRACE_DELTAS_CAP — never
-                # under-report silently
-                dropped = int(sum(max(0, int(c) - len(e))
-                                  for c, e in zip(ev_counts, events)))
-                if dropped:
-                    self._metrics.counter(
-                        "serve.trace_delta_overflow").inc(dropped)
-                    if not self._overflow_warned:
-                        self._overflow_warned = True
-                        print(f"warning: serve --trace-mode "
-                              f"{self.cfg.trace_mode} dropped {dropped}"
-                              f" improvement event(s) this dispatch "
-                              f"(cap {islands.TRACE_DELTAS_CAP}; raise "
-                              f"TT_TRACE_DELTAS_CAP)", file=sys.stderr)
+            host = dcore.fetch_state(state)
+            # the telemetry decode shared with the engine
+            # (dispatch_core.decode_telemetry): quality split, effective
+            # trace-mode packing and overflow surfacing all match the
+            # engine's retire path record-for-record
+            events, _, qrows, self._overflow_warned = \
+                dcore.decode_telemetry(
+                    trace, self.cfg.quality, self.cfg.trace_mode,
+                    metrics=self._metrics,
+                    overflow_counter="serve.trace_delta_overflow",
+                    overflow_warned=self._overflow_warned,
+                    warn_label="serve ")
             q_dec = None
             if qrows is not None:
                 # decode only the lanes that carried real jobs: filler
@@ -679,8 +661,9 @@ class Scheduler:
         --max-job-recoveries budget — fails THAT JOB alone with a
         terminal jobEntry; co-tenants, other buckets, the writer, and
         the service itself run on untouched."""
-        from timetabling_ga_tpu.runtime import engine, retry
-        engine.purge_programs(self.mesh)
+        from timetabling_ga_tpu.runtime import dispatch_core as dcore
+        from timetabling_ga_tpu.runtime import retry
+        dcore.purge_programs(self.mesh)
         transient = retry.is_transient(exc)
         now = self.tracer.now()
         for job in jobs:
@@ -727,6 +710,7 @@ class Scheduler:
         program). Each lane draws from key(its job's seed) alone, so
         batched init preserves the co-tenant-independence contract.
         Idle lanes replicate the first job's data and are discarded."""
+        from timetabling_ga_tpu.runtime import dispatch_core as dcore
         from timetabling_ga_tpu.runtime import engine
         lanes = self.cfg.lanes
         with self.tracer.span("init", cat="device",
@@ -741,7 +725,7 @@ class Scheduler:
             seeds = np.zeros((lanes,), np.int32)
             for lane, job in enumerate(jobs):
                 seeds[lane] = job.seed
-            host = engine.fetch_state(init(pa_stack, seeds))
+            host = dcore.fetch_state(init(pa_stack, seeds))
         for lane, job in enumerate(jobs):
             job.snapshot = _slice_state(host, lane, self.cfg.pop_size)
             self._ship_rec(job, jsonl.job_entry(
