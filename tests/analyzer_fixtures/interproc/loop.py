@@ -5,6 +5,7 @@ declared in the other module, which is exactly what the single-module
 rules (TT301/TT203) cannot see."""
 
 import jax
+import numpy as np
 
 from interproc import core
 
@@ -73,3 +74,30 @@ def blocking_control_loop(pa, steps):
         if not done:
             break
     return state
+
+
+def resident_fetch_loop(sched, steps):
+    """TT306: host fetches of resident-group state outside a park
+    fence — the direct store read, a name rooted in it, and a
+    conversion sink all flag; the configured fence_helpers bodies are
+    the only legal site for these bytes to move."""
+    rows = []
+    snap = None
+    for bkey in list(sched._resident):
+        entry = sched._resident[bkey]
+        snap = core.fetch(entry["state"])           # EXPECT TT306
+        rows.append(
+            np.asarray(sched._resident[bkey]["state"]))  # EXPECT TT306
+    return snap, rows
+
+
+def resident_dispatch_clean(sched, steps):
+    """CLEAN under TT306: the resident state feeds the dispatch, and
+    the park fetch reads the runner's OUTPUT — a rebind from a plain
+    call clears store-rootedness (the scheduler's _cycle idiom)."""
+    runner = core.cached_runner(None)
+    state = sched._resident["b"]["state"]
+    for i in range(steps):
+        state = runner(state, i)
+    host = core.fetch(state)
+    return host

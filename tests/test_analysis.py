@@ -108,11 +108,12 @@ def test_clean_fixture_has_zero_findings():
 
 
 def test_interproc_rules_fire_across_module_boundary():
-    """The whole-program rules (TT303/TT304/TT305) must localize each
-    seeded CROSS-MODULE violation — factory, donation and sanctioned
-    fetch all declared in interproc/core.py, broken in
+    """The whole-program rules (TT303/TT304/TT305/TT306) must localize
+    each seeded CROSS-MODULE violation — factory, donation and
+    sanctioned fetch all declared in interproc/core.py, broken in
     interproc/loop.py — to the exact file:line, and the clean core
-    module must stay silent."""
+    module (plus loop.py's clean resident-dispatch idiom) must stay
+    silent."""
     pkg = os.path.join(FIXTURES, "interproc")
     expected = set()
     for name in sorted(os.listdir(pkg)):
@@ -124,8 +125,9 @@ def test_interproc_rules_fire_across_module_boundary():
     got = {(f.rule, os.path.basename(f.path), f.line)
            for f in run_analysis([pkg], fixture_config())}
     assert got == expected
-    # all three whole-program rules exercised, nothing in core.py
-    assert {r for r, _, _ in got} == {"TT303", "TT304", "TT305"}
+    # all four whole-program rules exercised, nothing in core.py
+    assert {r for r, _, _ in got} == {"TT303", "TT304", "TT305",
+                                      "TT306"}
     assert all(name == "loop.py" for _, name, _ in got)
 
 

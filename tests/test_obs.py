@@ -865,9 +865,10 @@ def _serve_api_run(jobs=3, scrape=False, **cfg_kw):
     pull front BETWEEN dispatches — a live run, deterministically."""
     from timetabling_ga_tpu.problem import load_tim_file
     from timetabling_ga_tpu.serve.service import SolveService
-    cfg = ServeConfig(backend="cpu", lanes=2, quantum=10, pop_size=8,
-                      generations=20, obs=True, metrics_every=1,
-                      **cfg_kw)
+    kw = dict(backend="cpu", lanes=2, quantum=10, pop_size=8,
+              generations=20, obs=True, metrics_every=1)
+    kw.update(cfg_kw)
+    cfg = ServeConfig(**kw)
     out = io.StringIO()
     svc = SolveService(cfg, out=out)
     scrapes = []
@@ -960,8 +961,15 @@ def test_serve_run_under_scrape_faults_never_stalls():
     from timetabling_ga_tpu.runtime import faults
     faults.install("scrape:1:hang,scrape:2:die")
     try:
+        # quantum=5 -> 4 quanta for the 20-generation jobs, so a LIVE
+        # /metrics scrape lands after the two faulted ones. (At the
+        # default quantum the only post-fault scrape was /readyz,
+        # whose status is derived from process-global gauges — earlier
+        # modules in a full-suite run leave engine.degrade_level /
+        # fleet readiness set and it answers 503, which is correct
+        # readiness reporting but not this test's recovery signal.)
         recs, scrapes, svc = _serve_api_run(
-            jobs=2, scrape=True, obs_listen="127.0.0.1:0")
+            jobs=2, scrape=True, obs_listen="127.0.0.1:0", quantum=5)
     finally:
         faults.install(None)
     done = [r["jobEntry"]["job"] for r in recs

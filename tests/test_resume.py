@@ -166,6 +166,10 @@ def test_resumed_stream_identity():
     svc1.submit(_PA, job_id="r", seed=3, generations=20)
     svc1.step()
     svc1.step()
+    # the group went device-resident after its first park; exporting
+    # the CURRENT progress is a snapshot-shipping request, and the
+    # park fence for those is flush_resident (scheduler RESIDENCY)
+    svc1.scheduler.flush_resident("ship")
     ship = svc1.queue.get("r").ship
     wire = json.loads(json.dumps(ship.pack()))
     prefix = list(ship.records)

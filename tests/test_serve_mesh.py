@@ -1,0 +1,268 @@
+"""Multi-device serving (ISSUE 17): mesh sizing, lane padding, and
+device-resident job groups.
+
+The properties pinned here:
+
+  1. lane PADDING is shape-only: `islands.pad_lanes` rounds the
+     configured lane count up to a device multiple, the padded lanes
+     are zero-generation filler, and the job-packing CAPACITY stays
+     the configured `--lanes`;
+  2. mesh width is INVISIBLE in the record protocol: per-job streams
+     are strip_timing-identical between a 1-device mesh and the full
+     forced-8-device mesh (lane RNG streams are pure functions of
+     (seed, chunk, gen) — tests/conftest.py forces 8 host devices for
+     the whole suite);
+  3. RESIDENCY is a pure transport optimization: it cuts park/resume
+     bytes and scores hits, never changes a stream, and always falls
+     back to a host park on repack, fault, flush request, and preempt
+     drain — so every ship unit a handler serves is a real park-fence
+     unit.
+"""
+
+import io
+import json
+import time
+
+import pytest
+
+from timetabling_ga_tpu.obs.metrics import MetricsRegistry
+from timetabling_ga_tpu.parallel import islands
+from timetabling_ga_tpu.problem import dump_tim, random_instance
+from timetabling_ga_tpu.runtime import faults, jsonl
+from timetabling_ga_tpu.runtime.config import ServeConfig, parse_serve_args
+from timetabling_ga_tpu.serve.service import SolveService
+
+_SHAPE_A = dict(n_events=12, n_rooms=3, n_features=2, n_students=8,
+                attend_prob=0.2)
+_PA = random_instance(71, **_SHAPE_A)
+_PA2 = random_instance(73, **_SHAPE_A)
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+def _cfg(**kw):
+    kw.setdefault("backend", "cpu")
+    kw.setdefault("lanes", 2)
+    kw.setdefault("quantum", 5)
+    kw.setdefault("pop_size", 4)
+    kw.setdefault("max_steps", 8)
+    return ServeConfig(**kw)
+
+
+def _job_records(text, job_id):
+    out = []
+    for line in text.splitlines():
+        rec = json.loads(line)
+        body = rec[next(iter(rec))]
+        if isinstance(body, dict) and body.get("job") == str(job_id):
+            out.append(rec)
+    return out
+
+
+def _run(jobs, **cfg_kw):
+    """Run `jobs` to completion; return (svc, {id: strip_timing})."""
+    buf = io.StringIO()
+    svc = SolveService(_cfg(**cfg_kw), out=buf,
+                       registry=MetricsRegistry())
+    for jid, problem, seed, gens in jobs:
+        svc.submit(problem, job_id=jid, seed=seed, generations=gens)
+    svc.drive()
+    svc.close()
+    return svc, {jid: jsonl.strip_timing(_job_records(buf.getvalue(),
+                                                      jid))
+                 for jid, *_ in jobs}
+
+
+# ------------------------------------------------------- lane padding
+
+
+def test_pad_lanes_rounds_up_to_device_multiple():
+    mesh = islands.make_mesh(None)
+    n = mesh.devices.size
+    assert islands.pad_lanes(mesh, 1) == n
+    assert islands.pad_lanes(mesh, n) == n
+    assert islands.pad_lanes(mesh, n + 1) == 2 * n
+    # zero-lane degenerate input still yields a dispatchable width
+    assert islands.pad_lanes(mesh, 0) == n
+
+
+def test_scheduler_pads_width_but_not_capacity():
+    """lanes % devices != 0: the dispatch width pads up to a device
+    multiple, while job-packing capacity stays the configured count
+    (padding lanes are filler, not admission slots)."""
+    import jax
+
+    n_dev = jax.device_count()
+    svc = SolveService(_cfg(lanes=3, mesh_devices=0), out=io.StringIO(),
+                       registry=MetricsRegistry())
+    sch = svc.scheduler
+    assert sch.mesh.devices.size == n_dev
+    assert sch.lanes % n_dev == 0 and sch.lanes >= 3
+    assert sch.cfg.lanes == 3           # capacity unchanged
+    assert svc.registry.gauge("serve.mesh_devices").value == n_dev
+    assert svc.registry.gauge("serve.lanes").value == sch.lanes
+    svc.close()
+
+
+def test_mesh_wider_than_runnable_lanes():
+    """One job on the full mesh: every lane past the first is filler,
+    the job completes, and its stream matches the 1-device run."""
+    jobs = [("solo", _PA, 3, 15)]
+    svc1, base = _run(jobs, mesh_devices=1, resident=False)
+    svcN, wide = _run(jobs, mesh_devices=0, resident=False)
+    assert svcN.queue.get("solo").state == "done"
+    assert svcN.scheduler.lanes >= svcN.scheduler.mesh.devices.size
+    assert wide["solo"] == base["solo"]
+
+
+# --------------------------------------- stream identity across meshes
+
+
+def test_stream_identity_across_mesh_sizes():
+    """Per-job record streams are strip_timing-identical between the
+    1-device mesh and the full mesh, parked or resident — mesh width
+    and residency must never show in a record."""
+    jobs = [("ia", _PA, 3, 15), ("ib", _PA2, 4, 15)]
+    _, base = _run(jobs, mesh_devices=1, resident=False)
+    for kw in (dict(mesh_devices=0, resident=False),
+               dict(mesh_devices=0, resident=True),
+               dict(mesh_devices=1, resident=True)):
+        _, got = _run(jobs, **kw)
+        for jid, *_ in jobs:
+            assert got[jid] == base[jid], (jid, kw)
+
+
+# ------------------------------------------------------------ residency
+
+
+def test_residency_scores_hits_and_cuts_bytes():
+    """Same stream, resident on vs off (private registries): the
+    resident run scores hits and moves strictly fewer park/resume
+    bytes; the parked run never hits."""
+    jobs = [("ra", _PA, 3, 30), ("rb", _PA2, 4, 30)]
+    svc_off, base = _run(jobs, resident=False)
+    svc_on, got = _run(jobs, resident=True)
+
+    def bytes_moved(svc):
+        return (svc.registry.counter("serve.park_bytes").value
+                + svc.registry.counter("serve.resume_bytes").value)
+
+    assert svc_off.registry.counter("serve.resident_hits").value == 0
+    assert svc_on.registry.counter("serve.resident_hits").value > 0
+    assert bytes_moved(svc_on) < bytes_moved(svc_off)
+    for jid, *_ in jobs:
+        assert got[jid] == base[jid], jid
+
+
+def test_residency_invalidated_by_repack():
+    """A second job admitted into a resident group's bucket changes
+    the lane assignment: the group flushes (a full host park) before
+    the repacked quantum, and both streams stay identical to a
+    never-resident run."""
+    jobs = [("pa", _PA, 3, 30), ("pb", _PA2, 4, 30)]
+    _, base = _run(jobs, resident=False)
+
+    buf = io.StringIO()
+    svc = SolveService(_cfg(resident=True), out=buf,
+                       registry=MetricsRegistry())
+    svc.submit(_PA, job_id="pa", seed=3, generations=30)
+    svc.step()                           # q1: first park, ship built
+    svc.step()                           # q2: goes resident
+    assert len(svc.scheduler._resident) == 1
+    svc.submit(_PA2, job_id="pb", seed=4, generations=30)
+    svc.step()                           # repack: [pb, pa] != [pa]
+    assert svc.registry.counter("serve.resident_flushes").value >= 1
+    svc.drive()
+    svc.close()
+    for jid, *_ in jobs:
+        got = jsonl.strip_timing(_job_records(buf.getvalue(), jid))
+        assert got == base[jid], jid
+
+
+def test_residency_invalidated_by_fault():
+    """A transient fault on a RESIDENT quantum drops the device state
+    and rolls the cursors back to the last host fence: the job
+    recovers from its park snapshot and the stream is bit-identical
+    to an uninjected run."""
+    jobs = [("fa", _PA, 3, 30)]
+    _, base = _run(jobs, resident=False)
+
+    buf = io.StringIO()
+    svc = SolveService(_cfg(resident=True), out=buf,
+                       registry=MetricsRegistry())
+    # q1 parks (first ship), q2 goes resident, q3 faults mid-residency
+    faults.install("quantum:3:unavailable")
+    svc.submit(_PA, job_id="fa", seed=3, generations=30)
+    svc.drive()
+    faults.install(None)
+    svc.close()
+    assert svc.registry.counter("serve.job_recoveries").value >= 1
+    assert svc.queue.get("fa").state == "done"
+    assert len(svc.scheduler._resident) == 0
+    got = jsonl.strip_timing(_job_records(buf.getvalue(), "fa"))
+    assert got == base["fa"]
+
+
+def test_flush_request_and_preempt_flush_refresh_ship():
+    """request_flush (the ?snapshot=1 handler hook) parks the group at
+    the next fence with a fence-fresh ship unit; flush_resident (the
+    preempt-drain hook) does it immediately between quanta."""
+    svc = SolveService(_cfg(resident=True), out=io.StringIO(),
+                       registry=MetricsRegistry())
+    svc.submit(_PA, job_id="s", seed=3, generations=40)
+    svc.step()                           # q1: park, ship @ 5 gens
+    svc.step()                           # q2: resident, ship frozen
+    job = svc.queue.get("s")
+    assert len(svc.scheduler._resident) == 1
+    assert job.ship.gens_done == 5       # frozen at the host fence
+    # handler-style request: flag only, honored at the NEXT fence —
+    # the flush lands before q3 dispatches, so the ship re-syncs to
+    # the pre-q3 cursor (10) and the group may re-enter residency
+    svc.scheduler.request_flush()
+    svc.step()                           # fence flush (ship @ 10), q3
+    assert job.ship.gens_done == 10
+    assert job.gens_done == 15
+    assert len(svc.scheduler._resident) == 1   # resident again
+    # preempt-drain style: immediate flush between quanta
+    flushed = svc.scheduler.flush_resident("preempt")
+    assert flushed == 1
+    assert len(svc.scheduler._resident) == 0
+    assert job.ship.gens_done == job.gens_done == 15
+    svc.drive()
+    svc.close()
+    assert svc.queue.get("s").state == "done"
+
+
+def test_ship_hot_job_parks_every_fence():
+    """A job someone polls ?snapshot=1 on (ship_hot) keeps its group
+    parking at every fence — snapshot freshness beats residency."""
+    svc = SolveService(_cfg(resident=True), out=io.StringIO(),
+                       registry=MetricsRegistry())
+    svc.submit(_PA, job_id="h", seed=3, generations=40)
+    svc.step()
+    job = svc.queue.get("h")
+    job.ship_hot = True                  # what job_view sets
+    svc.step()
+    svc.step()
+    assert len(svc.scheduler._resident) == 0
+    assert job.ship.gens_done == job.gens_done == 15
+    svc.drive()
+    svc.close()
+
+
+# ---------------------------------------------------------------- flags
+
+
+def test_mesh_flags_parse_and_validate():
+    cfg = parse_serve_args(["--mesh-devices", "2", "--no-resident",
+                            "--backend", "cpu"])
+    assert cfg.mesh_devices == 2 and cfg.resident is False
+    cfg = parse_serve_args(["--backend", "cpu"])
+    assert cfg.mesh_devices == 0 and cfg.resident is True
+    with pytest.raises(SystemExit):
+        parse_serve_args(["--mesh-devices", "-1"])

@@ -427,6 +427,9 @@ def test_resume_meter_continuity():
                 tenant="acme")
     svc1.step()
     svc1.step()
+    # park the device-resident group so the exported wire carries the
+    # CURRENT usage cursor (snapshot-shipping requests flush first)
+    svc1.scheduler.flush_resident("ship")
     ship = svc1.queue.get("r").ship
     wire = json.loads(json.dumps(ship.pack()))
     svc1.close()

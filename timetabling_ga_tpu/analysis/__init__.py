@@ -31,6 +31,11 @@ Rules (see README "Static analysis & sanitizers"):
          fencing a later dispatch in the same loop iteration, and
          control flow steered through jax.block_until_ready instead
          of the sanctioned packed fetch
+  TT306  host fetch of device-RESIDENT group state outside a park
+         fence: a value rooted in a `resident_stores` attribute
+         (serve/scheduler.py `_resident`) reaching a fetch helper or
+         conversion sink anywhere but a `fence_helpers` flush body —
+         bytes moving without the snapshot/ship units re-syncing
   TT401  PRNG key reuse (two consumers, no split/fold_in between)
   TT402  loop-carried key reuse (one call site consuming the same key
          across `for` iterations without fold_in on the loop index)
@@ -139,6 +144,7 @@ def _rule_modules():
         "TT303": rules_interproc,
         "TT304": rules_interproc,
         "TT305": rules_interproc,
+        "TT306": rules_interproc,
         "TT401": rules_rng,
         "TT402": rules_rng,
         "TT501": rules_api,

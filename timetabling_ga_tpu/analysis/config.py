@@ -16,9 +16,9 @@ import os
 import re
 
 ALL_RULES = ("TT101", "TT102", "TT201", "TT202", "TT203", "TT301",
-             "TT302", "TT303", "TT304", "TT305", "TT401", "TT402",
-             "TT501", "TT502", "TT601", "TT602", "TT603", "TT604",
-             "TT605", "TT606", "TT607", "TT608")
+             "TT302", "TT303", "TT304", "TT305", "TT306", "TT401",
+             "TT402", "TT501", "TT502", "TT601", "TT602", "TT603",
+             "TT604", "TT605", "TT606", "TT607", "TT608")
 
 
 @dataclasses.dataclass
@@ -56,6 +56,17 @@ class AnalyzerConfig:
     taint_sinks: list[str] = dataclasses.field(
         default_factory=lambda: ["float", "int", "bool", "np.asarray",
                                  "np.array", "item", "tolist"])
+    # attribute names holding device-RESIDENT group state (TT306: a
+    # host fetch rooted in one of these stores may only happen inside
+    # a fence helper — serve/scheduler.py RESIDENCY)
+    resident_stores: list[str] = dataclasses.field(
+        default_factory=lambda: ["_resident"])
+    # park-fence helper function names whose bodies are the SANCTIONED
+    # host-fetch sites for resident-group state (exempt from TT306):
+    # the flush path, where snapshot/ship units re-sync
+    fence_helpers: list[str] = dataclasses.field(
+        default_factory=lambda: ["_flush_bucket", "_flush_job",
+                                 "flush_resident"])
     # report stale `# tt-analyze: ignore[...]` markers (CLI
     # --warn-unused-ignores sets this)
     warn_unused_ignores: bool = False

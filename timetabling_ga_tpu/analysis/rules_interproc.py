@@ -1,7 +1,7 @@
-"""TT303/TT304/TT305 — whole-program device-taint, donation, and fence
-discipline (the interprocedural upgrade of TT301/TT203).
+"""TT303/TT304/TT305/TT306 — whole-program device-taint, donation, and
+fence discipline (the interprocedural upgrade of TT301/TT203).
 
-All three rules run over `analysis/project.py`'s view of the scan set —
+The rules run over `analysis/project.py`'s view of the scan set —
 module graph, import resolution, per-function summaries — so a program
 built by a factory in one module is tracked into the module that calls
 it. They deliberately cover ONLY what the single-module rules cannot:
@@ -43,10 +43,26 @@ host read must precede the next dispatch, telemetry must not.
       sanctioned packed readback (`fetch`) that batches the round
       trip and feeds the watchdog.
 
+TT306 — host fetch of device-RESIDENT group state outside a park
+fence. The serving residency optimization (serve/scheduler.py
+RESIDENCY) keeps a stacked group's population on device between
+quanta, indexed by a store attribute named in `resident_stores`
+(default `_resident`). Any value rooted in that store — a direct
+subscript/`get` read, or a name assigned from one — reaching a host
+fetch (a `sync_helpers` call, or a `taint_sinks` conversion) in a
+dispatch module flags, UNLESS the enclosing function is a configured
+`fence_helpers` park-fence helper: fetching resident state anywhere
+else bypasses the flush state machine, so the bytes move without the
+snapshot/ship units re-syncing (a handler would then serve a unit
+that matches neither the cursors nor the device). A rebind from a
+plain call clears rootedness — `state, trace = runner(..., state, ...)`
+makes `state` the quantum's OUTPUT, whose park-path fetch is the
+legal fence.
+
 Scope notes: function bodies named in `sync_helpers` are exempt (they
-ARE the sanctioned sync points); nested closures are not scanned
-(the dispatch loops under audit live in module-level functions and
-methods).
+ARE the sanctioned sync points), as are `fence_helpers` bodies for
+TT306; nested closures are not scanned (the dispatch loops under
+audit live in module-level functions and methods).
 """
 
 from __future__ import annotations
@@ -60,6 +76,7 @@ from timetabling_ga_tpu.analysis.project import Project
 RULE_SYNC = "TT303"
 RULE_DONATE = "TT304"
 RULE_FENCE = "TT305"
+RULE_RESIDENT = "TT306"
 
 _METHOD_SINKS = {"item", "tolist"}
 _BLOCKING_WAIT = {"jax.block_until_ready", "block_until_ready"}
@@ -457,6 +474,121 @@ class _FenceChecker:
                             "the sanctioned packed fetch helper"))
 
 
+class _ResidentChecker:
+    """TT306: a host fetch rooted in a device-resident group store,
+    outside a park-fence helper. Linear statement walk, like
+    _TaintChecker, with its own (simpler) rootedness: store accesses
+    and names assigned from them, cleared by a rebind from any plain
+    call — a dispatch program's output is new state, and parking it
+    is the legal fence."""
+
+    def __init__(self, facts: _FuncFacts, path, findings):
+        self.facts = facts
+        self.path = path
+        self.findings = findings
+        cfg = facts.proj.config
+        self.stores = set(getattr(cfg, "resident_stores",
+                                  ["_resident"]))
+        (self._converts, self._dotted,
+         self._methods) = _sink_sets(cfg)
+        self.rooted: set[str] = set()
+
+    def _flag(self, node, what):
+        self.findings.append(Finding(
+            RULE_RESIDENT, self.path, node.lineno, node.col_offset,
+            f"host fetch of device-resident group state ({what}) "
+            f"outside a park-fence helper — resident population state "
+            f"may only reach the host inside a `fence_helpers` flush "
+            f"body, where the group's snapshot/ship units re-sync; "
+            f"fetch the dispatch OUTPUT at the park fence, or move "
+            f"this read into the flush path"))
+
+    def _store_access(self, node: ast.AST) -> bool:
+        return any(isinstance(sub, ast.Attribute)
+                   and sub.attr in self.stores
+                   for sub in ast.walk(node))
+
+    def _rooted_expr(self, node: ast.AST) -> bool:
+        """Store access, or a read of a rooted name. A Call with no
+        store access in it is NOT rooted (its output is a new value),
+        which is also what makes assignment from one a clearing
+        rebind."""
+        if self._store_access(node):
+            return True
+        if isinstance(node, ast.Call):
+            return False
+        return any(isinstance(sub, ast.Name)
+                   and isinstance(sub.ctx, ast.Load)
+                   and sub.id in self.rooted
+                   for sub in ast.walk(node))
+
+    def _check_sinks(self, node: ast.AST):
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            qn = qualname(sub.func)
+            args = list(sub.args) + [kw.value for kw in sub.keywords]
+            if self.facts.is_sanctioned(sub):
+                if any(self._rooted_expr(a) for a in args):
+                    self._flag(sub, f"`{qn}(...)`")
+            elif (isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in self._methods):
+                if self._rooted_expr(sub.func.value):
+                    self._flag(sub, f"`.{sub.func.attr}()`")
+            elif ((qn in self._converts
+                   or qual_matches(qn, self._dotted)) and sub.args):
+                if self._rooted_expr(sub.args[0]):
+                    self._flag(sub, f"`{qn}(...)`")
+
+    def _bind(self, targets, value):
+        rooted = self._rooted_expr(value)
+        for tgt in targets:
+            for name in target_names(tgt):
+                if rooted:
+                    self.rooted.add(name)
+                else:
+                    self.rooted.discard(name)
+
+    def run(self):
+        self._stmts(self.facts.fi.node.body)
+
+    def _stmts(self, stmts):
+        for st in stmts:
+            self._stmt(st)
+
+    def _stmt(self, st):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return
+        if isinstance(st, ast.Assign):
+            self._check_sinks(st.value)
+            self._bind(st.targets, st.value)
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign, ast.Expr,
+                             ast.Return, ast.Raise, ast.Assert)):
+            val = getattr(st, "value", None) or getattr(st, "test",
+                                                        None)
+            if val is not None:
+                self._check_sinks(val)
+        elif isinstance(st, (ast.If, ast.While)):
+            self._check_sinks(st.test)
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, ast.For):
+            self._check_sinks(st.iter)
+            self._stmts(st.body)
+            self._stmts(st.orelse)
+        elif isinstance(st, ast.With):
+            for item in st.items:
+                self._check_sinks(item.context_expr)
+            self._stmts(st.body)
+        elif isinstance(st, ast.Try):
+            self._stmts(st.body)
+            for h in st.handlers:
+                self._stmts(h.body)
+            self._stmts(st.orelse)
+            self._stmts(st.finalbody)
+
+
 def _analyze_project(proj: Project, ctx) -> dict[str, list[Finding]]:
     out: dict[str, list[Finding]] = {}
     rules = ctx.config.rules
@@ -473,6 +605,10 @@ def _analyze_project(proj: Project, ctx) -> dict[str, list[Finding]]:
                 _TaintChecker(facts, fi.module.rel, findings).run()
             if "TT305" in rules:
                 _FenceChecker(facts, fi.module.rel, findings).run()
+            if ("TT306" in rules
+                    and fi.name not in set(getattr(
+                        ctx.config, "fence_helpers", []))):
+                _ResidentChecker(facts, fi.module.rel, findings).run()
     return out
 
 

@@ -67,6 +67,7 @@ JAX_COMPAT_TABLE = {
             "block_until_ready",
             "make_array_from_callback", "process_count",
             "process_index", "clear_caches", "device_get",
+            "device_put",
             "config", "random", "tree", "tree_util", "sharding",
             "profiler", "distributed", "errors", "experimental"],
     "jax.numpy": ["*"],

@@ -314,6 +314,18 @@ class ReplicaApi:
             view["records"] = r.tail.tail(job_id)
             view["records_truncated"] = r.tail.truncated(job_id)
         if with_snapshot and job is not None:
+            # a device-RESIDENT job's ship unit is its last HOST
+            # fence's (older but consistent — serve/scheduler.py
+            # RESIDENCY); ask the drive loop to park every resident
+            # group at its next control fence so the next refresh
+            # ships current progress, and mark THIS job ship_hot so
+            # a polling gateway's resume cache stays within one
+            # quantum of the live cursor (its group keeps parking
+            # instead of re-entering residency between polls).
+            # Flag-only: this handler thread must never touch the
+            # device (TT605)
+            job.ship_hot = True
+            r.svc.scheduler.request_flush()
             # `?snapshot=1`: publish the job's latest park-fence ship
             # unit (serve/snapshot.py ShipUnit — one consistent
             # state+record-prefix pair the drive loop replaced
@@ -641,6 +653,11 @@ class Replica:
         self._preempting = True
         self._preempt_deadline = (time.monotonic()
                                   + self.cfg.preempt_grace)
+        # park every device-resident group FIRST (_handle runs on the
+        # drive loop, between quanta — a legal device fence): the ship
+        # units published below then reflect real progress, not the
+        # group's last pre-residency host fence
+        self.svc.scheduler.flush_resident("preempt")
         from timetabling_ga_tpu.serve.queue import JobState
         for job in list(self.svc.queue.active()):
             job.state = JobState.PREEMPTED

@@ -114,6 +114,17 @@ def make_mesh(n_islands: int = None, devices=None) -> Mesh:
     return Mesh(np.array(devices), (AXIS,))
 
 
+def pad_lanes(mesh: Mesh, n_lanes: int) -> int:
+    """Smallest lane count >= `n_lanes` that `local_islands` accepts on
+    `mesh` (a multiple of the device count). The serve scheduler sizes
+    its dispatch width with this: jobs fill the first `n_lanes` lanes
+    and the padding lanes run zero-generation filler whose
+    device-seconds the tt-meter split books as overhead
+    (serve/scheduler.py)."""
+    n_dev = mesh.devices.size
+    return ((max(1, n_lanes) + n_dev - 1) // n_dev) * n_dev
+
+
 def local_islands(mesh: Mesh, n_islands: int = None) -> int:
     """Islands per device. n_islands may EXCEED the device count (the
     analogue of running several MPI ranks per node — mpirun oversubscribes

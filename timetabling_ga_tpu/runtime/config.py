@@ -677,8 +677,33 @@ class ServeConfig:
     output: Optional[str] = None  # record stream; None = stdout
     backend: str = "tpu"
     lanes: int = 4                # job lanes per dispatch (stacked along
-    #                               the island axis; must be a multiple
-    #                               of the device count)
+    #                               the island axis). The scheduler pads
+    #                               the dispatch width UP to the next
+    #                               multiple of the mesh's device count
+    #                               (islands.local_islands requires
+    #                               `lanes % devices == 0`); padding
+    #                               lanes carry no job and their
+    #                               device-seconds are metered as
+    #                               overhead, not billed to tenants
+    mesh_devices: int = 0         # devices in the serving mesh
+    #                               (0 = every device the replica owns —
+    #                               jax.devices(); N = first N, the
+    #                               pre-mesh single-device behaviour at
+    #                               N=1). Part of the lane-runner
+    #                               compile-cache key
+    resident: bool = True         # device-resident job groups: while a
+    #                               stacked group's lane assignment is
+    #                               unchanged between consecutive
+    #                               quanta, keep its population state on
+    #                               device and fetch only the compressed
+    #                               trace leaf; park to host (the
+    #                               pre-residency per-quantum
+    #                               fetch_state/reshard_state cycle) on
+    #                               any repack, fault, finish, deadline,
+    #                               preempt-drain or snapshot-shipping
+    #                               request. --no-resident is the A/B's
+    #                               other leg: record streams are
+    #                               identical either way
     quantum: int = 25             # generations per time slice: small
     #                               enough that late arrivals wait at
     #                               most one dispatch, large enough to
@@ -799,6 +824,7 @@ _SERVE_FLAG_MAP = {
     "-o": ("output", str),
     "--backend": ("backend", str),
     "--lanes": ("lanes", int),
+    "--mesh-devices": ("mesh_devices", int),
     "--quantum": ("quantum", int),
     "--backlog": ("backlog", int),
     "--pop-size": ("pop_size", int),
@@ -831,7 +857,8 @@ _SERVE_FLAG_MAP = {
 _SERVE_BOOL_FLAGS = {"--obs": "obs", "--quality": "quality",
                      "--preempt-on-term": "preempt_on_term"}
 
-_SERVE_NEG_BOOL_FLAGS = {"--no-usage": "usage"}
+_SERVE_NEG_BOOL_FLAGS = {"--no-usage": "usage",
+                         "--no-resident": "resident"}
 
 
 def _serve_usage() -> str:
@@ -872,6 +899,9 @@ def parse_serve_args(argv) -> ServeConfig:
         raise SystemExit("--preempt-grace must be >= 0 seconds")
     if cfg.lanes < 1:
         raise SystemExit("--lanes must be >= 1")
+    if cfg.mesh_devices < 0:
+        raise SystemExit("--mesh-devices must be >= 0 "
+                         "(0 = every visible device)")
     if cfg.quantum < 1:
         raise SystemExit("--quantum must be >= 1 generation")
     if cfg.backlog < 1:

@@ -318,13 +318,33 @@ def reshard_state(state: ga.PopState, mesh) -> ga.PopState:
     island-sharded arrays. Multi-host safe: every process holds the full
     host copy (the checkpoint stores the global population), and
     `make_array_from_callback` slices out each process's local shards —
-    the resume-side counterpart of the checkpoint allgather."""
+    the resume-side counterpart of the checkpoint allgather.
+
+    Single-process (every serve replica) takes the `device_put` fast
+    path: one placement call per leaf instead of the per-shard callback
+    slicing, which matters now that the serve scheduler re-places a
+    whole stacked group at every non-resident resume fence."""
     from jax.sharding import NamedSharding
     sh = NamedSharding(mesh, jax.sharding.PartitionSpec(islands.AXIS))
+    if jax.process_count() == 1:
+        return jax.tree.map(
+            lambda x: jax.device_put(np.asarray(x), sh), state)
     return jax.tree.map(
         lambda x: jax.make_array_from_callback(
             np.asarray(x).shape, sh, lambda idx, x=x: np.asarray(x)[idx]),
         state)
+
+
+def state_nbytes(state) -> int:
+    """Bytes a PopState moves across the device<->host boundary when
+    parked (`fetch_state`) or re-placed (`reshard_state`) — the unit the
+    serve scheduler's `serve.park_bytes` / `serve.resume_bytes` counters
+    and the bench `extra.serve_mesh` leg account in. Works on host
+    (numpy) and device pytrees alike; None-safe."""
+    if state is None:
+        return 0
+    return int(sum(int(getattr(leaf, "nbytes", 0))
+                   for leaf in jax.tree.leaves(state)))
 
 
 # deadline (seconds) for the fetch watchdog below; set per run from

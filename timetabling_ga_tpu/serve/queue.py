@@ -132,6 +132,14 @@ class Job:
     #                                   resumed stream can no longer
     #                                   claim identity (surfaced on
     #                                   the wire, never silent)
+    ship_hot: bool = False            # someone polls ?snapshot=1 on
+    #                                   this job (a gateway keeping a
+    #                                   resume cache warm): its group
+    #                                   parks at EVERY fence so each
+    #                                   refresh ships current
+    #                                   progress — device residency
+    #                                   yields to snapshot freshness
+    #                                   (serve/scheduler.py RESIDENCY)
     # -- tt-meter (obs/usage.py; README "Usage metering") ----------------
     usage: dict = dataclasses.field(default_factory=dict)
     #                                   cumulative per-job meter,

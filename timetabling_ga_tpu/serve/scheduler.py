@@ -19,16 +19,37 @@ dispatches:
             waits at most one quantum for a lane — the fairness the
             one-run-per-process engine cannot offer.
 
-  PARKING   between quanta every job's population lives as a host
-            snapshot (dispatch_core.fetch_state — the same all-numpy
-            tuple the PR-3 fault supervisor rolls and checkpoint.save
-            serializes) and is re-placed with
-            dispatch_core.reshard_state at its next slice. Parked jobs cost zero device memory, so the backlog
-            can exceed the lanes by any factor. Fetch/re-place per
-            quantum is the v1 cost model (exact, simple, and measured
-            by bench.py extra.serve); keeping a resident group on
-            device between unchanged dispatches is the known follow-up
-            (ROADMAP).
+  PARKING   a job's population is durable as a host snapshot
+            (dispatch_core.fetch_state — the same all-numpy tuple the
+            PR-3 fault supervisor rolls and checkpoint.save serializes)
+            and is re-placed with dispatch_core.reshard_state at its
+            next slice. Parked jobs cost zero device memory, so the
+            backlog can exceed the lanes by any factor.
+
+  RESIDENCY while a stacked group's lane assignment is UNCHANGED
+            between consecutive quanta (same bucket, same jobs in the
+            same lane order) the park/resume round trip is skipped:
+            the population stays on device (`_resident`, one entry per
+            bucket) and only the compressed trace leaf is fetched.
+            The group falls back to a full host park — a "flush" — on
+            any repack (lane assignment changed), job finish, pending
+            deadline, fault, preempt drain, or snapshot-shipping
+            request, so the supervisor's rolling host snapshot and the
+            tt-resume wire format are always refreshable at park
+            fences. While resident, job.snapshot / job.ship freeze at
+            the last host fence (`_resident[bkey]["fence"]` records the
+            cursors they match); a handler serving ?snapshot=1 gets
+            that older-but-consistent unit, sets the flush-request
+            flag so the next fence re-syncs, and marks the job
+            ship_hot — a continuously-polled job's group parks every
+            fence from then on, keeping a gateway's resume cache
+            within one quantum of the live cursor. On a fault the group's
+            cursors roll BACK to the fence meta, so the requeued jobs
+            re-run from exactly the state their snapshots hold — the
+            emitted/best floors absorb the re-run's duplicate
+            improvement records and the stream stays bit-identical.
+            --no-resident restores the per-quantum park/resume cycle
+            (the A/B leg bench.py extra.serve_mesh measures).
 
   FAIRNESS  bucket groups are served round-robin, and within a group
             jobs are ordered by (priority desc, generations-served asc,
@@ -41,8 +62,18 @@ progress. A job's record stream is therefore bit-identical whether it
 ran alone or packed with any mix of co-tenants (pinned by
 tests/test_serve.py).
 
-Single-device, single-process by design in v1: multi-device lane
-sharding only needs `lanes % devices == 0` plumbing, and multi-host
+Mesh sizing: the scheduler serves every device the replica owns
+(`--mesh-devices 0`, the default, sizes the mesh from jax.devices();
+N pins the first N — N=1 is the pre-mesh single-device behaviour).
+`islands.local_islands` requires `lanes % devices == 0`, so the
+configured lane count is padded UP to the next device multiple
+(`islands.pad_lanes`); jobs fill the first `cfg.lanes` lanes and the
+padding lanes run zero-generation filler whose device-seconds the
+tt-meter split books as `overhead_device_seconds`, never billed to a
+tenant. Because a lane's RNG streams are pure functions of (seed,
+chunk, generation) — independent of lane position and device count —
+per-job record streams are bit-identical across mesh sizes (pinned by
+tests/test_serve_mesh.py). Single-PROCESS still by design: multi-host
 serving has the same agreement problem as the ROADMAP's multi-host
 recovery item.
 """
@@ -136,10 +167,28 @@ class Scheduler:
             event_floor=cfg.bucket_events, room_floor=cfg.bucket_rooms,
             feature_floor=cfg.bucket_features,
             student_floor=cfg.bucket_students, ratio=cfg.bucket_ratio)
-        # v1 serves from ONE device (module docstring); lane count is
-        # free because every lane of a single shard is a vmapped local
-        # island (islands.local_islands)
-        self.mesh = islands.make_mesh(1)
+        # mesh sizing (module docstring): every device the replica
+        # owns by default, the first N under --mesh-devices N. The
+        # dispatch width is the configured lane count padded UP to a
+        # device multiple (islands.local_islands requires
+        # `lanes % devices == 0`); jobs only ever fill the first
+        # cfg.lanes lanes — padding lanes are zero-generation filler
+        self.mesh = islands.make_mesh(cfg.mesh_devices or None)
+        self.lanes = islands.pad_lanes(self.mesh, cfg.lanes)
+        self._metrics.gauge("serve.mesh_devices").set(
+            self.mesh.devices.size)
+        self._metrics.gauge("serve.lanes").set(self.lanes)
+        # device-resident groups (module docstring RESIDENCY): bucket
+        # key -> {"jids": lane-ordered job-id tuple, "state": the
+        # group's device PopState, "fence": {job id: (chunks,
+        # gens_done) at the last HOST fence — what job.snapshot
+        # matches, and what a fault rolls back to}}
+        self._resident: dict = {}
+        # snapshot-shipping flush request (set from handler threads via
+        # request_flush; consumed at the next control fence)
+        self._flush_req = False
+        self._metrics.gauge_fn("serve.resident_groups",
+                               lambda: len(self._resident))
         self.gacfg = ga.GAConfig(
             pop_size=cfg.pop_size,
             ls_steps=max(1, cfg.max_steps // cfg.ls_candidates),
@@ -362,6 +411,10 @@ class Scheduler:
             if (job.deadline_s is not None
                     and now - job.submitted_t > job.deadline_s):
                 if job.snapshot is not None:
+                    # a resident job's snapshot is the LAST host
+                    # fence's — park its group first so the finalize
+                    # reads the generations it actually ran
+                    self._flush_job(job, "deadline")
                     self._finalize(job, deadline_hit=True)
                 else:
                     job.state = JobState.FAILED
@@ -384,17 +437,29 @@ class Scheduler:
         every step is the control fence: deadline reaping and
         backpressure shedding (both registry-visible) happen before the
         next pack."""
+        if self._flush_req:
+            # a handler thread asked for fresh shippable snapshots
+            # (?snapshot=1 on a resident job): park every resident
+            # group at THIS fence — the drive loop is the only thread
+            # allowed to touch the device (TT605)
+            self._flush_req = False
+            self.flush_resident("request")
         self._shed()
         self._reap()
         buckets = self._buckets_ready()
         if not buckets:
+            if self._resident:
+                # nothing runnable but device state lingers (the
+                # group's jobs all went terminal between fences):
+                # park/free it rather than hold device memory idle
+                self.flush_resident("idle")
             return False
         bkey = buckets[self._rr % len(buckets)]
         self._rr += 1
 
-        lanes = self.cfg.lanes
+        lanes = self.lanes
         pop = self.cfg.pop_size
-        jobs = self.queue.ready(bkey)[:lanes]
+        jobs = self.queue.ready(bkey)[:self.cfg.lanes]
         # every span of this dispatch cycle is tagged with the packed
         # jobs' ids AND their flow ids: one span advances many causal
         # chains, and `tt trace --job ID` follows exactly one of them
@@ -468,8 +533,10 @@ class Scheduler:
     def _cycle(self, jobs, pa_stack, seeds, chunks, gens, Ep,
                jids, flows, engine) -> None:
         from timetabling_ga_tpu.runtime import dispatch_core as dcore
-        lanes = self.cfg.lanes
+        lanes = self.lanes
         pop = self.cfg.pop_size
+        bkey = jobs[0].bucket
+        jid_t = tuple(jids)
         # tt-meter: the fence instant the wait components are measured
         # against — queue_seconds (admission -> first dispatch) and
         # park_seconds (previous fence -> this dispatch) are computed
@@ -477,13 +544,38 @@ class Scheduler:
         # faulted dispatch charges nothing twice (the lost wall lands
         # in the next successful fence's park component)
         t_fence0 = self._now()
+        entry = self._resident.get(bkey)
+        if entry is not None and (entry["jids"] != jid_t
+                                  or not self.cfg.resident
+                                  or self._flush_req):
+            # lane assignment changed (or a flush is pending): park
+            # the old group to host FIRST, so this pack resumes every
+            # member — kept or swapped out — from a fresh snapshot
+            self._flush_bucket(bkey, "repack")
+            entry = None
+        resident = entry is not None
         with self.tracer.span("resume", cat="serve", job=jids,
-                              flow=flows):
-            # parked host snapshots -> one stacked device placement
-            host0 = _stack_states([j.snapshot for j in jobs], pop,
-                                  lanes, Ep)
-            state = self._inflight = dcore.reshard_state(host0,
-                                                         self.mesh)
+                              flow=flows, resident=resident):
+            if resident:
+                # the group's population never left the device: the
+                # previous quantum's output is this dispatch's input
+                # (donation consumes it below, as always)
+                state = self._inflight = entry["state"]
+                self._metrics.counter("serve.resident_hits").inc()
+            else:
+                # parked host snapshots -> one stacked device placement
+                host0 = _stack_states([j.snapshot for j in jobs], pop,
+                                      lanes, Ep)
+                state = self._inflight = dcore.reshard_state(host0,
+                                                             self.mesh)
+                self._metrics.counter("serve.resume_bytes").inc(
+                    dcore.state_nbytes(host0))
+                # the host fence this device state matches: a fault in
+                # any LATER resident quantum rolls the group's cursors
+                # back here (the snapshots never advanced past it)
+                entry = {"jids": jid_t, "state": None,
+                         "fence": {j.id: (j.chunks, j.gens_done)
+                                   for j in jobs}}
         with self.tracer.span("quantum", cat="device", job=jids,
                               flow=flows, gens=int(gens.sum())):
             faults.maybe_fail("quantum")
@@ -496,6 +588,10 @@ class Scheduler:
             self._inflight = state
             trace = dcore.fetch_leaf(trace)  # (lanes, quantum, 2)|packed
             tq_wall = self._now() - tq0
+            # device wall under dispatch, for the serve_mesh bench
+            # leg's host-gap metric (wall - quantum_seconds = time the
+            # device sat idle between quanta)
+            self._metrics.counter("serve.quantum_seconds").inc(tq_wall)
             # live roofline for the serve path, same gauges and same
             # formula as the engine's (obs/cost.py owns it): the lane
             # program's compile-time counts over this quantum's wall.
@@ -506,9 +602,37 @@ class Scheduler:
                 from timetabling_ga_tpu.obs import cost as obs_cost
                 obs_cost.set_live_roofline(
                     getattr(runner, "last_cost", None), tq_wall)
+        # park to host unless the group can stay device-resident: a
+        # finishing job needs its final snapshot, a pending flush
+        # request needs fresh shippable units, --no-resident always
+        # parks, a ship_hot job (someone polls its ?snapshot=1 —
+        # freshness beats residency for it) parks every fence, and a
+        # job that has never shipped parks ONCE first — the fleet's
+        # rolling-snapshot invariant is that every active job has a
+        # shippable unit soon after its first quantum, so residency
+        # starts at the second consecutive quantum of an unchanged
+        # pack. The jid-tuple check at the NEXT resume catches
+        # repacks; everything else (fault, deadline, preempt) flushes
+        # through its own fence hook.
+        stay = (self.cfg.resident and not self._flush_req
+                and all(job.ship is not None and not job.ship_hot
+                        for job in jobs)
+                and not any(int(gens[lane]) >= job.remaining()
+                            for lane, job in enumerate(jobs)))
         with self.tracer.span("park", cat="serve", job=jids,
-                              flow=flows):
-            host = dcore.fetch_state(state)
+                              flow=flows, resident=stay):
+            if stay:
+                entry["state"] = state
+                self._resident[bkey] = entry
+                host = None
+            else:
+                # fetch BEFORE dropping the entry: if this fetch
+                # faults mid-resident-run, _recover_quantum still
+                # finds the fence meta to roll the cursors back to
+                host = dcore.fetch_state(state)
+                self._resident.pop(bkey, None)
+                self._metrics.counter("serve.park_bytes").inc(
+                    dcore.state_nbytes(host))
             # the telemetry decode shared with the engine
             # (dispatch_core.decode_telemetry): quality split, effective
             # trace-mode packing and overflow surfacing all match the
@@ -537,7 +661,8 @@ class Scheduler:
             deltas, meter_payload = self._meter_quantum(
                 jobs, gens, tq_wall, runner, t_fence0)
             for lane, job in enumerate(jobs):
-                job.snapshot = _slice_state(host, lane, pop)
+                if host is not None:
+                    job.snapshot = _slice_state(host, lane, pop)
                 job.chunks += 1
                 job.gens_done += int(gens[lane])
                 if deltas is not None:
@@ -565,12 +690,14 @@ class Scheduler:
                 job.state = JobState.PARKED
                 if job.remaining() == 0:
                     self._finalize(job)
-                else:
+                elif host is not None:
                     # the park fence IS the ship fence (README "Fleet
                     # resume"): replace the job's shippable unit
                     # wholesale — state + the exact record prefix
                     # through this fence, one consistent pair for any
-                    # handler thread serving ?snapshot=1
+                    # handler thread serving ?snapshot=1. A resident
+                    # job keeps its LAST host fence's unit (older but
+                    # consistent — request_flush re-syncs it)
                     job.ship = snapshot_mod.ShipUnit(
                         state=job.snapshot, bucket=job.bucket,
                         pop_size=pop, seed=job.seed,
@@ -611,6 +738,17 @@ class Scheduler:
         exec_s = max(0.0, float(tq_wall) - compile_s)
         cost = getattr(runner, "last_cost", None) or {}
         flops = float(cost.get("flops", 0.0))
+        # idle-lane device-seconds are OVERHEAD, not tenant work: a
+        # dispatch reserves the whole padded lane width (mesh sizing,
+        # module docstring) whether or not every lane carries a job —
+        # the idle fraction lands in the payload's
+        # `overhead_device_seconds`, and only the live-lane share is
+        # split across tenants (the conservation invariant checks
+        # lane shares against the ATTRIBUTED total)
+        idle = self.lanes - len(jobs)
+        overhead_raw = exec_s * idle / float(self.lanes) if idle else 0.0
+        exec_s -= overhead_raw
+        overhead_s, _ = usage_mod.split(overhead_raw, [1])
         # dyadic-grid splits (obs/usage.split): the recorded totals
         # are the QUANTIZED ones, so lane shares sum to them exactly —
         # seconds on the ~ns default grid, FLOPs on the integer grid
@@ -641,6 +779,7 @@ class Scheduler:
                    "bucket": list(jobs[0].bucket),
                    "gens": sum(gens_l),
                    "device_seconds": exec_s,
+                   "overhead_device_seconds": overhead_s,
                    "compile_seconds": compile_s,
                    "flops": flops,
                    "lanes": lanes_out}
@@ -653,17 +792,34 @@ class Scheduler:
         (_advance); here the compiled lane programs bound to the mesh
         are purged (they may reference dead buffers — the supervisor's
         rule), and each job of the faulted dispatch is REQUEUED from
-        its park snapshot: chunks/gens_done/emitted never advanced, so
-        the re-run repeats the identical chunk and the record stream
-        stays bit-identical to an uninjected run's (the per-job
-        emitted floor absorbs any records the faulted dispatch got out
-        before dying). A non-transient error — or a job over its
+        its park snapshot: chunks/gens_done match the snapshot (never
+        advanced on a parked run; rolled back to the fence meta on a
+        resident one — below), so the re-run repeats the identical
+        chunk(s) and the record stream stays bit-identical to an
+        uninjected run's (the per-job emitted floor absorbs any
+        records the faulted dispatch — or a rolled-back resident
+        quantum — got out before dying). A non-transient error — or a job over its
         --max-job-recoveries budget — fails THAT JOB alone with a
         terminal jobEntry; co-tenants, other buckets, the writer, and
         the service itself run on untouched."""
         from timetabling_ga_tpu.runtime import dispatch_core as dcore
         from timetabling_ga_tpu.runtime import retry
         dcore.purge_programs(self.mesh)
+        # a RESIDENT group's cursors ran ahead of its host snapshots;
+        # roll them back to the fence meta so the requeued jobs re-run
+        # from exactly the state their snapshots hold. The re-run's
+        # quanta repeat deterministically (RNG is pure in (seed, chunk,
+        # gen)) and the emitted/best floors absorb the duplicate
+        # improvement records, so the stream stays bit-identical. The
+        # re-run device time IS re-metered — the device really runs it
+        # twice, and tt-meter bills consumption, not progress.
+        entry = self._resident.pop(jobs[0].bucket, None)
+        if entry is not None:
+            islands.delete_state(entry["state"])
+            for job in jobs:
+                if (job.state not in JobState.TERMINAL
+                        and job.id in entry["fence"]):
+                    job.chunks, job.gens_done = entry["fence"][job.id]
         transient = retry.is_transient(exc)
         now = self.tracer.now()
         for job in jobs:
@@ -696,6 +852,101 @@ class Scheduler:
                 job.ship_records = []
                 self._metrics.counter("serve.jobs_failed").inc()
 
+    # -- residency flush fences ----------------------------------------
+
+    def _flush_bucket(self, bkey, reason: str) -> None:
+        """Park ONE device-resident group to host: fetch its stacked
+        state, refresh every live member's snapshot + shippable unit
+        (the park fence IS the ship fence), free the device buffers
+        and drop the entry. THE park fence for resident jobs — every
+        other fallback path (repack, deadline, preempt, shipping
+        request, idle teardown) funnels through here.
+
+        Fault-safe: if the fetch dies, the group rolls back to its
+        fence meta (cursors re-match the stale snapshots) before the
+        error propagates — a failed flush costs resident progress,
+        never consistency."""
+        from timetabling_ga_tpu.runtime import dispatch_core as dcore
+        entry = self._resident.pop(bkey, None)
+        if entry is None:
+            return
+        pop = self.cfg.pop_size
+        live = [(lane, self.queue.get(jid))
+                for lane, jid in enumerate(entry["jids"])
+                if jid in self.queue]
+        live = [(lane, job) for lane, job in live
+                if job.state not in JobState.TERMINAL]
+        if not live:
+            # every member went terminal (cancel/shed) since the last
+            # quantum: nothing to park, just free the device buffers
+            islands.delete_state(entry["state"])
+            return
+        try:
+            with self.tracer.span("flush", cat="serve",
+                                  bucket=list(bkey), reason=reason,
+                                  job=[job.id for _, job in live]):
+                host = dcore.fetch_state(entry["state"])
+        except BaseException:
+            islands.delete_state(entry["state"])
+            for _, job in live:
+                if job.id in entry["fence"]:
+                    job.chunks, job.gens_done = entry["fence"][job.id]
+            raise
+        islands.delete_state(entry["state"])
+        self._metrics.counter("serve.park_bytes").inc(
+            dcore.state_nbytes(host))
+        for lane, job in live:
+            job.snapshot = _slice_state(host, lane, pop)
+            job.ship = snapshot_mod.ShipUnit(
+                state=job.snapshot, bucket=job.bucket,
+                pop_size=pop, seed=job.seed,
+                gens_done=job.gens_done, chunks=job.chunks,
+                emitted=job.emitted, best=job.best,
+                records=list(job.ship_records),
+                truncated=job.ship_truncated,
+                usage=dict(job.usage))
+        self._metrics.counter("serve.resident_flushes").inc()
+
+    def _flush_job(self, job: Job, reason: str) -> None:
+        """Park the resident group CONTAINING `job`, if any. Absorbs a
+        flush fault (the job is rolled back and proceeds from its last
+        host fence — consistent, just less progressed)."""
+        entry = self._resident.get(job.bucket)
+        if entry is None or job.id not in entry["jids"]:
+            return
+        try:
+            self._flush_bucket(job.bucket, reason)
+        except Exception as e:
+            jsonl.fault_entry(self.out, "flush", "rollback", e, 0, 0,
+                              0, self.tracer.now(), job=job.id)
+
+    def flush_resident(self, reason: str) -> int:
+        """Park EVERY device-resident group to host now. Drive-loop
+        threads only (it touches the device) — handler threads use
+        request_flush instead. The fleet Replica calls this at its
+        preempt fence so every shipped snapshot reflects real
+        progress. A group whose flush faults rolls back to its last
+        host fence and is skipped (its jobs stay consistent). Returns
+        the number of groups parked."""
+        n = 0
+        for bkey in list(self._resident):
+            try:
+                self._flush_bucket(bkey, reason)
+                n += 1
+            except Exception as e:
+                jsonl.fault_entry(self.out, "flush", "rollback", e,
+                                  0, 0, 0, self.tracer.now())
+        return n
+
+    def request_flush(self) -> None:
+        """Ask the drive loop to park every resident group at its next
+        control fence. Safe from any thread (handlers serving
+        ?snapshot=1 on a resident job call this — they must never
+        touch the device themselves, the TT605 discipline); until the
+        fence runs, shipped units stay the last host fence's
+        older-but-consistent pair."""
+        self._flush_req = True
+
     def drive(self) -> None:
         """Run dispatches until no runnable job remains."""
         while self.step():
@@ -712,7 +963,7 @@ class Scheduler:
         Idle lanes replicate the first job's data and are discarded."""
         from timetabling_ga_tpu.runtime import dispatch_core as dcore
         from timetabling_ga_tpu.runtime import engine
-        lanes = self.cfg.lanes
+        lanes = self.lanes
         with self.tracer.span("init", cat="device",
                               job=[j.id for j in jobs],
                               flow=[j.flow for j in jobs]):

@@ -91,6 +91,21 @@ _METRICS = [
     ("scale downs", "scale", "scale_downs"),
     ("scale jobs lost", "scale", "jobs_lost"),
     ("scale identical", "scale", "records_identical"),
+    # extra.serve_mesh (ISSUE 17): dotted legs descend into the A/B's
+    # sub-objects — jobs_per_min appears in all three, so a flat
+    # lookup would pick whichever leg happened to survive truncation
+    ("devices", "serve_mesh.ndev_parked", "devices"),
+    ("mesh jobs/min 1dev", "serve_mesh.1dev_parked", "jobs_per_min"),
+    ("mesh jobs/min ndev", "serve_mesh.ndev_parked", "jobs_per_min"),
+    ("mesh jobs/min resident", "serve_mesh.ndev_resident",
+     "jobs_per_min"),
+    ("mesh gap ms/q resident", "serve_mesh.ndev_resident",
+     "host_gap_ms_per_quantum"),
+    ("mesh B/q resident", "serve_mesh.ndev_resident",
+     "park_resume_bytes_per_quantum"),
+    ("mesh B/q parked", "serve_mesh.ndev_parked",
+     "park_resume_bytes_per_quantum"),
+    ("scale compile attempts", "scale_2000ev", "compile_attempts"),
 ]
 
 _NUM = r"(-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)"
@@ -138,12 +153,17 @@ def _metric(doc, text: str, leg, key):
         m = re.search(rf'"{key}":\s*{_NUM}', text)
         return float(m.group(1)) if m else None
     if isinstance(doc, dict):
-        obj = doc.get(leg)
-        if obj is None and isinstance(doc.get("extra"), dict):
-            obj = doc["extra"].get(leg)
+        obj = doc
+        for part in leg.split("."):
+            nxt = obj.get(part) if isinstance(obj, dict) else None
+            if (nxt is None and isinstance(obj, dict)
+                    and isinstance(obj.get("extra"), dict)):
+                nxt = obj["extra"].get(part)
+            obj = nxt
         if isinstance(obj, dict) and key in obj:
             return float(obj[key])
-    m = re.search(rf'"{leg}":\s*\{{[^}}]*"{key}":\s*{_NUM}', text)
+    inner = leg.split(".")[-1]
+    m = re.search(rf'"{inner}":\s*\{{[^}}]*"{key}":\s*{_NUM}', text)
     return float(m.group(1)) if m else None
 
 
@@ -214,7 +234,52 @@ def report(root: str = REPO) -> str:
                 f"| r{_fmt(m['round'])} | {_fmt(m['n_devices'])} | "
                 f"{'yes' if m['ok'] else 'NO'} | {_fmt(m['best'])} | "
                 f"{_fmt(m['gens'])} |")
+    lines.append("")
+    lines.extend(_scaling_section(rounds, multis))
     return "\n".join(lines)
+
+
+def _scaling_section(rounds, multis) -> list:
+    """Throughput-vs-device-count curves, per round: the serve_mesh
+    A/B's jobs/min spread (1-device baseline vs the full mesh vs the
+    resident full mesh), the headline gens/s trajectory, and the
+    multichip dry-run's device widths — the at-a-glance answer to
+    'does adding devices still buy throughput'."""
+    lines = ["## scaling curves (throughput vs devices)"]
+    mesh_rows = []
+    for r in rounds:
+        m = r["metrics"]
+        one = m.get("mesh jobs/min 1dev")
+        nd = m.get("mesh jobs/min ndev")
+        if one is None and nd is None:
+            continue
+        dev = m.get("devices")
+        speedup = (f" ({nd / one:.2f}x)"
+                   if one and nd else "")
+        mesh_rows.append(
+            f"  r{_fmt(r['round'])}: 1dev {_fmt(one)} -> "
+            f"{_fmt(dev)}dev {_fmt(nd)} jobs/min{speedup}, resident "
+            f"{_fmt(m.get('mesh jobs/min resident'))}")
+    if mesh_rows:
+        lines.append("jobs/min (extra.serve_mesh, 1-device vs full "
+                     "mesh vs full mesh + resident groups):")
+        lines.extend(mesh_rows)
+    else:
+        lines.append("jobs/min: no extra.serve_mesh legs recorded yet")
+    gens = [(r["round"], r["metrics"].get("gens/s scan"))
+            for r in rounds
+            if r["metrics"].get("gens/s scan") is not None]
+    if gens:
+        lines.append("gens/s (generation_scan) per round: "
+                     + ", ".join(f"r{_fmt(n)} {_fmt(v)}"
+                                 for n, v in gens))
+    if multis:
+        lines.append("multichip dry-run (devices -> gens): "
+                     + ", ".join(
+                         f"r{_fmt(m['round'])} "
+                         f"{_fmt(m['n_devices'])}dev "
+                         f"gens={_fmt(m['gens'])}" for m in multis))
+    return lines
 
 
 def metrics_report(path: str) -> str:

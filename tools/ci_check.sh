@@ -9,10 +9,10 @@
 #
 # Steps (each failure is fatal):
 #   1. tt-analyze --strict --warn-unused-ignores over timetabling_ga_tpu/
-#      — the JAX-aware static rules, 22 of them including the
-#      whole-program device-taint/donation/fence pass
-#      (TT303/TT304/TT305), plus stale-suppression detection (TT901;
-#      README "Static analysis & sanitizers")
+#      — the JAX-aware static rules, 23 of them including the
+#      whole-program device-taint/donation/fence/residency pass
+#      (TT303/TT304/TT305/TT306), plus stale-suppression detection
+#      (TT901; README "Static analysis & sanitizers")
 #   2. python -m compileall — syntax across every tree we ship
 #   3. the tier-1 pytest command from ROADMAP.md
 set -u -o pipefail
