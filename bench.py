@@ -685,6 +685,102 @@ def measure_pipeline(problem, pop: int = 1024, gens: int = 40) -> dict:
     return out
 
 
+def measure_accord(problem, pop: int = 256, gens: int = 30) -> dict:
+    """extra.accord leg (ISSUE 18, tt-accord): what the control side
+    channel costs when nothing is wrong.
+
+    Two measurements. (1) Single-process engine A/B, channel on (the
+    inert solo loopback every default run now carries) vs off
+    (--no-accord): wall-clock pair plus the records-identical
+    assertion — the channel must be free AND invisible when there is
+    no peer. (2) The protocol microbench: a 2-view LoopbackChannel
+    group runs the real agreement code (`agree` process-0-wins fences
+    and `guard_collective` rendezvous, second view on a thread), giving
+    ms/fence for the agreement machinery itself — the per-fence
+    overhead a multi-host run pays on the HOST path, off the device."""
+    import dataclasses
+    import io
+    import json as _json
+    import tempfile
+    import threading
+
+    from timetabling_ga_tpu.problem import dump_tim
+    from timetabling_ga_tpu.runtime import control_channel as cc
+    from timetabling_ga_tpu.runtime import engine, jsonl
+    from timetabling_ga_tpu.runtime.config import RunConfig
+
+    with tempfile.NamedTemporaryFile("w", suffix=".tim",
+                                     delete=False) as f:
+        f.write(dump_tim(problem))
+        tim = f.name
+    try:
+        base = RunConfig(input=tim, seed=1234, pop_size=pop, islands=1,
+                         generations=gens, migration_period=5,
+                         epochs_per_dispatch=1, ls_mode="sweep",
+                         ls_sweeps=1, init_sweeps=0,
+                         time_limit=100000.0, auto_tune=False,
+                         trace=True)
+        engine.precompile(base)
+
+        def leg(accord):
+            cfg = dataclasses.replace(base, accord=accord)
+            buf = io.StringIO()
+            t0 = time.perf_counter()
+            best = engine.run(cfg, out=buf)
+            wall = time.perf_counter() - t0
+            lines = [_json.loads(x) for x in
+                     buf.getvalue().splitlines()]
+            return {"best": best, "wall_s": wall,
+                    "recs": jsonl.strip_timing(lines)}
+
+        on = leg(True)
+        off = leg(False)
+    finally:
+        os.unlink(tim)
+
+    fences = 300
+    ch0, ch1 = cc.LoopbackChannel.group(2)
+    try:
+        def follower():
+            for _ in range(fences):
+                ch1.agree("b", None)
+            for _ in range(fences):
+                ch1.guard_collective()
+        t = threading.Thread(target=follower, daemon=True)
+        t.start()
+        t0 = time.perf_counter()
+        for _ in range(fences):
+            ch0.agree("b", [1, 2, 3])
+        agree_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(fences):
+            ch0.guard_collective()
+        guard_s = time.perf_counter() - t0
+        t.join(60)
+    finally:
+        ch0.close()
+        ch1.close()
+
+    out = {
+        "pop": pop, "gens": gens,
+        "wall_s_accord_on": round(on["wall_s"], 3),
+        "wall_s_accord_off": round(off["wall_s"], 3),
+        "best_on": on["best"], "best_off": off["best"],
+        "records_identical": on["recs"] == off["recs"],
+        "fences": fences,
+        "agree_ms_per_fence": round(agree_s / fences * 1e3, 4),
+        "guard_ms_per_fence": round(guard_s / fences * 1e3, 4),
+    }
+    print(f"# accord A/B (pop {pop}, {gens} gens): wall "
+          f"{out['wall_s_accord_on']}s on vs "
+          f"{out['wall_s_accord_off']}s off; records identical="
+          f"{out['records_identical']}; loopback 2-view agreement "
+          f"{out['agree_ms_per_fence']} ms/agree, "
+          f"{out['guard_ms_per_fence']} ms/guard "
+          f"({fences} fences)", file=sys.stderr)
+    return out
+
+
 def measure_ls_shootout(problem) -> dict:
     """VERDICT item 2: systematic sweep vs K-random local search, equal
     wall clock, same start population. Reports mean penalty reached —
@@ -2103,6 +2199,7 @@ def main(argv=None) -> None:
             ("kernel_cost",
              lambda: measure_kernel_cost(problem, tpu)),
             ("pipeline", lambda: measure_pipeline(problem)),
+            ("accord", lambda: measure_accord(problem)),
             ("obs", lambda: measure_obs(problem)),
             ("quality", lambda: measure_quality(problem)),
             ("flight", lambda: measure_flight(problem)),

@@ -1,0 +1,36 @@
+"""TT307 fixture: device collectives on the recovery/agreement path.
+
+Not imported or executed — parsed by tests/test_analysis.py, which
+opts this file into `accord-modules`. The tt-accord contract
+(runtime/control_channel.py): after a fault the collective program is
+poisoned on at least one process, so agreement/recovery code must be
+pure host-side — a collective here hangs at the rendezvous the
+faulted peer never reaches.
+"""
+import json
+
+from jax.experimental import multihost_utils          # EXPECT TT307
+
+
+def agree_fallback(vals):
+    # 'just reuse the broadcast' — THE bug class: the broadcast IS
+    # the collective program that died
+    return multihost_utils.broadcast_one_to_all(vals)  # EXPECT TT307
+
+
+def collect_verdicts(local):
+    import jax.numpy as jnp
+    from jax import lax
+    # a collective reduction to merge verdicts: same hang
+    votes = lax.psum(jnp.asarray(local), "i")          # EXPECT TT307
+    return votes
+
+
+def gather_states(state):
+    return multihost_utils.process_allgather(state)    # EXPECT TT307
+
+
+def merge_locally(verdicts):
+    # OK: host-side deterministic merge — what the channel does
+    ordered = sorted(verdicts, key=lambda v: v["proc"])
+    return json.loads(json.dumps(ordered[0]))

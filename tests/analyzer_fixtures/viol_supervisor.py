@@ -1,0 +1,30 @@
+"""TT307 fixture: collectives inside a *Supervisor recovery policy.
+
+Not imported or executed — parsed by tests/test_analysis.py. This
+file is NOT in accord-modules: only the `*Supervisor` class-body
+scope may fire here, so the free function's collective below is a
+deliberate negative (the healthy program path is allowed to be
+collective — it is the program).
+"""
+
+
+class DriveSupervisor:
+    def classify(self, exc):
+        return "dispatch"
+
+    def agree_on_fault(self, states):
+        from jax.experimental import multihost_utils
+        # recovery consensus over the poisoned program: hangs
+        return multihost_utils.process_allgather(states)  # EXPECT TT307
+
+    def snapshot(self, state):
+        from jax import lax
+        penalty = lax.pmin(state.penalty, "i")            # EXPECT TT307
+        self.snap = (state, penalty)
+
+
+def healthy_migration(pop):
+    from jax import lax
+    # OK: a collective on the healthy program path, outside any
+    # Supervisor body and outside accord-modules
+    return lax.ppermute(pop, "i", [(0, 1), (1, 0)])

@@ -1,0 +1,448 @@
+"""tt-accord (ISSUE 18): the multi-host control side channel.
+
+The LoopbackChannel fault matrix runs the FULL agreement protocol —
+process-0-wins fences, pre-collective guards, fault-recovery consensus,
+heartbeat expiry, disagreeing-verdict merges — as N channel views over
+one in-process store on single-process CPU, so every recovery-agreement
+path is tier-1. The slow 2-process subprocess e2e then kills a real
+peer mid-run (`dispatch@1:2:die`) and pins the acceptance: the survivor
+classifies PeerLost within --peer-timeout instead of hanging at the
+dead peer's collective, aborts with a final durable checkpoint, and a
+resumed rerun's stream matches an uninjected run's modulo timing/fault
+records. Single-process, the channel is inert: record streams are
+identical with accord on or off (modulo timing, like every A/B).
+"""
+
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from timetabling_ga_tpu.problem import dump_tim, random_instance
+from timetabling_ga_tpu.runtime import control_channel as cc
+from timetabling_ga_tpu.runtime import faults, jsonl, retry
+from timetabling_ga_tpu.runtime.config import RunConfig
+
+# ----------------------------------------------------------- verdict merge
+
+
+def test_merge_verdicts_lowest_pid_real_site_wins():
+    agreed = cc.merge_verdicts([
+        {"proc": 1, "site": "dispatch", "action": "recover", "gens": 10},
+        {"proc": 0, "site": "accord", "action": "recover", "gens": 10},
+    ])
+    # the flag-observer (site 'accord') defers to the process that saw
+    # the real error, regardless of pid order
+    assert agreed["site"] == "dispatch" and agreed["decider"] == 1
+    assert agreed["agreed"] is True and agreed["procs"] == [0, 1]
+
+
+def test_merge_verdicts_abort_wins():
+    agreed = cc.merge_verdicts([
+        {"proc": 0, "site": "dispatch", "action": "recover", "gens": 5},
+        {"proc": 1, "site": "fetch", "action": "abort", "gens": 5},
+    ])
+    # a budget-exhausted (or lost) process must never be outvoted into
+    # a retry its state cannot survive
+    assert agreed["action"] == "abort" and agreed["decider"] == 1
+    # two real recover sites, no abort: lowest pid decides
+    agreed = cc.merge_verdicts([
+        {"proc": 1, "site": "fetch", "action": "recover", "gens": 5},
+        {"proc": 0, "site": "dispatch", "action": "recover", "gens": 5},
+    ])
+    assert agreed["site"] == "dispatch" and agreed["decider"] == 0
+    with pytest.raises(ValueError):
+        cc.merge_verdicts([])
+
+
+# --------------------------------------------------------- solo / registry
+
+
+def test_solo_channel_is_inert():
+    ch = cc.LoopbackChannel.solo()
+    try:
+        assert ch._hb_thread is None          # no heartbeat thread
+        assert ch.agree("s", [3, 7]) == [3, 7]
+        ch.guard_collective()                 # no-op, returns
+        agreed = ch.agree_on_fault(
+            {"site": "dispatch", "action": "recover", "gens": 10})
+        assert agreed["site"] == "dispatch" and agreed["decider"] == 0
+    finally:
+        ch.close()
+
+
+def test_open_channel_gates():
+    # --no-accord: no channel at all
+    assert cc.open_channel(accord=False) is None
+    # single-process: the inert solo loopback
+    ch = cc.open_channel(accord=True)
+    try:
+        assert isinstance(ch, cc.LoopbackChannel) and ch.nproc == 1
+    finally:
+        ch.close()
+    # the registry round-trip dispatch_core.fetch guards through
+    assert cc.active() is None
+    try:
+        assert cc.install(ch) is ch and cc.active() is ch
+    finally:
+        cc.install(None)
+    assert cc.active() is None
+
+
+# ------------------------------------------------- the loopback fault matrix
+
+
+def _join(threads, results, timeout=30.0):
+    """Join worker threads and re-raise the first captured failure."""
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "accord protocol thread hung"
+    for r in results:
+        if isinstance(r, BaseException):
+            raise r
+
+
+def _spawn(fn, *args):
+    """Run fn(*args) on a thread, capturing result or exception."""
+    box = [None]
+
+    def run():
+        try:
+            box[0] = fn(*args)
+        except BaseException as e:        # noqa: BLE001 — re-raised
+            box[0] = e
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, box
+
+
+def test_agree_is_process0_wins():
+    ch0, ch1 = cc.LoopbackChannel.group(2)
+    try:
+        t, box = _spawn(ch1.agree, "s", [0, 0])     # p1's local values
+        assert ch0.agree("s", [5, 9]) == [5, 9]     # p0 never blocks
+        _join([t], [])
+        assert box[0] == [5, 9]                     # p1 adopted p0's
+        # the per-tag fence counter advances: a second fence is fresh
+        t, box = _spawn(ch1.agree, "s", None)
+        assert ch0.agree("s", [1]) == [1]
+        _join([t], [])
+        assert box[0] == [1]
+    finally:
+        ch0.close(), ch1.close()
+
+
+def test_guard_collective_rendezvous():
+    ch0, ch1 = cc.LoopbackChannel.group(2)
+    try:
+        t, box = _spawn(ch1.guard_collective)
+        ch0.guard_collective()
+        _join([t], box)
+    finally:
+        ch0.close(), ch1.close()
+
+
+def test_one_sees_fault_peer_joins_agreement():
+    """The asymmetric case: p1 is healthy and waiting at a collective
+    guard when p0 faults. The fault flag converts p1's wait into
+    AccordPeerFault (transient), p1 joins the agreement as a deferring
+    observer (site 'accord'), and both adopt p0's verdict — then the
+    bumped epoch lets post-recovery fences run fresh."""
+    ch0, ch1 = cc.LoopbackChannel.group(2)
+    try:
+        guard_box = [None]
+
+        def p1_side():
+            try:
+                ch1.guard_collective()
+            except cc.AccordPeerFault as e:
+                guard_box[0] = e
+                return ch1.agree_on_fault(
+                    {"site": "accord", "action": "recover", "gens": 10})
+            raise AssertionError("guard passed with a faulted peer")
+
+        t1, box1 = _spawn(p1_side)
+        time.sleep(0.1)                 # let p1 reach the guard wait
+        agreed0 = ch0.agree_on_fault(
+            {"site": "dispatch", "action": "recover", "gens": 10})
+        _join([t1], box1)
+        assert isinstance(guard_box[0], cc.AccordPeerFault)
+        assert retry.is_transient(guard_box[0])
+        # identical agreement on both processes: p0's real site won
+        assert agreed0 == box1[0]
+        assert agreed0["site"] == "dispatch"
+        assert agreed0["action"] == "recover" and agreed0["decider"] == 0
+        # epoch bumped in lockstep — replayed fences use fresh keys
+        assert ch0.epoch == 1 and ch1.epoch == 1
+        t1, box1 = _spawn(ch1.guard_collective)
+        ch0.guard_collective()
+        _join([t1], box1)
+    finally:
+        ch0.close(), ch1.close()
+
+
+def test_both_see_fault_disagreeing_verdicts_merge_to_one():
+    """Both processes fault in the same window with DIFFERENT local
+    verdicts (different sites): both enter agreement concurrently, the
+    flag double-write is benign, and the merge is identical on both —
+    lowest-pid real site."""
+    ch0, ch1 = cc.LoopbackChannel.group(2)
+    try:
+        t1, box1 = _spawn(
+            ch1.agree_on_fault,
+            {"site": "fetch", "action": "recover", "gens": 10})
+        agreed0 = ch0.agree_on_fault(
+            {"site": "dispatch", "action": "recover", "gens": 10})
+        _join([t1], box1)
+        assert agreed0 == box1[0]
+        assert agreed0["site"] == "dispatch" and agreed0["decider"] == 0
+    finally:
+        ch0.close(), ch1.close()
+
+
+def test_abort_verdict_wins_agreement():
+    """A budget-exhausted process's abort outvotes the peer's recover:
+    both adopt the clean abort (the engine then writes the final
+    durable checkpoint and re-raises — never a hang)."""
+    ch0, ch1 = cc.LoopbackChannel.group(2)
+    try:
+        t1, box1 = _spawn(
+            ch1.agree_on_fault,
+            {"site": "dispatch", "action": "abort", "gens": 10})
+        agreed0 = ch0.agree_on_fault(
+            {"site": "dispatch", "action": "recover", "gens": 10})
+        _join([t1], box1)
+        assert agreed0 == box1[0]
+        assert agreed0["action"] == "abort" and agreed0["decider"] == 1
+    finally:
+        ch0.close(), ch1.close()
+
+
+def test_dead_peer_heartbeat_expiry_at_guard():
+    """The liveness conversion: a peer whose heartbeat went silent past
+    --peer-timeout raises PeerLost at the guard (NOT transient — the
+    process is gone) instead of waiting forever at the collective the
+    peer will never join."""
+    ch0, ch1 = cc.LoopbackChannel.group(2, peer_timeout=0.5)
+    try:
+        ch1.kill()                      # p1's process "dies"
+        t0 = time.monotonic()
+        with pytest.raises(cc.PeerLost) as ei:
+            ch0.guard_collective()
+        wall = time.monotonic() - t0
+        assert ei.value.proc == 1 and ei.value.silence_s > 0.5
+        assert wall < 10.0              # bounded, not a hang
+        assert not retry.is_transient(ei.value)
+    finally:
+        ch0.close(), ch1.close()
+
+
+def test_peer_lost_mid_agreement_is_an_abort_vote():
+    """A peer that dies DURING fault agreement contributes a
+    synthesized abort verdict instead of raising — its death IS a
+    vote, and abort wins the merge."""
+    ch0, ch1 = cc.LoopbackChannel.group(2, peer_timeout=0.5)
+    try:
+        ch1.kill()
+        agreed = ch0.agree_on_fault(
+            {"site": "dispatch", "action": "recover", "gens": 10})
+        assert agreed["action"] == "abort" and agreed["decider"] == 1
+        assert agreed.get("lost") is True and agreed["site"] == "accord"
+    finally:
+        ch0.close(), ch1.close()
+
+
+def test_peer_timeout_zero_waits_forever():
+    """--peer-timeout 0 disables liveness classification: the guard
+    keeps waiting (here until the peer actually arrives)."""
+    ch0, ch1 = cc.LoopbackChannel.group(2, peer_timeout=0.0)
+    try:
+        ch1.kill()                      # silence alone must not expire
+        t0, box0 = _spawn(ch0.guard_collective)
+        time.sleep(0.8)
+        assert t0.is_alive()            # still waiting, not PeerLost
+        ch1.guard_collective()          # late arrival completes it
+        _join([t0], box0)
+    finally:
+        ch0.close(), ch1.close()
+
+
+# ---------------------------------------------------- fault-plan @proc scope
+
+
+def test_fault_plan_process_scoping():
+    """`site@proc` entries parse away on every other process, and
+    UNSCOPED entries apply to process 0 only under a multi-process
+    launch — one shared TT_FAULTS value, per-process stable indices."""
+    spec = "dispatch@1:2:die,dispatch@0:1:hang,fetch:1:error"
+    try:
+        faults.set_process(1, 2)
+        plan = faults.FaultPlan.parse(spec)
+        assert plan.pop_action("dispatch") is None      # @0: not ours
+        assert plan.pop_action("dispatch") == "die"     # @1 entry
+        assert plan.pop_action("fetch") is None         # unscoped -> p0
+        faults.set_process(0, 2)
+        plan = faults.FaultPlan.parse(spec)
+        assert plan.pop_action("dispatch") == "hang"    # @0 entry
+        assert plan.pop_action("dispatch") is None      # @1: not ours
+        assert plan.pop_action("fetch") == "error"      # unscoped = p0
+        # single-process (the default): @0 is equivalent to unscoped
+        faults.set_process(0, 1)
+        plan = faults.FaultPlan.parse("dispatch@0:1:die,fetch:1:hang")
+        assert plan.pop_action("dispatch") == "die"
+        assert plan.pop_action("fetch") == "hang"
+        with pytest.raises(faults.FaultPlanError):
+            faults.FaultPlan.parse("dispatch@x:1:die")
+        with pytest.raises(faults.FaultPlanError):
+            faults.FaultPlan.parse("dispatch@-1:1:die")
+    finally:
+        faults.set_process(0, 1)
+
+
+# ------------------------------------------- single-process A/B (channel off)
+
+
+@pytest.fixture(scope="module")
+def tim_file(tmp_path_factory):
+    problem = random_instance(55, n_events=15, n_rooms=5, n_features=2,
+                              n_students=10, attend_prob=0.1)
+    path = tmp_path_factory.mktemp("accord") / "tiny.tim"
+    path.write_text(dump_tim(problem))
+    return str(path)
+
+
+def _go(tim_file, **kw):
+    from timetabling_ga_tpu.runtime import engine
+    buf = io.StringIO()
+    cfg = RunConfig(input=tim_file, seed=3, pop_size=8, islands=1,
+                    generations=30, migration_period=10, max_steps=8,
+                    time_limit=300, backend="cpu", auto_tune=False,
+                    trace=True, **kw)
+    best = engine.run(cfg, out=buf)
+    return best, [json.loads(x) for x in buf.getvalue().splitlines()]
+
+
+def test_single_process_stream_identical_accord_on_off(tim_file):
+    """ISSUE 18 acceptance: single-process record streams are identical
+    with the channel on (the inert solo loopback) or off (--no-accord)
+    — the channel adds fields only under a real multi-host agreement."""
+    best_on, on = _go(tim_file)                    # accord defaults True
+    best_off, off = _go(tim_file, accord=False)
+    assert best_on == best_off
+    assert jsonl.strip_timing(on) == jsonl.strip_timing(off)
+    # and recovery through the solo channel stays free of accord fields
+    best_f, lines = _go(tim_file, faults="dispatch:2:unavailable")
+    fe = [x["faultEntry"] for x in lines if "faultEntry" in x]
+    assert [e["action"] for e in fe] == ["recover"]
+    assert "agreed" not in fe[0] and "proc" not in fe[0]
+    assert best_f == best_on
+    assert jsonl.strip_timing(lines) == jsonl.strip_timing(on)
+
+
+# ------------------------------------------------------ 2-process kill e2e
+
+
+@pytest.mark.slow
+def test_two_process_peer_death_agreed_abort_and_resume(tim_file,
+                                                        tmp_path):
+    """The acceptance e2e: a REAL 2-process jax.distributed run where
+    `dispatch@1:2:die` kills process 1 mid-run. The survivor must NOT
+    hang at the dead peer's next collective: its channel guard
+    classifies PeerLost within --peer-timeout, emits the abort
+    faultEntry (lostProc=1), leaves a final durable checkpoint from
+    the last agreed fence, and exits. A fresh 2-process rerun resuming
+    that checkpoint then matches an uninjected run's stream modulo
+    timing/fault records."""
+    import socket
+    import subprocess
+    import sys as _sys
+
+    def run_pair(outfile, ckfile, tt_faults=None, resume=False):
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+
+        def proc(pid):
+            env = dict(
+                os.environ, JAX_PLATFORMS="cpu",
+                XLA_FLAGS="--xla_force_host_platform_device_count=4")
+            env.pop("TT_FAULTS", None)
+            if tt_faults:
+                env["TT_FAULTS"] = tt_faults   # ONE shared value: the
+                #                                @proc scope picks who
+            args = [_sys.executable, "-m", "timetabling_ga_tpu.cli",
+                    "-i", tim_file, "-s", "9", "--backend", "cpu",
+                    "--coordinator", f"localhost:{port}",
+                    "--num-processes", "2", "--process-id", str(pid),
+                    "--pop-size", "4", "--generations", "20",
+                    "--migration-period", "5", "--no-auto-tune",
+                    "--ls-mode", "sweep", "--ls-sweeps", "1",
+                    "-m", "8", "-t", "600", "--no-precompile",
+                    "--peer-timeout", "8",
+                    "--checkpoint", ckfile, "--checkpoint-every", "1"]
+            if resume:
+                args += ["--resume"]
+            if pid == 0:
+                args += ["-o", outfile]
+            return subprocess.Popen(args, env=env,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE, text=True)
+
+        p0, p1 = proc(0), proc(1)
+        out0, err0 = p0.communicate(timeout=600)   # bounded = no hang
+        out1, err1 = p1.communicate(timeout=120)
+        return (p0.returncode, err0), (p1.returncode, err1)
+
+    clean_out = str(tmp_path / "clean.jsonl")
+    fault_out = str(tmp_path / "fault.jsonl")
+    resume_out = str(tmp_path / "resume.jsonl")
+    clean_ck = str(tmp_path / "clean.npz")
+    fault_ck = str(tmp_path / "fault.npz")
+
+    # 1) uninjected baseline
+    (rc0, err0), (rc1, err1) = run_pair(clean_out, clean_ck)
+    assert rc0 == 0, err0[-3000:]
+    assert rc1 == 0, err1[-3000:]
+
+    # 2) kill process 1's second dispatch: the survivor classifies
+    #    PeerLost at its next channel guard and aborts — both
+    #    processes EXIT (communicate() returning at all is the no-hang
+    #    assertion), neither cleanly
+    (rc0, err0), (rc1, err1) = run_pair(fault_out, fault_ck,
+                                        tt_faults="dispatch@1:2:die")
+    assert rc1 != 0                      # the injected SystemExit
+    assert rc0 != 0 and "lost contact with process 1" in err0, \
+        err0[-3000:]
+    lines = [json.loads(x) for x in open(fault_out)]
+    fe = [x["faultEntry"] for x in lines if "faultEntry" in x]
+    assert fe and fe[-1]["site"] == "accord"
+    assert fe[-1]["action"] == "abort" and fe[-1]["lostProc"] == 1
+    assert fe[-1]["agreed"] is False and fe[-1]["proc"] == 0
+    # the final durable checkpoint from the last agreed fence (gen 5:
+    # process 1 died entering chunk 2 of 5-generation chunks)
+    with np.load(fault_ck, allow_pickle=False) as z:
+        assert int(z["generation"]) == 5
+        assert z["slots"].shape[0] == 8 * 4     # GLOBAL population
+
+    # 3) rerun resuming the abort checkpoint: completes, and the
+    #    stream's protocol core (solutions + runEntries) matches the
+    #    uninjected run's modulo timing — the determinism contract
+    #    across the death. logEntry improvement floors reset per
+    #    incarnation by design (a resumed run re-announces its current
+    #    best), so the cross-incarnation comparison is over the
+    #    solution/runEntry records.
+    (rc0, err0), (rc1, err1) = run_pair(resume_out, fault_ck,
+                                        resume=True)
+    assert rc0 == 0, err0[-3000:]
+    assert rc1 == 0, err1[-3000:]
+
+    def core(path):
+        recs = [json.loads(x) for x in open(path)]
+        return jsonl.strip_timing(
+            [r for r in recs if "solution" in r or "runEntry" in r])
+
+    assert core(resume_out) == core(clean_out)

@@ -53,6 +53,7 @@ def fixture_config() -> AnalyzerConfig:
     cfg.fleet_modules = list(cfg.fleet_modules) + ["viol_fleet.py",
                                                    "viol_gw_api.py",
                                                    "viol_scale.py"]
+    cfg.accord_modules = list(cfg.accord_modules) + ["viol_accord.py"]
     return cfg
 
 
@@ -93,6 +94,11 @@ def analyze_fixture(fixture: str):
     #                        metering clocks (tt-meter)
     "viol_scale.py",       # TT608 fleet actuator calls on handler
     #                        paths / dispatcher-tick bodies (tt-scale)
+    "viol_accord.py",      # TT307 collectives / multihost_utils in
+    #                        accord modules (tt-accord side channel)
+    "viol_supervisor.py",  # TT307 collectives inside *Supervisor
+    #                        recovery-policy bodies (with the healthy-
+    #                        path collective as a negative)
 ])
 def test_rule_fires_at_expected_lines(fixture):
     """Each rule family fires exactly at the marked (rule, line) pairs —

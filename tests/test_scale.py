@@ -216,6 +216,47 @@ def test_choose_victim_warmth_and_order():
     assert victim is None and skipped == []
 
 
+def test_choose_victim_prefers_device_cold_replica():
+    """Residency-aware retirement: a replica with zero resident
+    groups retires for free, so it is preferred over one whose
+    retire would flush parked device state — even when the warm
+    one has fewer in-flight jobs."""
+    reps = {"r0": {"inflight": 0, "idle": True,
+                   "resident_groups": 3.0,
+                   "resident_bytes": 4096.0},
+            "r1": {"inflight": 1, "idle": True,
+                   "resident_groups": 0.0,
+                   "resident_bytes": 0.0}}
+    assert choose_victim(reps, {}) == ("r1", [])
+    # all warm: fewest resident bytes wins (smallest flush)
+    reps = {"r0": {"inflight": 0, "idle": True,
+                   "resident_groups": 2.0,
+                   "resident_bytes": 8192.0},
+            "r1": {"inflight": 0, "idle": True,
+                   "resident_groups": 5.0,
+                   "resident_bytes": 1024.0}}
+    assert choose_victim(reps, {}) == ("r1", [])
+    # never-scraped residency (None) is warm-unknown, never preferred
+    # over a known-cold replica...
+    reps = {"r0": {"inflight": 0, "idle": True},
+            "r1": {"inflight": 2, "idle": True,
+                   "resident_groups": 0.0, "resident_bytes": 0.0}}
+    assert choose_victim(reps, {}) == ("r1", [])
+    # ...and with no residency data anywhere the legacy order holds
+    # exactly (fewest in-flight, then name)
+    reps = {"r0": {"inflight": 2, "idle": True},
+            "r1": {"inflight": 0, "idle": True}}
+    assert choose_victim(reps, {}) == ("r1", [])
+    # the warmth guard still outranks residency preference
+    reps = {"r0": {"inflight": 0, "idle": True,
+                   "resident_groups": 0.0, "resident_bytes": 0.0},
+            "r1": {"inflight": 0, "idle": True,
+                   "resident_groups": 7.0,
+                   "resident_bytes": 2.0 ** 20}}
+    victim, skipped = choose_victim(reps, {"r0": [[1]]})
+    assert victim == "r1" and skipped == ["r0"]
+
+
 # ------------------------------------------------------- policy evaluation
 
 

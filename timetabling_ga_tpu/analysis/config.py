@@ -16,9 +16,9 @@ import os
 import re
 
 ALL_RULES = ("TT101", "TT102", "TT201", "TT202", "TT203", "TT301",
-             "TT302", "TT303", "TT304", "TT305", "TT306", "TT401",
-             "TT402", "TT501", "TT502", "TT601", "TT602", "TT603",
-             "TT604", "TT605", "TT606", "TT607", "TT608")
+             "TT302", "TT303", "TT304", "TT305", "TT306", "TT307",
+             "TT401", "TT402", "TT501", "TT502", "TT601", "TT602",
+             "TT603", "TT604", "TT605", "TT606", "TT607", "TT608")
 
 
 @dataclasses.dataclass
@@ -56,6 +56,13 @@ class AnalyzerConfig:
     taint_sinks: list[str] = dataclasses.field(
         default_factory=lambda: ["float", "int", "bool", "np.asarray",
                                  "np.array", "item", "tolist"])
+    # files (path suffix match) forming the tt-accord control side
+    # channel: TT307 bans device collectives and multihost_utils.*
+    # there wholesale (recovery/agreement code must never ride the
+    # possibly-poisoned collective program), alongside the
+    # *Supervisor-class scope the rule applies everywhere
+    accord_modules: list[str] = dataclasses.field(
+        default_factory=lambda: ["runtime/control_channel.py"])
     # attribute names holding device-RESIDENT group state (TT306: a
     # host fetch rooted in one of these stores may only happen inside
     # a fence helper — serve/scheduler.py RESIDENCY)

@@ -1,0 +1,125 @@
+"""TT307 — device collectives banned on the recovery/agreement path.
+
+The tt-accord contract (runtime/control_channel.py): after a fault,
+the collective program is poisoned on at least one process, so any
+code that decides WHAT to do about the fault — the control side
+channel itself, and the Supervisor's recovery policy — must be pure
+host-side. A device collective (`lax.psum`/`ppermute`/`all_gather`
+family) or any `multihost_utils.*` call (`broadcast_one_to_all`,
+`process_allgather` — sugar over the same collectives) on that path
+recreates the exact hang the channel exists to prevent: the faulted
+or dead peer never reaches the rendezvous.
+
+Two scopes:
+
+  - ACCORD MODULES (`accord-modules` in pyproject, path suffix match —
+    runtime/control_channel.py): the whole file is the side channel;
+    importing `multihost_utils` there is already a finding, not just
+    calling it.
+  - `*Supervisor` CLASS BODIES in any analyzed file: the recovery
+    policy surface (classify / agree_on_fault / snapshot / the
+    ladder). dispatch_core.Supervisor is the instance; the rule keys
+    on the class-name suffix so ports and test doubles inherit the
+    discipline.
+
+The run loop's HEALTHY-path collectives (dispatch_core.fetch's
+allgather, guarded through the channel) are out of scope — they are
+the program, not the recovery decision about the program.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from timetabling_ga_tpu.analysis.core import (
+    Finding, qual_matches, qualname)
+
+RULE = "TT307"
+
+# the jax collective family: launching any of these requires every
+# process to arrive — the rendezvous a faulted peer never reaches
+_COLLECTIVE_CALLEES = {
+    "lax.psum", "lax.pmean", "lax.pmax", "lax.pmin", "lax.ppermute",
+    "lax.pshuffle", "lax.all_gather", "lax.all_to_all",
+    "lax.pbroadcast", "lax.psum_scatter",
+    "psum", "pmean", "pmax", "pmin", "ppermute", "all_gather",
+    "all_to_all", "pbroadcast", "psum_scatter",
+}
+
+# multihost_utils sugar over the same collectives
+_MULTIHOST_CALLEES = {
+    "broadcast_one_to_all", "process_allgather", "sync_global_devices",
+}
+
+
+def _accord_module(path: str, ctx) -> bool:
+    rel = path.replace("\\", "/")
+    modules = getattr(ctx.config, "accord_modules",
+                      ["runtime/control_channel.py"])
+    return any(m in rel for m in modules)
+
+
+def _violation(node: ast.Call) -> str | None:
+    """The banned callee's display name, or None."""
+    qn = qualname(node.func)
+    if qn is not None and "multihost_utils" in qn.split("."):
+        return qn
+    if qual_matches(qn, _MULTIHOST_CALLEES):
+        return qn
+    if qual_matches(qn, _COLLECTIVE_CALLEES):
+        return qn
+    return None
+
+
+def _check_body(root: ast.AST, path: str, where: str,
+                findings: list) -> None:
+    for node in ast.walk(root):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _violation(node)
+        if name is not None:
+            findings.append(Finding(
+                RULE, path, node.lineno, node.col_offset,
+                f"device collective `{name}(...)` on the "
+                f"recovery/agreement path ({where}) — after a fault "
+                f"the collective program is poisoned on at least one "
+                f"process, so a collective here hangs at the "
+                f"rendezvous the faulted peer never reaches; recovery "
+                f"must ride the host-side control channel "
+                f"(runtime/control_channel.py, TT307)"))
+
+
+def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
+    findings: list[Finding] = []
+    if _accord_module(path, ctx):
+        # the whole file is the side channel: even IMPORTING the
+        # collective sugar there signals the discipline is breaking
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                names = [a.name for a in node.names]
+                if (node.module and "multihost_utils" in node.module) \
+                        or "multihost_utils" in names:
+                    findings.append(Finding(
+                        RULE, path, node.lineno, node.col_offset,
+                        "`multihost_utils` imported inside an accord "
+                        "module — the control side channel must stay "
+                        "host-side; device-collective sugar has no "
+                        "business here (TT307)"))
+            elif isinstance(node, ast.Import):
+                if any("multihost_utils" in a.name for a in node.names):
+                    findings.append(Finding(
+                        RULE, path, node.lineno, node.col_offset,
+                        "`multihost_utils` imported inside an accord "
+                        "module — the control side channel must stay "
+                        "host-side; device-collective sugar has no "
+                        "business here (TT307)"))
+        _check_body(tree, path, "accord module", findings)
+        return findings
+    # everywhere else: only *Supervisor class bodies (the recovery
+    # policy surface) are audited
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.ClassDef)
+                and node.name.endswith("Supervisor")):
+            _check_body(node, path,
+                        f"`{node.name}` recovery policy", findings)
+    return findings

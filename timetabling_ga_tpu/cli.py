@@ -154,7 +154,23 @@ def main(argv=None) -> int:
     # compiling inside -t.
     if cfg.precompile:
         precompile(cfg)
-    run(cfg)
+    from timetabling_ga_tpu.runtime import control_channel
+    try:
+        run(cfg)
+    except control_channel.PeerLost as e:
+        # A peer process died mid-run. The abort faultEntry and the
+        # final checkpoint are already durable (engine's PeerLost
+        # path flushes before re-raising); what remains CANNOT be
+        # done cleanly: the dead peer's collective never completes,
+        # so the XLA execution thread is parked forever and
+        # jax.distributed's atexit shutdown barrier would wait on
+        # the missing process indefinitely. Skip interpreter
+        # teardown entirely — a hard exit is the only exit.
+        import os as _os
+        print(f"tt: aborting run: {e}", file=sys.stderr)
+        sys.stderr.flush()
+        sys.stdout.flush()
+        _os._exit(70)   # EX_SOFTWARE: abnormal, deliberate
     return 0
 
 

@@ -49,6 +49,22 @@ def shard_map(f, **kwargs):
     return _shard_map_impl(f, **kwargs)
 
 
+def coordination_client():
+    """The jax.distributed coordination-service client for this
+    process, or None when no coordinator is live (single-process) or
+    the installed JAX keeps it elsewhere. tt-accord
+    (runtime/control_channel.py) builds its KV-store backend on this;
+    the client's home is a private module (`jax._src.distributed`) on
+    every version we support, so it is resolved HERE behind the
+    guarded-import idiom instead of being declared pinned API."""
+    try:
+        from jax._src import distributed
+    except ImportError:
+        return None
+    return getattr(getattr(distributed, "global_state", None),
+                   "client", None)
+
+
 # The declared JAX API surface (analysis rules TT501 + TT502). Keys are
 # module paths; values are the symbol names reachable from that module —
 # by `from <module> import <name>` (TT501) OR by attribute access

@@ -113,16 +113,29 @@ def choose_victim(replicas: dict, protected: dict) -> tuple:
     name -> the hot buckets it is the ONLY warm home of.
 
     Candidates must be individually idle (the mean-backlog guard the
-    caller evaluated); preference is fewest in-flight jobs, then name
-    (deterministic). Pins deliberately do NOT drive the order: warmth
-    protection is the correctness layer, and a cold bucket's re-warm
-    after its idle home retires is a bounded warm-up cost, not a lost
-    job. Returns (victim_name_or_None, [names the warmth guard
-    skipped]) — a skipped name means the policy WANTED that replica
-    and the guard refused (`fleet.scale.blocked_warmth`)."""
+    caller evaluated); preference is DEVICE-COLD first — a replica
+    whose scraped `serve.resident_groups` gauge reads zero retires
+    for free, while a warm one flushes every resident group back
+    through the park path — then fewest `serve.resident_bytes` among
+    the warm (smallest flush), then fewest in-flight jobs, then name
+    (deterministic). A replica whose residency was never scraped
+    (None) sorts with the warm ones: unknown is not known-cold.
+    Pins deliberately do NOT drive the order: warmth protection is
+    the correctness layer, and a cold bucket's re-warm after its idle
+    home retires is a bounded warm-up cost, not a lost job. Returns
+    (victim_name_or_None, [names the warmth guard skipped]) — a
+    skipped name means the policy WANTED that replica and the guard
+    refused (`fleet.scale.blocked_warmth`)."""
+    def _key(n):
+        v = replicas[n]
+        rg = v.get("resident_groups")
+        rb = v.get("resident_bytes")
+        return (0 if rg == 0 else 1,
+                rb if isinstance(rb, (int, float)) else float("inf"),
+                v.get("inflight", 0), n)
     order = sorted(
         (name for name, v in replicas.items() if v.get("idle")),
-        key=lambda n: (replicas[n].get("inflight", 0), n))
+        key=_key)
     skipped = []
     for name in order:
         if protected.get(name):
@@ -317,7 +330,11 @@ class AutoScaler:
                                     cfg.scale_down_for)},
                 "replicas": {n: {"inflight": v.get("inflight", 0),
                                  "backlog_mean": v.get("backlog_mean"),
-                                 "idle": v.get("idle", False)}
+                                 "idle": v.get("idle", False),
+                                 "resident_groups":
+                                     v.get("resident_groups"),
+                                 "resident_bytes":
+                                     v.get("resident_bytes")}
                              for n, v in reps.items()}}
             if skipped:
                 ev["warmth_skipped"] = {
@@ -532,11 +549,20 @@ def _evidence_lines(ev: dict) -> list:
             flat = " ".join(f"{t}:{r:g}" for t, r in sorted(v.items()))
             out.append(f"demand flop/s: {flat}")
         elif name == "replicas" and isinstance(v, dict):
+            def _res(d):
+                rg = d.get("resident_groups")
+                if rg is None:
+                    return ""
+                if rg == 0:
+                    return ", cold"
+                rb = d.get("resident_bytes")
+                return (f", {rg:g} resident"
+                        + (f" ({rb:g}B)" if rb is not None else ""))
             flat = " ".join(
                 f"{n}(inflight {d.get('inflight', 0)}, "
                 f"mean backlog "
                 f"{d.get('backlog_mean') if d.get('backlog_mean') is not None else '?'}"
-                f"{', idle' if d.get('idle') else ''})"
+                f"{', idle' if d.get('idle') else ''}{_res(d)})"
                 for n, d in sorted(v.items()))
             out.append(f"victims considered: {flat}")
         elif name == "warmth_skipped" and isinstance(v, dict):

@@ -1005,7 +1005,14 @@ class Gateway:
                              "inflight": inflight_by_rep.get(h.name,
                                                              0),
                              "pins": self.router.pin_counts.get(
-                                 h.name, 0)}
+                                 h.name, 0),
+                             # serve.resident_* gauges off the last
+                             # probe: the residency-aware victim
+                             # preference (autoscaler choose_victim)
+                             "resident_groups": getattr(
+                                 h, "resident_groups", None),
+                             "resident_bytes": getattr(
+                                 h, "resident_bytes", None)}
                     for h in self.replicas.all()},
                 "protected": protected}
         view = {"replicas": [h.view() for h in self.replicas.all()],

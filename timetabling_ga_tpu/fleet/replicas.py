@@ -774,6 +774,12 @@ class ReplicaHandle:
         self.backlog = None
         self.compile_count = 0.0
         self.compile_cache_hits = 0.0
+        # device residency (serve.resident_* gauges): the autoscaler's
+        # residency-aware victim choice reads these off the same
+        # scrape — None until first scraped (treated as warm-unknown,
+        # never preferred over a known-cold replica)
+        self.resident_groups = None
+        self.resident_bytes = None
         self.probe_seconds = None    # last successful probe's round
         #                              trip (/readyz + /metrics) — the
         #                              gateway's fleet.replica.* probe
@@ -867,6 +873,10 @@ class ReplicaHandle:
         self.compile_cache_hits = obs_scrape.scalar(
             families, obs_scrape.COMPILE_HITS,
             self.compile_cache_hits)
+        self.resident_groups = obs_scrape.scalar(
+            families, obs_scrape.RESIDENT_GROUPS, self.resident_groups)
+        self.resident_bytes = obs_scrape.scalar(
+            families, obs_scrape.RESIDENT_BYTES, self.resident_bytes)
         # the prober's incident scrape (tt-flight): when the replica's
         # dump counter advances — off the exposition this probe already
         # parsed — fetch the fresh bundle and cache it on the handle,

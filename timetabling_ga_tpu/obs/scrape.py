@@ -42,6 +42,13 @@ COMPILE_HITS = "tt_compile_cache_hits_total"
 # advances, so the gateway holds a replica's newest bundle even after
 # the replica dies — the "30 seconds before the failover" evidence
 FLIGHT_DUMPS = "tt_flight_dumps_total"
+# device residency (serve/scheduler.py RESIDENCY): groups parked on
+# device between quanta and the bytes a retire would flush. The
+# autoscaler's residency-aware victim choice scores on both
+# (fleet/autoscaler.py choose_victim) — retiring a cold replica costs
+# nothing; retiring a warm one flushes every resident group
+RESIDENT_GROUPS = "tt_serve_resident_groups"
+RESIDENT_BYTES = "tt_serve_resident_bytes"
 
 # one sample line: name, optional {labels}, value, optional exemplar
 # (OpenMetrics: " # {labels} value [timestamp]")

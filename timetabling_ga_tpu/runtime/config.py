@@ -305,6 +305,22 @@ class RunConfig:
     #                           the hung-RPC worst case becomes a
     #                           classified, recoverable failure
     #                           (0 = no watchdog)
+    accord: bool = True       # tt-accord control side channel
+    #                           (runtime/control_channel.py): multi-host
+    #                           schedule agreement, pre-collective
+    #                           rendezvous and fault-recovery consensus
+    #                           ride the coordination-service KV store
+    #                           instead of device collectives.
+    #                           --no-accord restores the PR-1
+    #                           broadcast_one_to_all behavior (and its
+    #                           hang-on-fault failure mode);
+    #                           single-process runs are bit-identical
+    #                           either way
+    peer_timeout: float = 60.0  # seconds of heartbeat silence before a
+    #                           multi-host peer is classified lost
+    #                           (control_channel.PeerLost -> agreed
+    #                           clean abort instead of an infinite
+    #                           collective hang; 0 = wait forever)
     faults: Optional[str] = None  # deterministic fault-injection plan
     #                           (runtime/faults.py grammar); None reads
     #                           $TT_FAULTS — the tier-1 recovery tests
@@ -476,6 +492,7 @@ _FLAG_MAP = {
     "--stall-hamming": ("stall_hamming", float),
     "--max-recoveries": ("max_recoveries", int),
     "--fetch-timeout": ("fetch_timeout", float),
+    "--peer-timeout": ("peer_timeout", float),
     "--faults": ("faults", str),
     "--coordinator": ("coordinator", str),
     "--num-processes": ("num_processes", int),
@@ -496,7 +513,8 @@ TRACE_MODES = ("full", "deltas", "stats")
 _NEG_BOOL_FLAGS = {"--no-auto-tune": "auto_tune",
                    "--no-precompile": "precompile",
                    "--no-pipeline": "pipeline",
-                   "--no-donate": "donate"}
+                   "--no-donate": "donate",
+                   "--no-accord": "accord"}
 
 
 def _format_usage(header_lines, flag_map, bool_flag_maps=()) -> str:
@@ -638,6 +656,9 @@ def parse_args(argv) -> RunConfig:
     if cfg.fetch_timeout < 0:
         raise SystemExit("--fetch-timeout must be >= 0 seconds "
                          "(0 disables the fetch watchdog)")
+    if cfg.peer_timeout < 0:
+        raise SystemExit("--peer-timeout must be >= 0 seconds "
+                         "(0 waits forever for a silent peer)")
     if cfg.post_lahc < 0:
         raise SystemExit("--post-lahc must be >= 0 (history length; "
                          "0 disables the LAHC endgame)")

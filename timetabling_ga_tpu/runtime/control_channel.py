@@ -1,0 +1,432 @@
+"""tt-accord — the out-of-band multi-host control side channel.
+
+Why it exists: every cross-process decision the run loop makes today
+(chunk sizes, stop/continue, resume yes/no) rode
+`multihost_utils.broadcast_one_to_all` — a DEVICE collective, i.e. part
+of the very program whose death the supervisor is trying to recover
+from. After a fault the collective runtime is poisoned on at least one
+process, so "agree on what to do about the fault" could not use the
+program to agree — a multi-host fault was an unrecoverable hang at the
+next collective rendezvous (ROADMAP item 2). This module is the
+host-side channel that never touches the device path: schedule
+agreement (`agree`), pre-collective rendezvous (`guard_collective`),
+fault-recovery consensus (`agree_on_fault`) and a liveness heartbeat
+that converts a dead peer's infinite collective hang into a classified
+`PeerLost` within `--peer-timeout`.
+
+Two backends, one protocol:
+
+* `DistributedChannel` — the `jax.distributed` coordination-service
+  key-value store (`key_value_set` / `blocking_key_value_get`), live
+  whenever a coordinator is (`--coordinator` / `--distributed`).
+  No coordination-service *barriers*: a timed-out barrier id is
+  poisoned for every later arrival, so every wait here is a
+  first-write-wins KV rendezvous that stays re-enterable.
+* `LoopbackChannel` — an in-process dict + condition variable sharing
+  the exact protocol code (everything above the `_set`/`_get`/beat
+  primitives), so every agreement path — including heartbeat expiry
+  and disagreeing-verdict merges — unit-tests on single-process CPU
+  in tier-1 (`tests/test_accord.py`). `kill()` simulates a peer's
+  process death by silencing its heartbeat.
+
+Key discipline (what makes replay safe):
+
+* every key is namespaced `e{epoch}/...`; `agree_on_fault` bumps the
+  epoch on ALL processes at the same agreement and resets the fence
+  counters, so control fences replayed after a recovery write FRESH
+  keys instead of colliding with their first-attempt values;
+* per-run namespaces (`DistributedChannel` is opened once per
+  engine.run on every process, in lockstep) keep repeated runs against
+  one long-lived coordinator from reusing keys;
+* the fault flag (`e{epoch}/fault`) is the only multi-writer key and
+  is written first-write-wins — both-see-fault races are benign.
+
+This module is the accord-modules surface tt-analyze TT307 audits:
+nothing here may launch a device collective or touch
+`multihost_utils.*` — recovery must ride this channel precisely
+because the collective program cannot be trusted after a fault.
+Import-time stdlib-only; jax is reached lazily inside `open_channel`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+# how often a waiting process re-checks the fault flag and peer
+# liveness between KV polls. Short enough that fault hand-off latency
+# is negligible next to a dispatch chunk; long enough that a waiting
+# peer costs ~5 coordination-service RPCs a second.
+POLL_S = 0.2
+
+
+class PeerLost(RuntimeError):
+    """A peer's heartbeat went silent past --peer-timeout while we
+    waited for it at a control fence. NOT transient (the message
+    carries no retry.TRANSIENT_MARKERS string): the peer's process is
+    gone, no rehydrate brings it back, and the only correct move is
+    the agreed clean abort with a final durable checkpoint — never a
+    hang at the collective the peer will not join."""
+
+    def __init__(self, proc: int, silence_s: float):
+        super().__init__(
+            f"lost contact with process {proc}: no heartbeat for "
+            f"{silence_s:.1f}s (over --peer-timeout)")
+        self.proc = proc
+        self.silence_s = silence_s
+
+
+class AccordPeerFault(RuntimeError):
+    """Another process declared a fault on the side channel while this
+    one waited at a control fence. The LOCAL program is healthy — the
+    message carries the 'peer declared a fault' marker
+    retry.TRANSIENT_MARKERS matches, so the supervisor classifies it
+    transient and this process joins the recovery agreement instead of
+    entering the collective its faulted peer will never reach."""
+
+    tt_site = "accord"
+
+    def __init__(self):
+        super().__init__(
+            "accord: a peer declared a fault on the control channel; "
+            "joining the recovery agreement")
+
+
+def merge_verdicts(verdicts: list) -> dict:
+    """Deterministically merge per-process fault verdicts into THE
+    agreed one — pure function of the verdict list, so every process
+    computes the identical decision from the identical inputs with no
+    second round trip. Rules: any `abort` wins (lowest-pid abort is
+    the decider — a process out of recovery budget, or a lost peer's
+    synthesized verdict, must never be outvoted into a retry its
+    state cannot survive); otherwise the lowest-pid verdict naming a
+    REAL fault site wins (a process that merely observed the fault
+    flag carries site 'accord' and defers to the process that saw the
+    actual error). The result gains `agreed`/`decider`/`procs`."""
+    vs = sorted(verdicts, key=lambda v: int(v.get("proc", 0)))
+    if not vs:
+        raise ValueError("merge_verdicts: empty verdict list")
+    aborts = [v for v in vs if v.get("action") == "abort"]
+    if aborts:
+        agreed = dict(aborts[0])
+    else:
+        real = [v for v in vs if v.get("site") not in (None, "accord")]
+        agreed = dict(real[0] if real else vs[0])
+    agreed["agreed"] = True
+    agreed["decider"] = int(agreed.get("proc", 0))
+    agreed["procs"] = [int(v.get("proc", 0)) for v in vs]
+    return agreed
+
+
+class ControlChannel:
+    """Protocol base: agreement fences, collective guards, fault
+    consensus and heartbeats over three backend primitives —
+    `_set(key, value)` (first-write-wins), `_get(key, timeout_s)`
+    (value or None) and `_beat_ages()` (seconds since each peer's last
+    observed heartbeat). Single-process channels (`nproc == 1`) are
+    complete no-ops on every path — the engine keeps one code path
+    and the record stream stays bit-identical channel on or off."""
+
+    def __init__(self, pid: int, nproc: int, peer_timeout: float = 60.0,
+                 hb_interval: float | None = None):
+        self.pid = int(pid)
+        self.nproc = int(nproc)
+        # 0 = wait forever (never classify a peer dead)
+        self.peer_timeout = float(peer_timeout)
+        if hb_interval is None:
+            hb_interval = min(1.0, self.peer_timeout / 4) \
+                if self.peer_timeout > 0 else 1.0
+        self.hb_interval = max(0.02, float(hb_interval))
+        self.epoch = 0
+        self._fences: dict = {}        # tag -> fence count within epoch
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        if self.nproc > 1:
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, name="tt-accord-hb", daemon=True)
+            self._hb_thread.start()
+
+    # ---- backend primitives ----------------------------------------
+    def _set(self, key: str, value: str) -> None:
+        raise NotImplementedError
+
+    def _get(self, key: str, timeout_s: float):
+        raise NotImplementedError
+
+    def _post_beat(self, seq: int) -> None:
+        raise NotImplementedError
+
+    def _silence_s(self, proc: int) -> float:
+        """Seconds since `proc`'s last observed heartbeat."""
+        raise NotImplementedError
+
+    # ---- heartbeat --------------------------------------------------
+    def _hb_loop(self):
+        seq = 0
+        while not self._hb_stop.wait(self.hb_interval):
+            seq += 1
+            try:
+                self._post_beat(seq)
+            except Exception:
+                return     # a dead backend ends the beat, silently:
+                #            exactly what peers' liveness checks detect
+
+    def close(self) -> None:
+        """Stop the heartbeat. Idempotent; the channel must not be
+        used afterwards (peers will classify this process lost)."""
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2 * self.hb_interval + 1.0)
+
+    # ---- protocol helpers -------------------------------------------
+    def _next(self, tag) -> int:
+        n = self._fences.get(tag, 0) + 1
+        self._fences[tag] = n
+        return n
+
+    def _fault_key(self) -> str:
+        return f"e{self.epoch}/fault"
+
+    def fault_flagged(self) -> bool:
+        return self._get(self._fault_key(), 0.0) is not None
+
+    def _await(self, key: str, peer: int, check_flag: bool = True) -> str:
+        """Wait for `key` tolerant of everything but silence: returns
+        its value; raises AccordPeerFault the moment any process posts
+        the epoch's fault flag (unless already inside fault agreement),
+        PeerLost when `peer`'s heartbeat has been quiet past
+        --peer-timeout. Never waits on a barrier — re-enterable."""
+        while True:
+            v = self._get(key, POLL_S)
+            if v is not None:
+                return v
+            if check_flag and self.fault_flagged():
+                raise AccordPeerFault()
+            if self.peer_timeout > 0:
+                silence = self._silence_s(peer)
+                if silence > self.peer_timeout:
+                    raise PeerLost(peer, silence)
+
+    # ---- the three agreement surfaces -------------------------------
+    def agree(self, tag: str, payload):
+        """Process-0-wins agreement at a named control fence: process 0
+        posts its JSON-serializable `payload` and proceeds; every other
+        process adopts it. The fence index is the per-tag call count
+        within the epoch, so lockstep callers need no explicit ids.
+        Single-process: identity."""
+        if self.nproc == 1:
+            return payload
+        key = f"e{self.epoch}/a/{tag}/{self._next(('a', tag))}"
+        if self.pid == 0:
+            self._set(key, json.dumps(payload))
+            return payload
+        return json.loads(self._await(key, peer=0))
+
+    def guard_collective(self) -> None:
+        """Host-side rendezvous BEFORE entering a device collective:
+        every process posts arrival and waits for all peers. A peer
+        that faulted raises AccordPeerFault here (join its recovery
+        agreement instead of hanging at its missing collective); a
+        peer whose heartbeat died raises PeerLost within
+        --peer-timeout. This is what converts 'infinite hang inside
+        the collective' into a classified host-side fault."""
+        if self.nproc == 1:
+            return
+        base = f"e{self.epoch}/g/{self._next('g')}"
+        self._set(f"{base}/{self.pid}", "1")
+        for p in range(self.nproc):
+            if p != self.pid:
+                self._await(f"{base}/{p}", peer=p)
+
+    def agree_on_fault(self, local_verdict: dict) -> dict:
+        """Fault-recovery consensus: post this process's verdict
+        ({'site', 'action': 'recover'|'abort', 'gens', ...}), collect
+        every peer's (a peer lost mid-agreement contributes a
+        synthesized abort verdict instead of raising — its death IS a
+        vote), and return `merge_verdicts` of the full set — identical
+        on every process. Bumps the epoch and resets the fence
+        counters: all processes resume (or abort) in a fresh key
+        namespace, so replayed fences cannot collide with their
+        pre-fault writes. Single-process: the local verdict, agreed."""
+        verdict = dict(local_verdict)
+        verdict["proc"] = self.pid
+        if self.nproc == 1:
+            return merge_verdicts([verdict])
+        try:
+            self._set(self._fault_key(), "1")
+        except Exception:
+            pass       # both-see-fault: a peer flagged first — fine
+        self._set(f"e{self.epoch}/v/{self.pid}", json.dumps(verdict))
+        verdicts = [verdict]
+        for p in range(self.nproc):
+            if p == self.pid:
+                continue
+            try:
+                verdicts.append(json.loads(
+                    self._await(f"e{self.epoch}/v/{p}", peer=p,
+                                check_flag=False)))
+            except PeerLost as e:
+                verdicts.append({"proc": p, "site": "accord",
+                                 "action": "abort", "gens": -1,
+                                 "lost": True,
+                                 "silence_s": round(e.silence_s, 3)})
+        agreed = merge_verdicts(verdicts)
+        self.epoch += 1
+        self._fences.clear()
+        return agreed
+
+
+class _LoopbackStore:
+    """The shared in-process backend: one dict + condition variable and
+    per-process heartbeat timestamps."""
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.data: dict = {}
+        self.beats: dict = {}
+
+
+class LoopbackChannel(ControlChannel):
+    """In-process backend: N channel views over one `_LoopbackStore`
+    run the full protocol (including real heartbeat threads) on one
+    CPU process — the tier-1 test double for the distributed backend,
+    and the single-process fast path (`solo()`)."""
+
+    def __init__(self, pid: int, nproc: int,
+                 store: _LoopbackStore | None = None,
+                 peer_timeout: float = 60.0,
+                 hb_interval: float | None = None):
+        self._store = store if store is not None else _LoopbackStore()
+        with self._store.cond:
+            self._store.beats[pid] = time.monotonic()
+        super().__init__(pid, nproc, peer_timeout, hb_interval)
+
+    @classmethod
+    def group(cls, n: int, peer_timeout: float = 60.0,
+              hb_interval: float | None = None) -> list:
+        """N views over one shared store — 'n processes' in one."""
+        store = _LoopbackStore()
+        return [cls(p, n, store, peer_timeout, hb_interval)
+                for p in range(n)]
+
+    @classmethod
+    def solo(cls) -> "LoopbackChannel":
+        """The single-process channel: every protocol surface is a
+        no-op/identity and no heartbeat thread runs."""
+        return cls(0, 1)
+
+    def kill(self) -> None:
+        """Simulate this view's process dying: its heartbeat stops,
+        so peers' liveness checks see growing silence. (A dead process
+        also stops writing keys — tests simply stop calling.)"""
+        self._hb_stop.set()
+
+    def _set(self, key, value):
+        with self._store.cond:
+            self._store.data.setdefault(key, value)
+            self._store.cond.notify_all()
+
+    def _get(self, key, timeout_s):
+        with self._store.cond:
+            if timeout_s > 0:
+                self._store.cond.wait_for(
+                    lambda: key in self._store.data, timeout_s)
+            return self._store.data.get(key)
+
+    def _post_beat(self, seq):
+        with self._store.cond:
+            self._store.beats[self.pid] = time.monotonic()
+
+    def _silence_s(self, proc):
+        with self._store.cond:
+            t = self._store.beats.get(proc)
+        return 0.0 if t is None else max(0.0, time.monotonic() - t)
+
+
+class DistributedChannel(ControlChannel):
+    """The real multi-host backend over the jax.distributed
+    coordination-service client's KV store. Heartbeats are
+    sequence-numbered keys (`hb/{pid}/{seq}`) because the KV store is
+    write-once: liveness is 'how long since the NEXT sequence number
+    appeared', tracked per peer on the observing side."""
+
+    def __init__(self, client, pid: int, nproc: int,
+                 peer_timeout: float = 60.0,
+                 hb_interval: float | None = None,
+                 namespace: str = "tt-accord/0"):
+        self._client = client
+        self._ns = namespace
+        # per-peer [last seen seq, monotonic time it was seen]
+        self._hb_seen = {p: [0, time.monotonic()]
+                         for p in range(nproc) if p != pid}
+        super().__init__(pid, nproc, peer_timeout, hb_interval)
+
+    def _set(self, key, value):
+        self._client.key_value_set(f"{self._ns}/{key}", value)
+
+    def _get(self, key, timeout_s):
+        try:
+            return self._client.blocking_key_value_get(
+                f"{self._ns}/{key}", max(1, int(timeout_s * 1000)))
+        except Exception:
+            return None        # missing within timeout — the protocol
+            #                    loops re-check flag + liveness
+
+    def _post_beat(self, seq):
+        self._client.key_value_set(f"{self._ns}/hb/{self.pid}/{seq}", "1")
+
+    def _silence_s(self, proc):
+        ent = self._hb_seen[proc]
+        while True:            # drain beats that landed since last look
+            if self._get(f"hb/{proc}/{ent[0] + 1}", 0.001) is None:
+                break
+            ent[0] += 1
+            ent[1] = time.monotonic()
+        return max(0.0, time.monotonic() - ent[1])
+
+
+# ---- per-process channel registry -----------------------------------
+# The active channel (None = accord off). dispatch_core.fetch guards
+# its multi-host allgather through `active()`; engine.run installs at
+# open and uninstalls in its finally. Per-run sequence numbers keep
+# repeated runs in one process (against one long-lived coordinator)
+# from colliding in the shared KV namespace — every process opens the
+# channel once per run, in lockstep, so the counters agree.
+_ACTIVE: ControlChannel | None = None
+_RUN_SEQ = 0
+
+
+def install(ch: ControlChannel | None):
+    global _ACTIVE
+    _ACTIVE = ch
+    return ch
+
+
+def active() -> ControlChannel | None:
+    return _ACTIVE
+
+
+def open_channel(accord: bool = True, peer_timeout: float = 60.0):
+    """Build the channel for this process's topology: None when accord
+    is disabled (--no-accord), a solo loopback single-process (all
+    paths no-op), the coordination-service backend when
+    jax.distributed is live. Multi-process WITHOUT a coordination
+    client (should not happen — jax.distributed.initialize creates
+    one) degrades to None rather than failing the run."""
+    if not accord:
+        return None
+    import jax
+
+    from timetabling_ga_tpu import compat
+    nproc = jax.process_count()
+    if nproc == 1:
+        return LoopbackChannel.solo()
+    client = compat.coordination_client()
+    if client is None:
+        return None
+    global _RUN_SEQ
+    _RUN_SEQ += 1
+    return DistributedChannel(
+        client, jax.process_index(), nproc, peer_timeout=peer_timeout,
+        namespace=f"tt-accord/{_RUN_SEQ}")

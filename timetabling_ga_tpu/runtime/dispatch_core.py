@@ -56,6 +56,7 @@ import numpy as np
 from timetabling_ga_tpu.obs.spans import NULL_TRACER
 from timetabling_ga_tpu.ops import ga
 from timetabling_ga_tpu.parallel import islands
+from timetabling_ga_tpu.runtime import control_channel
 from timetabling_ga_tpu.runtime import faults
 from timetabling_ga_tpu.runtime import retry
 from timetabling_ga_tpu.runtime.config import RunConfig
@@ -240,10 +241,16 @@ class Supervisor:
                  shrunk chunks — smaller dispatches both finish under a
                  sick device's watchdog and lose less work per kill)
 
-    Single-process only: recovery decisions read local clocks and local
-    errors, and multi-host processes would have to agree on them before
-    diverging from the collective program order (future work — the
-    ROADMAP's multi-host pipelining item has the same shape)."""
+    Multi-host (tt-accord): recovery decisions read local clocks and
+    local errors, so before any process diverges from the collective
+    program order ALL processes adopt one verdict over the control
+    side channel (runtime/control_channel.py) — `agree_on_fault` posts
+    this process's classification and returns the deterministic merge
+    of every peer's (README "Multi-host recovery"). Only then do the
+    processes purge/rehydrate/resume (or cleanly abort) in lockstep.
+    Requires the channel (--no-accord restores the single-process-only
+    gate); the recovery path itself must never launch a device
+    collective — tt-analyze TT307 audits exactly that."""
 
     WINDOW_S = float(os.environ.get("TT_FAULT_WINDOW_S", "300"))
     MAX_LEVEL = 4
@@ -251,7 +258,8 @@ class Supervisor:
     def __init__(self, cfg: RunConfig):
         self.cfg = cfg
         self.enabled = (cfg.max_recoveries > 0
-                        and jax.process_count() == 1)
+                        and (jax.process_count() == 1
+                             or getattr(cfg, "accord", True)))
         self.snap: Snapshot | None = None
         self.recoveries = 0
         self.level = 0
@@ -287,6 +295,26 @@ class Supervisor:
             self.level = new_level
             return True
         return False
+
+    def agree_on_fault(self, channel, site: str, error=None) -> dict:
+        """Multi-host recovery consensus: build this process's local
+        verdict — `recover` at the snapshot's generation count, or
+        `abort` when the recovery budget is spent — and return the
+        channel's agreed merge (control_channel.merge_verdicts: abort
+        wins, else the lowest-pid REAL fault site). Host-side only:
+        this and the snapshot rehydrate are the Supervisor's
+        TT307-audited recovery surface, and neither may touch the
+        possibly-poisoned collective program. Single-process channels
+        return the local verdict unchanged."""
+        local = {
+            "site": site,
+            "action": ("abort"
+                       if self.recoveries + 1 > self.cfg.max_recoveries
+                       else "recover"),
+            "gens": int(self.snap.gens_done) if self.snap else -1,
+            "err": str(error)[:200] if error is not None else None,
+        }
+        return channel.agree_on_fault(local)
 
     def maybe_relax(self, now: float) -> bool:
         """Step the ladder back UP (one level per clean WINDOW_S):
@@ -390,6 +418,14 @@ def fetch(x, tracer=NULL_TRACER, flow=None) -> np.ndarray:
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         faults.maybe_fail("fetch")
+        # tt-accord: host-side rendezvous BEFORE the allgather. A peer
+        # that faulted (or died) can never reach this collective — the
+        # guard raises AccordPeerFault/PeerLost on the side channel
+        # within --peer-timeout instead of letting this process hang
+        # forever at the collective rendezvous.
+        ch = control_channel.active()
+        if ch is not None:
+            ch.guard_collective()
         return np.asarray(multihost_utils.process_allgather(x, tiled=True))
     timeout = _FETCH_TIMEOUT
     if not timeout:

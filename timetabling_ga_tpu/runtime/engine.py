@@ -112,6 +112,7 @@ from timetabling_ga_tpu.ops import ga
 from timetabling_ga_tpu.parallel import islands
 from timetabling_ga_tpu.problem import load_tim_file
 from timetabling_ga_tpu.runtime import checkpoint as ckpt
+from timetabling_ga_tpu.runtime import control_channel
 from timetabling_ga_tpu.runtime import dispatch_core as dcore
 from timetabling_ga_tpu.runtime import faults
 from timetabling_ga_tpu.runtime import jsonl
@@ -337,9 +338,20 @@ def _sync_vals(*vals):
     must take the SAME dispatch decisions (chunk sizes, epoch counts,
     break/continue) or their collective program sequences diverge near
     the -t boundary and the run deadlocks. Decisions are computed from
-    per-process clocks, then overridden with process 0's values via an
-    all-device broadcast. Identity on single-process runs."""
+    per-process clocks, then overridden with process 0's values.
+
+    tt-accord: agreement rides the control side channel
+    (control_channel.agree, process-0-wins over the coordination
+    service's KV store) — host-side, OFF the device path, so schedule
+    agreement still works while the collective program is poisoned or
+    a peer is dead (the channel classifies that instead of hanging).
+    --no-accord falls back to the PR-1 `broadcast_one_to_all` device
+    collective. Identity on single-process runs either way."""
     if jax.process_count() > 1:
+        ch = control_channel.active()
+        if ch is not None:
+            return tuple(int(v)
+                         for v in ch.agree("s", [int(v) for v in vals]))
         from jax.experimental import multihost_utils
         arr = multihost_utils.broadcast_one_to_all(
             np.asarray(vals, np.int64))
@@ -553,6 +565,18 @@ def maybe_init_distributed(cfg: RunConfig) -> None:
     global _DISTRIBUTED_DONE
     if _DISTRIBUTED_DONE or not (cfg.distributed or cfg.coordinator):
         return
+    if cfg.backend == "cpu":
+        # multi-process CPU (the 2-process e2e tier, and any host-only
+        # rehearsal of a pod launch) needs cross-process collectives
+        # explicitly enabled — the backend default is 'none', which
+        # fails every multi-process computation with INVALID_ARGUMENT.
+        # Must happen BEFORE backend init; guarded because the flag's
+        # name/values have moved across jax versions.
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
     kwargs = {}
     if cfg.coordinator is not None:
         kwargs = dict(coordinator_address=cfg.coordinator,
@@ -865,10 +889,6 @@ def run(cfg: RunConfig, out=None) -> int:
     """
     if cfg.backend == "cpu":
         jax.config.update("jax_platforms", "cpu")
-    # fault-injection plan (RunConfig.faults, falling back to the
-    # TT_FAULTS env var) installed per run: invocation counters reset
-    # here, so a plan's site indices are deterministic within one run
-    faults.install(faults.active_spec(cfg.faults))
     dcore.set_fetch_timeout(cfg.fetch_timeout)
     if cfg.ls_time_limit != 99999.0:
         # -l is formally retired on this path: the fixed-shape batched LS
@@ -880,6 +900,23 @@ def run(cfg: RunConfig, out=None) -> int:
               "evaluations instead", file=sys.stderr)
 
     maybe_init_distributed(cfg)
+
+    # fault-injection plan (RunConfig.faults, falling back to the
+    # TT_FAULTS env var) installed per run: invocation counters reset
+    # here, so a plan's site indices are deterministic within one run.
+    # Process coordinates first — `site@proc` scoping filters entries
+    # at parse time, and parse needs to know which process this is
+    # (faults.py is stdlib-only and cannot ask jax itself)
+    faults.set_process(jax.process_index(), jax.process_count())
+    faults.install(faults.active_spec(cfg.faults))
+
+    # tt-accord: open the control side channel for this run (a
+    # per-process no-op object single-process, the coordination-service
+    # KV backend under a live coordinator). Installed module-globally
+    # so dispatch_core.fetch guards its multi-host allgathers through
+    # it; closed (heartbeat stopped, registry cleared) in the finally.
+    channel = control_channel.install(
+        control_channel.open_channel(cfg.accord, cfg.peer_timeout))
 
     # single-controller reporting: process 0 has the global view (every
     # island's solution records and the runEntry), so other processes
@@ -1004,6 +1041,13 @@ def run(cfg: RunConfig, out=None) -> int:
             obs_metrics.REGISTRY.freeze(
                 "writer.records", writer.records_written)
             obs_metrics.REGISTRY.freeze("writer.queue_depth", 0.0)
+        # stop the accord heartbeat and clear the channel registry:
+        # peers observing this process between runs must see silence,
+        # not a stale beat, and later single-process work (precompile,
+        # serve) must not guard through a dead channel
+        if channel is not None:
+            channel.close()
+        control_channel.install(None)
         # uninstall the fault plan: leftover unfired entries must not
         # ambush later non-run code (precompile, direct checkpoint
         # saves, other writers) outside any supervised region. Triggered
@@ -2135,24 +2179,74 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
                 tracer.record("fetch", t, time.monotonic() - t,
                               cat="engine", endTry=True)
                 break
+            except control_channel.PeerLost as e:
+                # a peer PROCESS is gone (heartbeat silent past
+                # --peer-timeout): no rehydrate brings it back and the
+                # collective program would hang at its next rendezvous
+                # forever. Emit the abort faultEntry, leave a final
+                # durable checkpoint from the snapshot (process 0
+                # only — the single-controller write discipline: on a
+                # shared filesystem N processes must not race the
+                # rename), and propagate — a classified clean exit,
+                # never a hang. The checkpoint state is global (the
+                # snapshot rode the last checkpoint fence's
+                # allgather), so the rerun resumes on any topology.
+                jsonl.fault_entry(
+                    out, "accord", "abort", e, trial, sup.recoveries,
+                    sup.level, time.monotonic() - t_try,
+                    proc=jax.process_index(), agreed=False,
+                    lostProc=e.proc)
+                if (cfg.checkpoint and sup.snap is not None
+                        and jax.process_index() == 0):
+                    try:
+                        ckpt.save(cfg.checkpoint, sup.snap.state,
+                                  sup.snap.key, sup.snap.gens_done,
+                                  fingerprint, sup.snap.best_seen,
+                                  seed)
+                    except Exception as e3:
+                        print(f"warning: final abort checkpoint "
+                              f"failed: {e3}", file=sys.stderr)
+                raise
             except Exception as e:
                 site = sup.classify(e)
                 if site is None:
                     raise
                 now = time.monotonic()
+                # tt-accord: BEFORE any process diverges from the
+                # collective program order, all processes adopt one
+                # verdict over the side channel — the process that saw
+                # the real error contributes its site, a process that
+                # merely observed the fault flag defers (site
+                # 'accord'), and any budget-exhausted process forces
+                # the agreed abort. Single-process runs skip this
+                # entirely (no extra fields, byte-identical stream).
+                agreed = None
+                ch = control_channel.active()
+                if jax.process_count() > 1 and ch is not None:
+                    agreed = sup.agree_on_fault(ch, site, e)
+                    site = agreed.get("site") or site
+                acc = ({} if agreed is None else
+                       {"proc": jax.process_index(), "agreed": True,
+                        "decider": agreed["decider"]})
                 sup.recoveries += 1
                 mreg.gauge("engine.recovery_budget_remaining").set(
                     max(0, cfg.max_recoveries - sup.recoveries))
-                if sup.recoveries > cfg.max_recoveries:
-                    # recovery budget exhausted: emit the abort record,
-                    # leave a final durable checkpoint from the
-                    # snapshot, and let the error propagate — run()'s
-                    # finally drains the writer, so the stream is
-                    # complete up to and including this record
+                if (sup.recoveries > cfg.max_recoveries
+                        or (agreed is not None
+                            and agreed.get("action") == "abort")):
+                    # recovery budget exhausted (here or, under
+                    # accord, on ANY process — abort wins the merge):
+                    # emit the abort record, leave a final durable
+                    # checkpoint from the snapshot, and let the error
+                    # propagate — run()'s finally drains the writer,
+                    # so the stream is complete up to and including
+                    # this record
                     jsonl.fault_entry(
                         out, site, "abort", e, trial,
-                        sup.recoveries - 1, sup.level, now - t_try)
-                    if cfg.checkpoint:
+                        sup.recoveries - 1, sup.level, now - t_try,
+                        **acc)
+                    if cfg.checkpoint and (agreed is None
+                                           or jax.process_index() == 0):
                         try:
                             ckpt.save(cfg.checkpoint, sup.snap.state,
                                       sup.snap.key, sup.snap.gens_done,
@@ -2165,10 +2259,24 @@ def _run_tries(cfg: RunConfig, out, tracer=NULL_TRACER,
                 mreg.counter("engine.recoveries").inc()
                 t_rec = time.monotonic()
                 snap = sup.snap
+                if (agreed is not None
+                        and int(agreed.get("gens", -1)) >= 0
+                        and int(agreed["gens"]) != snap.gens_done):
+                    # snapshots are taken at shared control fences, so
+                    # the agreed resume chunk must equal this
+                    # process's — a divergence means the fence
+                    # discipline broke somewhere, and resuming anyway
+                    # would corrupt the collective program. Fail loud,
+                    # never hang.
+                    raise RuntimeError(
+                        f"accord: agreed resume generation "
+                        f"{agreed['gens']} != this process's snapshot "
+                        f"generation {snap.gens_done} — diverged "
+                        f"snapshots; refusing to resume") from e
                 jsonl.fault_entry(
                     out, site, "recover", e, trial, sup.recoveries,
                     sup.level, now - t_try,
-                    lostGens=max(0, gens_done - snap.gens_done))
+                    lostGens=max(0, gens_done - snap.gens_done), **acc)
                 if sup.escalate(now):
                     # repeated failures inside the window: step the
                     # degradation ladder (1 = serial, >= 2 = halved

@@ -19,7 +19,17 @@ Grammar (env var `TT_FAULTS`, or RunConfig.faults / `--faults`):
     TT_FAULTS=dispatch:3:unavailable,fetch:5:hang,writer:1:die,ckpt:2:truncate
 
 Each entry is `site:nth:action` — on the `nth` (1-based) invocation of
-`site`, perform `action`:
+`site`, perform `action`. Multi-process runs (tt-accord) scope a site
+with `site@proc` — `dispatch@1:2:die` kills process 1's second
+dispatch and is parsed AWAY on every other process, so their
+invocation indices are exactly the single-process plan's (one shared
+`TT_FAULTS` env value drives a deterministic cross-process matrix).
+UNSCOPED entries apply to process 0 only when `set_process` reports
+more than one process: without that rule a shared env value would
+fire the same plan independently on every process, shifting every
+index the moment any site's call count differs across processes.
+Single-process runs (the default `set_process(0, 1)`) are untouched —
+unscoped entries apply, `@0` is accepted and equivalent:
 
     unavailable  raise RuntimeError wrapping an inner exception whose
                  message carries 'UNAVAILABLE' (the jit-dispatch
@@ -170,6 +180,24 @@ SITES = ("dispatch", "fetch", "writer", "ckpt", "init", "obs_listen",
          "resume", "history", "flight_dump", "usage", "scaler")
 
 
+# this process's coordinates in a multi-launch run, injected by
+# engine.run AFTER jax.distributed init (this module is stdlib-only
+# and cannot ask jax itself). Defaults keep every single-process
+# caller — serve replicas, the fleet, direct installs in tests —
+# bit-identical to the pre-accord behavior.
+_PROC = 0
+_NPROC = 1
+
+
+def set_process(proc: int, nproc: int) -> None:
+    """Declare this process's (index, count) for plan scoping. Parse
+    happens per install, so call this BEFORE `install` (engine.run
+    orders it right after maybe_init_distributed)."""
+    global _PROC, _NPROC
+    _PROC = int(proc)
+    _NPROC = max(1, int(nproc))
+
+
 class FaultInjected(Exception):
     """An injected fault (also the inner 'device' error for the
     `unavailable` action, whose message carries the transient marker)."""
@@ -203,6 +231,24 @@ class FaultPlan:
                 raise FaultPlanError(
                     f"bad TT_FAULTS entry {item!r} (want site:nth:action)")
             site, nth_s, action = (p.strip() for p in parts)
+            # process scope (`site@proc`, tt-accord): parse-time
+            # filtering — a plan only ever holds THIS process's
+            # entries, so counters and indices are per-process stable
+            # under one shared TT_FAULTS env value
+            proc = None
+            if "@" in site:
+                site, _, proc_s = site.partition("@")
+                site = site.strip()
+                try:
+                    proc = int(proc_s)
+                except ValueError:
+                    raise FaultPlanError(
+                        f"bad TT_FAULTS process scope {proc_s!r} in "
+                        f"{item!r} (want site@proc)") from None
+                if proc < 0:
+                    raise FaultPlanError(
+                        f"TT_FAULTS process scope must be >= 0 in "
+                        f"{item!r}")
             try:
                 nth = int(nth_s)
             except ValueError:
@@ -219,6 +265,13 @@ class FaultPlan:
                 raise FaultPlanError(
                     f"unknown TT_FAULTS action {action!r} in {item!r} "
                     f"(one of {', '.join(ACTIONS)})")
+            if proc is None:
+                # unscoped under a multi-process launch: process 0
+                # only (module docstring — the indices rule)
+                if _NPROC > 1 and _PROC != 0:
+                    continue
+            elif proc != _PROC:
+                continue           # another process's entry
             entries.setdefault(site, {})[nth] = action
         return cls(entries)
 

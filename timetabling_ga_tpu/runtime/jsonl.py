@@ -340,7 +340,17 @@ def fault_entry(stream: IO, site: str, action: str, error, trial: int,
     = halved dispatch chunks), or abort (--max-recoveries exhausted;
     the run raises after this record). `recovery` counts recoveries so
     far this run; `time` is seconds into the trial — the lost wall
-    time stays charged against the trial budget."""
+    time stays charged against the trial budget.
+
+    Multi-host (tt-accord) events additionally carry `proc` (the
+    emitting process index), `agreed` (True when the action is the
+    channel-merged verdict every process adopted, False for a
+    unilateral PeerLost abort), `decider` (which process's verdict won
+    the merge) and `lostProc` on PeerLost. All inside the TIMING
+    discipline: faultEntry is a TIMING_RECORDS member, so strip_timing
+    drops the whole record and the determinism contract (records
+    identical modulo timing/fault records) is untouched by the new
+    fields."""
     rec = {"site": str(site), "action": str(action),
            "error": str(error)[:200], "trial": int(trial),
            "recovery": int(recovery), "level": int(level),

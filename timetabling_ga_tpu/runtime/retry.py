@@ -48,6 +48,14 @@ TRANSIENT_MARKERS = (
     #                             consumer of this policy (the engine's
     #                             own hung-fetch case is already the
     #                             'fetch watchdog' marker)
+    # tt-accord (runtime/control_channel.py AccordPeerFault): a PEER
+    # declared a fault on the control side channel while this process
+    # waited at a fence — the local program is healthy and the
+    # supervisor must join the recovery agreement, so the signal
+    # classifies transient. control_channel.PeerLost deliberately
+    # avoids this substring: a dead peer is NOT retryable (the agreed
+    # clean abort handles it).
+    "peer declared a fault",
 )
 
 # cause-chain walk bound: a pathological cycle (e1.__cause__ = e2,

@@ -189,6 +189,15 @@ class Scheduler:
         self._flush_req = False
         self._metrics.gauge_fn("serve.resident_groups",
                                lambda: len(self._resident))
+        # bytes currently parked ON DEVICE across resident groups —
+        # what a retire would have to flush through the park fences.
+        # The autoscaler's residency-aware victim choice reads both
+        # gauges off the gateway's scrape (fleet/autoscaler.py
+        # choose_victim): prefer cold replicas, tie-break on fewest
+        # resident bytes. Pure host arithmetic over leaf .nbytes —
+        # never a device sync (tt-analyze TT306/TT603 discipline).
+        self._metrics.gauge_fn("serve.resident_bytes",
+                               lambda: float(self._resident_bytes()))
         self.gacfg = ga.GAConfig(
             pop_size=cfg.pop_size,
             ls_steps=max(1, cfg.max_steps // cfg.ls_candidates),
@@ -937,6 +946,14 @@ class Scheduler:
                 jsonl.fault_entry(self.out, "flush", "rollback", e,
                                   0, 0, 0, self.tracer.now())
         return n
+
+    def _resident_bytes(self) -> int:
+        """Total device bytes across resident groups (the
+        serve.resident_bytes gauge) — leaf `.nbytes` sums, no device
+        sync. Tolerates a group mid-eviction (dict snapshot)."""
+        from timetabling_ga_tpu.runtime import dispatch_core as dcore
+        return sum(dcore.state_nbytes(g.get("state"))
+                   for g in list(self._resident.values()))
 
     def request_flush(self) -> None:
         """Ask the drive loop to park every resident group at its next

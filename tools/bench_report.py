@@ -106,6 +106,12 @@ _METRICS = [
     ("mesh B/q parked", "serve_mesh.ndev_parked",
      "park_resume_bytes_per_quantum"),
     ("scale compile attempts", "scale_2000ev", "compile_attempts"),
+    # extra.accord (ISSUE 18): the control side channel's cost when
+    # nothing is wrong — single-process A/B identity plus the loopback
+    # agreement microbench's per-fence overhead
+    ("accord ms/agree", "accord", "agree_ms_per_fence"),
+    ("accord ms/guard", "accord", "guard_ms_per_fence"),
+    ("accord identical", "accord", "records_identical"),
 ]
 
 _NUM = r"(-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)"
@@ -273,6 +279,20 @@ def _scaling_section(rounds, multis) -> list:
         lines.append("gens/s (generation_scan) per round: "
                      + ", ".join(f"r{_fmt(n)} {_fmt(v)}"
                                  for n, v in gens))
+    # tt-accord (ISSUE 18): the multi-host control channel's per-fence
+    # host overhead next to the curves it enables — a multi-host run
+    # pays this per agreement fence, off the device path
+    accord = [(r["round"], r["metrics"].get("accord ms/agree"),
+               r["metrics"].get("accord identical"))
+              for r in rounds
+              if r["metrics"].get("accord ms/agree") is not None]
+    if accord:
+        lines.append("accord fence overhead (extra.accord, loopback "
+                     "2-view): "
+                     + ", ".join(
+                         f"r{_fmt(n)} {_fmt(v)} ms/agree"
+                         f" identical={'yes' if ident else 'NO'}"
+                         for n, v, ident in accord))
     if multis:
         lines.append("multichip dry-run (devices -> gens): "
                      + ", ".join(

@@ -9,9 +9,10 @@
 #
 # Steps (each failure is fatal):
 #   1. tt-analyze --strict --warn-unused-ignores over timetabling_ga_tpu/
-#      — the JAX-aware static rules, 23 of them including the
+#      — the JAX-aware static rules, 24 of them including the
 #      whole-program device-taint/donation/fence/residency pass
-#      (TT303/TT304/TT305/TT306), plus stale-suppression detection
+#      (TT303/TT304/TT305/TT306) and the tt-accord recovery-path
+#      collective ban (TT307), plus stale-suppression detection
 #      (TT901; README "Static analysis & sanitizers")
 #   2. python -m compileall — syntax across every tree we ship
 #   3. the tier-1 pytest command from ROADMAP.md
@@ -86,6 +87,13 @@ if [ "${1:-}" = "--fast" ]; then
     step "autoscaler tests (tests/test_scale.py)"
     timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_scale.py -q -p no:cacheprovider -m 'not slow' \
+        || fail=1
+    # the tt-accord acceptance (2-process kill-mid-run) is slow-tiered;
+    # fast mode runs the loopback fault matrix — every agreement path,
+    # heartbeat expiry and verdict merge on single-process CPU
+    step "accord channel tests (tests/test_accord.py)"
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_accord.py -q -p no:cacheprovider -m 'not slow' \
         || fail=1
     [ "$fail" -eq 0 ] && step "OK (fast mode: full test tier skipped)"
     exit $fail
