@@ -1786,6 +1786,145 @@ def measure_resume() -> dict:
                 - resume_leg["wasted_gens_ratio"], 4)}
 
 
+def measure_edit() -> dict:
+    """extra.edit leg (ISSUE 19, tt-edit): warm vs cold incremental
+    re-solve A/B. One base job runs to completion (its freshest
+    park-fence snapshot stands in for what the gateway caches), then
+    the same small edit — one added event plus one attendance change —
+    is solved twice with identical seed/budget:
+
+      warm   snapshot present: population transplanted, anchored
+             objective on (w_anchor=1)
+      cold   no snapshot: demoted to a cold solve of the edited
+             instance (the pre-tt-edit behavior)
+
+    Reported per leg: wall time, time-to-feasible, generations to
+    reach the BASE job's final quality, final best, edit_distance, and
+    the demotion count (the warm same-bucket leg must show zero).
+    Plus the w_anchor=0 identity assertion: a zero-weight cold edit's
+    solver record stream must be identical to a plain solve of the
+    edited instance."""
+    import io
+
+    from timetabling_ga_tpu.obs import metrics as obs_metrics
+    from timetabling_ga_tpu.problem import dump_tim, random_instance
+    from timetabling_ga_tpu.runtime import jsonl
+    from timetabling_ga_tpu.runtime.config import ServeConfig
+    from timetabling_ga_tpu.serve import JobState
+    from timetabling_ga_tpu.serve import editsolve
+    from timetabling_ga_tpu.serve.service import SolveService
+
+    p = random_instance(9100, n_events=60, n_rooms=4, n_features=4,
+                        n_students=40, attend_prob=0.06)
+    base_gens = 200
+    edit_gens = 200
+
+    def serve_cfg():
+        return ServeConfig(backend="cpu", lanes=2, quantum=5,
+                           pop_size=8, max_steps=16)
+
+    # base job to completion, keeping the freshest park-fence wire
+    buf0 = io.StringIO()
+    svc = SolveService(serve_cfg(), out=buf0)
+    svc.submit(p, job_id="base", seed=1, generations=base_gens)
+    wire = None
+    while svc.state("base") not in (JobState.DONE, JobState.FAILED):
+        if not svc.step():
+            break
+        svc.scheduler.flush_resident("ship")
+        ship = svc.queue.get("base").ship
+        if ship is not None:
+            wire = ship.pack()
+    svc.drive()
+    base_best = int(svc.queue.get("base").best)
+    svc.close()
+
+    ops = [{"op": "add_event", "students": [2, 11], "features": [0]},
+           {"op": "set_attendance", "event": 3, "student": 5,
+            "value": 1}]
+    edit_spec = {"base": {"tim": dump_tim(p)}, "base_id": "base",
+                 "ops": ops}
+
+    def leg(warm: bool, w_anchor: int = 1):
+        reg = obs_metrics.REGISTRY
+        dem0 = reg.counter("serve.jobs_edit_demoted").value
+        buf = io.StringIO()
+        svc = SolveService(serve_cfg(), out=buf)
+        spec = dict(edit_spec, w_anchor=w_anchor)
+        if warm:
+            spec["snapshot"] = wire
+        t0 = time.perf_counter()
+        svc.submit(None, job_id="e", seed=2, generations=edit_gens,
+                   edit=spec)
+        gens_to_base = None
+        t_feasible = None
+
+        def observe():
+            nonlocal gens_to_base, t_feasible
+            job = svc.queue.get("e")
+            if t_feasible is None and job.best < 10 ** 6:
+                t_feasible = round(time.perf_counter() - t0, 3)
+            if gens_to_base is None and job.best <= base_best:
+                gens_to_base = int(job.gens_done)
+
+        while svc.state("e") not in (JobState.DONE, JobState.FAILED):
+            if not svc.step():
+                break
+            observe()
+        svc.drive()
+        observe()
+        wall = time.perf_counter() - t0
+        job = svc.queue.get("e")
+        res = svc.result("e") or {}
+        svc.close()
+        return {"wall_s": round(wall, 3), "best": int(job.best),
+                "gens": int(job.gens_done),
+                "time_to_feasible_s": t_feasible,
+                "gens_to_base_quality": gens_to_base,
+                "edit_distance": res.get("edit_distance"),
+                "demoted": int(reg.counter(
+                    "serve.jobs_edit_demoted").value - dem0)}
+
+    warm = leg(warm=True)
+    cold = leg(warm=False)
+
+    # w_anchor=0 cold leg: inert anchor machinery leaves the solver
+    # stream byte-identical to a plain solve of the edited instance
+    edited, _emap = editsolve.apply_ops(p, ops)
+
+    def solver_stream(buf):
+        keep = ("logEntry", "solution", "runEntry")
+        out = []
+        for line in buf.getvalue().splitlines():
+            rec = json.loads(line)
+            if next(iter(rec)) in keep:
+                out.append(rec)
+        return jsonl.strip_timing(out)
+
+    buf_a = io.StringIO()
+    svc_a = SolveService(serve_cfg(), out=buf_a)
+    svc_a.submit(edited, job_id="z", seed=3, generations=30)
+    svc_a.drive()
+    svc_a.close()
+    buf_b = io.StringIO()
+    svc_b = SolveService(serve_cfg(), out=buf_b)
+    svc_b.submit(None, job_id="z", seed=3, generations=30,
+                 edit=dict(edit_spec, w_anchor=0))
+    svc_b.drive()
+    svc_b.close()
+    identical = solver_stream(buf_a) == solver_stream(buf_b)
+
+    gens_saved = None
+    if (warm["gens_to_base_quality"] is not None
+            and cold["gens_to_base_quality"] is not None):
+        gens_saved = (cold["gens_to_base_quality"]
+                      - warm["gens_to_base_quality"])
+    return {"base_best": base_best, "base_gens": base_gens,
+            "warm": warm, "cold": cold,
+            "records_identical_w0": bool(identical),
+            "gens_to_base_saved": gens_saved}
+
+
 def measure_scrape() -> dict:
     """extra.scrape leg (ISSUE 6): the pull front's cost on a live
     serve stream.
@@ -2210,6 +2349,7 @@ def main(argv=None) -> None:
             ("fleet", measure_fleet),
             ("scale", measure_autoscale),
             ("resume", measure_resume),
+            ("edit", measure_edit),
             ("scrape", measure_scrape),
             ("scale_2000ev", measure_scale),
             ("ls_shootout", lambda: measure_ls_shootout(problem)),

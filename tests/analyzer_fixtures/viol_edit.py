@@ -1,0 +1,61 @@
+"""TT309 fixture: edit-solve work on the dispatch path / in traces.
+
+Not imported or executed — parsed by tests/test_analysis.py (the test
+config adds this file to `dispatch-modules` so the loop half fires).
+tt-edit's contract (serve/editsolve.py): diff/apply, anchor
+attachment, and the population transplant are ADMISSION-TIME host
+work — they run once at the submit/prepare seam
+(Scheduler.prepare_edit), never per dispatch quantum and never inside
+a compiled region.
+"""
+import functools
+
+import jax
+
+from timetabling_ga_tpu.serve import editsolve
+from timetabling_ga_tpu.serve.editsolve import transplant as warm_start
+
+
+def dispatch_loop(jobs, base, wire, runner, state):
+    for job in jobs:
+        edited, emap = editsolve.apply_ops(base, job.ops)  # EXPECT TT309
+        job.resume_wire = warm_start(                      # EXPECT TT309
+            edited, emap, wire, bucket=job.bucket,
+            pop_size=16, seed=job.seed)
+        state = runner(state, job)
+    return state
+
+
+def drain_until_idle(queue, base, edited):
+    while queue.busy():
+        ops, emap = editsolve.diff_problems(base, edited)  # EXPECT TT309
+        queue.tick(ops, emap)
+
+
+@jax.jit
+def traced_edit(x, base, edited):
+    editsolve.diff_problems(base, edited)                  # EXPECT TT309
+    return x * 2
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def traced_anchor(x, spec):
+    editsolve.parse_edit_spec(spec)                        # EXPECT TT309
+    return x + 1
+
+
+def prepare_edit_is_fine(job, base_wire, cfg):
+    # OK: the admission seam — once per submitted edit, outside any
+    # loop and outside any trace (the scheduler's sanctioned lazy
+    # import looks exactly like this)
+    from timetabling_ga_tpu.serve import editsolve as es
+    base, edited, emap, _ops = es.resolve_edit(job.edit)
+    return es.transplant(edited, emap, base_wire,
+                         bucket=job.bucket, pop_size=cfg.pop_size,
+                         seed=job.seed)
+
+
+def distance_at_finalize_is_fine(snap, padded, emap):
+    # OK: one call at record finalization, not per quantum
+    return editsolve.edit_distance(snap.slots[0],
+                                   padded.anchor_slots, emap)

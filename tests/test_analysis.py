@@ -47,6 +47,7 @@ def fixture_config() -> AnalyzerConfig:
                                                          "viol_cost.py",
                                                          "viol_quality.py",
                                                          "viol_flight.py",
+                                                         "viol_edit.py",
                                                          "interproc/loop.py"]
     cfg.sharded_modules = (list(cfg.sharded_modules)
                            + ["viol_collective.py", "viol_quality.py"])
@@ -94,6 +95,8 @@ def analyze_fixture(fixture: str):
     #                        metering clocks (tt-meter)
     "viol_scale.py",       # TT608 fleet actuator calls on handler
     #                        paths / dispatcher-tick bodies (tt-scale)
+    "viol_edit.py",        # TT309 edit-solve (diff/transplant) calls
+    #                        in dispatch loops / trace targets (tt-edit)
     "viol_accord.py",      # TT307 collectives / multihost_utils in
     #                        accord modules (tt-accord side channel)
     "viol_supervisor.py",  # TT307 collectives inside *Supervisor

@@ -1,0 +1,608 @@
+"""tt-edit: incremental re-solve (ISSUE 19).
+
+The acceptance properties pinned here:
+
+  1. the anchored objective is NEUTRAL at w_anchor == 0 — anchored
+     columns of zero weight evaluate bit-identically to the unanchored
+     objective, per individual, and a w_anchor=0 edit job's solver
+     record stream is identical to a plain solve of the edited
+     instance;
+  2. anchored evaluation is bucket-padding-exact: padded and unpadded
+     instances agree on (penalty, hcv, scv) bit-for-bit on the
+     committed ITC fixtures, anchor term included;
+  3. delta/sweep acceptance agrees with full (host-recomputable)
+     evaluation under an anchored problem;
+  4. the transplant carries base genes exactly on every warm path and
+     DEMOTES (never errors) on every cold obstacle;
+  5. the service surface: an edit job's result/records carry
+     mode/edit_distance/edit_of, and `tt stats` / `tt usage` split
+     edit traffic out without changing non-edit rendering.
+"""
+
+import dataclasses
+import io
+import json
+import os
+
+import numpy as np
+import jax
+import pytest
+
+from timetabling_ga_tpu.obs import metrics as obs_metrics
+from timetabling_ga_tpu.obs import logstats
+from timetabling_ga_tpu.obs import usage as obs_usage
+from timetabling_ga_tpu.obs.metrics import MetricsRegistry
+from timetabling_ga_tpu.ops import delta, fitness, ga, local_search, sweep
+from timetabling_ga_tpu.problem import (
+    dump_tim, load_tim, load_tim_file, random_instance)
+from timetabling_ga_tpu.runtime import jsonl
+from timetabling_ga_tpu.runtime.config import ServeConfig
+from timetabling_ga_tpu.serve import BucketSpec, JobState, bucket_key, \
+    pad_problem
+from timetabling_ga_tpu.serve import editsolve
+from timetabling_ga_tpu.serve import snapshot as snapshot_mod
+from timetabling_ga_tpu.serve.bucket import embed_population
+from timetabling_ga_tpu.serve.editsolve import EditDemoted, EditError
+from timetabling_ga_tpu.serve.service import SolveService
+
+FIXTURES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "fixtures")
+SPEC = BucketSpec()
+
+
+def _cfg(**kw):
+    kw.setdefault("backend", "cpu")
+    kw.setdefault("lanes", 2)
+    kw.setdefault("quantum", 10)
+    kw.setdefault("pop_size", 6)
+    kw.setdefault("max_steps", 8)
+    return ServeConfig(**kw)
+
+
+def _records(buf):
+    return [json.loads(x) for x in buf.getvalue().splitlines()]
+
+
+def _base_problem(seed=11, n_events=10):
+    return random_instance(seed, n_events=n_events, n_rooms=3,
+                           n_features=2, n_students=8,
+                           attend_prob=0.2)
+
+
+def _anchored(p, w, seed=0):
+    """p with a random anchor attached to every event at weight w
+    (identity event map — the pure-objective tests don't need an
+    actual edit)."""
+    rng = np.random.default_rng(seed)
+    anchor = rng.integers(0, p.n_slots, size=p.n_events).astype(
+        np.int32)
+    return editsolve.attach_anchor(
+        p, np.arange(p.n_events, dtype=np.int32), anchor, w), anchor
+
+
+# ------------------------------------------------------------- .tim codec
+
+@pytest.mark.parametrize("name", ["comp01s", "comp05s"])
+def test_to_tim_roundtrip_fixture(name):
+    p = load_tim_file(os.path.join(FIXTURES, f"{name}.tim"))
+    q = load_tim(p.to_tim())
+    np.testing.assert_array_equal(p.attends, q.attends)
+    np.testing.assert_array_equal(p.room_size, q.room_size)
+    np.testing.assert_array_equal(p.room_features, q.room_features)
+    np.testing.assert_array_equal(p.event_features, q.event_features)
+    assert (p.n_days, p.slots_per_day) == (q.n_days, q.slots_per_day)
+    # canonical: serializing the round-tripped problem is a fixpoint
+    assert q.to_tim() == p.to_tim()
+
+
+def test_to_tim_roundtrip_random():
+    p = _base_problem()
+    q = load_tim(p.to_tim(), n_days=p.n_days,
+                 slots_per_day=p.slots_per_day)
+    np.testing.assert_array_equal(p.attends, q.attends)
+    np.testing.assert_array_equal(p.possible, q.possible)
+
+
+# ---------------------------------------------------------- spec + differ
+
+def test_parse_edit_spec_rejections():
+    ok = {"base": {"tim": "x"}, "ops": []}
+    assert editsolve.parse_edit_spec(ok) is ok
+    for bad in (
+            "nope",                                     # not an object
+            {"ops": []},                                # no base
+            {"base": {}, "ops": [], "edited": {}},      # both forms
+            {"base": {}},                               # neither form
+            {"base": {}, "ops": [{"op": "explode"}]},   # unknown op
+            {"base": {}, "ops": "add"},                 # ops not a list
+            {"base": {}, "ops": [], "w_anchor": -1},    # negative w
+            {"base": {}, "ops": [], "w_anchor": "z"},   # non-int w
+    ):
+        with pytest.raises(EditError):
+            editsolve.parse_edit_spec(bad)
+
+
+def test_apply_ops_event_map_and_arrays():
+    p = _base_problem()
+    E = p.n_events
+    edited, emap = editsolve.apply_ops(p, [
+        {"op": "add_event", "students": [0, 3], "features": [1]},
+        {"op": "remove_event", "event": 2},
+        {"op": "set_attendance", "event": 0, "student": 5, "value": 1},
+        {"op": "set_room_size", "room": 1, "size": 1},
+        {"op": "set_room_features", "room": 0, "features": [0, 1]},
+        {"op": "set_event_features", "event": 1, "features": []},
+    ])
+    # map: original events minus #2, then the added event as -1
+    assert emap.tolist() == [0, 1] + list(range(3, E)) + [-1]
+    assert edited.n_events == E       # +1 added, -1 removed
+    assert edited.attends[5, 0] == 1
+    assert int(edited.room_size[1]) == 1
+    assert edited.room_features[0].tolist() == [1, 1]
+    assert edited.event_features[1].sum() == 0
+    new_col = edited.attends[:, -1]
+    assert np.flatnonzero(new_col).tolist() == [0, 3]
+    # applicability errors are EditError, not crashes
+    with pytest.raises(EditError):
+        editsolve.apply_ops(p, [{"op": "remove_event", "event": E}])
+    with pytest.raises(EditError):
+        editsolve.apply_ops(p, [{"op": "set_attendance", "event": 0,
+                                 "student": 99, "value": 1}])
+    with pytest.raises(EditError):
+        editsolve.apply_ops(
+            p, [{"op": "remove_event", "event": 0}] * E)  # empties
+
+
+def test_diff_problems_recovers_apply_ops():
+    """diff(base, apply_ops(base, ops)) yields ops that rebuild the
+    same edited instance (positional convention: trailing adds)."""
+    p = _base_problem(seed=21)
+    edited, emap = editsolve.apply_ops(p, [
+        {"op": "set_attendance", "event": 1, "student": 2, "value": 1},
+        {"op": "set_room_size", "room": 0, "size": 2},
+        {"op": "add_event", "students": [4], "features": [0]},
+    ])
+    ops2, emap2 = editsolve.diff_problems(p, edited)
+    assert emap2.tolist() == emap.tolist()
+    rebuilt, _ = editsolve.apply_ops(p, ops2)
+    np.testing.assert_array_equal(rebuilt.attends, edited.attends)
+    np.testing.assert_array_equal(rebuilt.room_size, edited.room_size)
+    np.testing.assert_array_equal(rebuilt.event_features,
+                                  edited.event_features)
+    np.testing.assert_array_equal(rebuilt.room_features,
+                                  edited.room_features)
+
+    # shrinking edit: trailing removes, reported in the map as absent
+    shrunk, smap = editsolve.apply_ops(
+        p, [{"op": "remove_event", "event": p.n_events - 1}])
+    ops3, smap3 = editsolve.diff_problems(p, shrunk)
+    assert smap3.tolist() == smap.tolist()
+    assert {"op": "remove_event", "event": p.n_events - 1} in ops3
+
+    # axis mismatches refuse to diff rather than guess
+    other = random_instance(5, n_events=p.n_events, n_rooms=4,
+                            n_features=2, n_students=8,
+                            attend_prob=0.2)
+    with pytest.raises(EditError):
+        editsolve.diff_problems(p, other)
+
+
+# ----------------------------------------------------- anchored objective
+
+@pytest.mark.parametrize("name", ["comp01s", "comp05s"])
+def test_anchored_penalty_padded_bit_exact(name):
+    """ISSUE 19 acceptance: the anchored penalty is bit-exact padded
+    vs unpadded (the anchor term rides the padding neutrality contract
+    through zero weights on padded events)."""
+    p = load_tim_file(os.path.join(FIXTURES, f"{name}.tim"))
+    ap, anchor = _anchored(p, w=3, seed=1)
+    pp = pad_problem(ap, SPEC)
+    rng = np.random.default_rng(7)
+    P = 4
+    slots = rng.integers(0, p.n_slots, size=(P, p.n_events)).astype(
+        np.int32)
+    rooms = rng.integers(0, p.n_rooms, size=(P, p.n_events)).astype(
+        np.int32)
+    s_pad, r_pad = embed_population(slots, rooms, pp)
+
+    pen, hcv, scv = fitness.batch_penalty(ap.device_arrays(), slots,
+                                          rooms)
+    pen2, hcv2, scv2 = fitness.batch_penalty(pp.device_arrays(),
+                                             s_pad, r_pad)
+    np.testing.assert_array_equal(np.asarray(pen), np.asarray(pen2))
+    np.testing.assert_array_equal(np.asarray(hcv), np.asarray(hcv2))
+    np.testing.assert_array_equal(np.asarray(scv), np.asarray(scv2))
+
+    # host recompute: penalty == base + w * Hamming(slots, anchor),
+    # and hcv/scv are pure constraint counts (anchor never leaks)
+    pen0, hcv0, scv0 = fitness.batch_penalty(p.device_arrays(), slots,
+                                             rooms)
+    np.testing.assert_array_equal(np.asarray(hcv), np.asarray(hcv0))
+    np.testing.assert_array_equal(np.asarray(scv), np.asarray(scv0))
+    ham = (slots != anchor[None, :]).sum(axis=1)
+    np.testing.assert_array_equal(
+        np.asarray(pen), np.asarray(pen0) + 3 * ham)
+
+
+def test_anchor_w_zero_is_bit_identical():
+    p = _base_problem(seed=31)
+    ap, _anchor = _anchored(p, w=0, seed=2)
+    rng = np.random.default_rng(3)
+    slots = rng.integers(0, p.n_slots, size=(8, p.n_events)).astype(
+        np.int32)
+    rooms = rng.integers(0, p.n_rooms, size=(8, p.n_events)).astype(
+        np.int32)
+    for a, b in zip(
+            fitness.batch_penalty(p.device_arrays(), slots, rooms),
+            fitness.batch_penalty(ap.device_arrays(), slots, rooms)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_anchor_cost_and_delta_consistent():
+    p = _base_problem(seed=41)
+    ap, anchor = _anchored(p, w=2, seed=4)
+    pa = ap.device_arrays()
+    rng = np.random.default_rng(5)
+    slots = rng.integers(0, p.n_slots, size=p.n_events).astype(
+        np.int32)
+    cost = int(fitness.anchor_cost(pa, slots))
+    assert cost == 2 * int(np.sum(slots != anchor))
+    # sparse move delta == recompute difference, with inactive lanes
+    # (new == old) contributing exactly 0
+    evs = np.array([0, 3, 3], np.int32)       # repeated event: the
+    #                                           padding convention
+    new = np.array([anchor[0], (slots[3] + 1) % p.n_slots, slots[3]],
+                   np.int32)
+    moved = slots.copy()
+    moved[0] = anchor[0]
+    moved[3] = (slots[3] + 1) % p.n_slots
+    d = int(fitness.anchor_delta(pa, slots, evs, new))
+    # the repeated lane passes new == old for the FINAL value; delta
+    # is defined per-lane against the pre-move slots, so compare
+    # against the two real moves only
+    real = (int(fitness.anchor_cost(pa, moved))
+            - int(fitness.anchor_cost(pa, slots)))
+    assert d == real
+
+
+@pytest.mark.parametrize("w", [0, 2])
+def test_delta_ls_agrees_with_full_ls_anchored(w):
+    """Acceptance: delta-path acceptance (residual arithmetic) and the
+    full-reevaluation path make identical decisions under an anchored
+    problem — same final populations, bit for bit."""
+    p = _base_problem(seed=51, n_events=12)
+    ap, _ = _anchored(p, w=w, seed=6)
+    pa = ap.device_arrays()
+    st = ga.init_population(pa, jax.random.key(0), 8)
+    key = jax.random.key(42)
+    s_full, r_full = local_search.batch_local_search(
+        pa, key, st.slots, st.rooms, n_rounds=10, n_candidates=4)
+    s_dlt, r_dlt = delta.batch_local_search_delta(
+        pa, key, st.slots, st.rooms, n_rounds=10, n_candidates=4)
+    np.testing.assert_array_equal(np.asarray(s_full),
+                                  np.asarray(s_dlt))
+    np.testing.assert_array_equal(np.asarray(r_full),
+                                  np.asarray(r_dlt))
+
+
+def test_sweep_anchored_neutral_at_zero_and_consistent():
+    p = _base_problem(seed=61, n_events=12)
+    ap0, _ = _anchored(p, w=0, seed=7)
+    ap2, _ = _anchored(p, w=2, seed=7)
+    st = ga.init_population(p.device_arrays(), jax.random.key(1), 8)
+    key = jax.random.key(9)
+    s_plain, r_plain = sweep.sweep_local_search(
+        p.device_arrays(), key, st.slots, st.rooms, n_sweeps=3)
+    s_zero, r_zero = sweep.sweep_local_search(
+        ap0.device_arrays(), key, st.slots, st.rooms, n_sweeps=3)
+    np.testing.assert_array_equal(np.asarray(s_plain),
+                                  np.asarray(s_zero))
+    np.testing.assert_array_equal(np.asarray(r_plain),
+                                  np.asarray(r_zero))
+    # w > 0: the sweep's maintained acceptance never drifts from the
+    # host-recomputable anchored objective (monotone non-increase)
+    pa2 = ap2.device_arrays()
+    pen0, _, _ = fitness.batch_penalty(pa2, st.slots, st.rooms)
+    s2, r2 = sweep.sweep_local_search(pa2, key, st.slots, st.rooms,
+                                      n_sweeps=3)
+    pen1, _, _ = fitness.batch_penalty(pa2, s2, r2)
+    assert (np.asarray(pen1) <= np.asarray(pen0)).all()
+
+
+# ------------------------------------------------------------- transplant
+
+def _wire_for(padded, pop_size=6, seed=5, bucket=None):
+    pa = padded.device_arrays()
+    st = ga.init_population(pa, jax.random.key(seed), pop_size)
+    st = ga.PopState(slots=np.asarray(st.slots),
+                     rooms=np.asarray(st.rooms),
+                     penalty=np.asarray(st.penalty),
+                     hcv=np.asarray(st.hcv), scv=np.asarray(st.scv))
+    return st, snapshot_mod.pack_state(
+        st, bucket=bucket, pop_size=pop_size, seed=seed, gens_done=9,
+        chunks=3, emitted=123, best=123)
+
+
+def test_transplant_warm_carries_base_genes():
+    p = _base_problem(seed=71)
+    bucket = bucket_key(p, SPEC)
+    base_padded = pad_problem(p, SPEC)
+    base_st, wire = _wire_for(base_padded, bucket=bucket)
+    wire = json.loads(json.dumps(wire))        # the /v1 wire form
+
+    edited, emap = editsolve.apply_ops(p, [
+        {"op": "add_event", "students": [1], "features": []},
+        {"op": "remove_event", "event": 2},
+        {"op": "set_attendance", "event": 0, "student": 4,
+         "value": 1},
+    ])
+    assert bucket_key(edited, SPEC) == bucket  # same-bucket edit
+    ep = pad_problem(edited, SPEC)
+    out = editsolve.transplant(ep, emap, wire, bucket=bucket,
+                               pop_size=6, seed=77)
+    state, meta = snapshot_mod.unpack_state(wire=out)
+    # cursors reset: the edit job's record stream starts clean
+    assert meta["gens_done"] == 0 and meta["chunks"] == 0
+    assert meta["emitted"] == meta["best"] == 2**31 - 1
+    slots = np.asarray(state.slots)
+    rooms = np.asarray(state.rooms)
+    live = ep.n_live_events
+    carried = np.flatnonzero(emap >= 0)
+    # the transplant lex-sorts the population under the edited
+    # problem, so rows come back PERMUTED: compare as row sets
+    got = sorted(map(tuple, np.concatenate(
+        [slots[:, carried], rooms[:, carried]], axis=1)))
+    want = sorted(map(tuple, np.concatenate(
+        [base_st.slots[:, emap[carried]],
+         base_st.rooms[:, emap[carried]]], axis=1)))
+    assert got == want
+    fresh = np.flatnonzero(emap < 0)
+    assert ((slots[:, fresh] >= 0)
+            & (slots[:, fresh] < p.n_slots)).all()
+    assert (rooms[:, fresh] == 0).all()
+    # re-evaluated under the EDITED instance and lex-sorted
+    pen, hcv, scv = fitness.batch_penalty(ep.device_arrays(),
+                                          slots[:, :],
+                                          rooms[:, :])
+    np.testing.assert_array_equal(np.asarray(pen), state.penalty)
+    order = np.asarray(fitness.lex_order(pen, scv))
+    assert order.tolist() == list(range(6))    # already sorted
+    assert live == edited.n_events
+
+
+def test_transplant_demotions():
+    p = _base_problem(seed=81)
+    bucket = bucket_key(p, SPEC)
+    _st, wire = _wire_for(pad_problem(p, SPEC), bucket=bucket)
+    edited, emap = editsolve.apply_ops(
+        p, [{"op": "set_room_size", "room": 0, "size": 1}])
+    ep = pad_problem(edited, SPEC)
+
+    with pytest.raises(EditDemoted):           # no base snapshot
+        editsolve.transplant(ep, emap, None, bucket=bucket,
+                             pop_size=6, seed=1)
+    other = tuple(list(bucket[:-1]) + [bucket[-1] + 1])
+    with pytest.raises(EditDemoted):           # cross-bucket
+        editsolve.transplant(ep, emap, wire, bucket=other,
+                             pop_size=6, seed=1)
+    with pytest.raises(EditDemoted):           # population mismatch
+        editsolve.transplant(ep, emap, wire, bucket=bucket,
+                             pop_size=12, seed=1)
+    cut = dict(wire, npz=wire["npz"][: len(wire["npz"]) // 2])
+    with pytest.raises(EditDemoted):           # undecodable wire
+        editsolve.transplant(ep, emap, cut, bucket=bucket,
+                             pop_size=6, seed=1)
+    # classify mirrors the same warm/cold rule
+    assert editsolve.classify(bucket, wire)
+    assert not editsolve.classify(other, wire)
+    assert not editsolve.classify(bucket, None)
+
+
+def test_edit_distance_counts_carried_moves_only():
+    anchor = np.array([1, 2, 3, 4], np.int32)
+    emap = np.array([0, -1, 2, 3], np.int32)   # event 1 is new
+    final = np.array([1, 9, 9, 4], np.int32)
+    # event 0 kept, event 1 NEW (ignored), event 2 moved, event 3 kept
+    assert editsolve.edit_distance(final, anchor, emap) == 1
+    assert editsolve.edit_distance(final, None, emap) is None
+    assert editsolve.edit_distance(final, anchor, None) is None
+
+
+# ------------------------------------------------------------ service e2e
+
+def test_service_edit_end_to_end_warm():
+    reg = obs_metrics.REGISTRY
+    before_edit = reg.counter("serve.jobs_edit").value
+    before_dem = reg.counter("serve.jobs_edit_demoted").value
+
+    p = _base_problem(seed=91)
+    buf = io.StringIO()
+    svc = SolveService(_cfg(), out=buf)
+    svc.submit(p, job_id="base", seed=5, generations=40)
+    svc.step()
+    svc.scheduler.flush_resident("ship")
+    wire = json.loads(json.dumps(svc.queue.get("base").ship.pack()))
+    svc.drive()
+
+    ops = [{"op": "add_event", "students": [2], "features": []},
+           {"op": "set_attendance", "event": 1, "student": 3,
+            "value": 1}]
+    svc.submit(None, job_id="ed", seed=6, generations=10,
+               edit={"base": {"tim": dump_tim(p)}, "base_id": "base",
+                     "ops": ops, "snapshot": wire, "w_anchor": 1})
+    svc.drive()
+    svc.close()
+    assert svc.state("ed") == JobState.DONE
+
+    res = svc.result("ed")
+    assert res["mode"] == "edit"
+    assert res["edit_of"] == "base"
+    assert res["edit_demoted"] is False
+    assert isinstance(res["edit_distance"], int)
+    # ISSUE 19 acceptance: the same-bucket path never demotes
+    assert reg.counter("serve.jobs_edit").value == before_edit + 1
+    assert reg.counter("serve.jobs_edit_demoted").value == before_dem
+
+    recs = _records(buf)
+    evs = {r["jobEntry"]["event"]: r["jobEntry"] for r in recs
+           if "jobEntry" in r and r["jobEntry"]["job"] == "ed"}
+    assert evs["admitted"]["mode"] == "edit"
+    assert evs["admitted"]["edit_of"] == "base"
+    assert evs["done"]["mode"] == "edit"
+    assert evs["done"]["edit_distance"] == res["edit_distance"]
+    assert "demoted" not in evs["admitted"]
+
+    # tt stats reads the same stream: edit jobs get their own row
+    text = logstats.summarize(recs)
+    assert "[edit]" in text
+    assert "edit: 1 jobs" in text
+    assert "edit_distance" in text
+
+
+def test_edit_w_zero_cold_stream_identical_to_plain_solve():
+    """ISSUE 19 acceptance: a w_anchor=0 edit with no base snapshot
+    (the demoted/cold leg) produces a solver record stream identical
+    to a plain solve of the edited instance — the anchored machinery
+    is invisible when inert."""
+    p = _base_problem(seed=101)
+    ops = [{"op": "set_attendance", "event": 0, "student": 1,
+            "value": 1},
+           {"op": "set_room_size", "room": 2, "size": 3}]
+    edited, _ = editsolve.apply_ops(p, ops)
+
+    def solver_stream(buf):
+        keep = ("logEntry", "solution", "runEntry")
+        return jsonl.strip_timing(
+            [r for r in _records(buf) if next(iter(r)) in keep])
+
+    buf_a = io.StringIO()
+    svc_a = SolveService(_cfg(), out=buf_a)
+    svc_a.submit(edited, job_id="j", seed=9, generations=12)
+    svc_a.drive()
+    svc_a.close()
+
+    buf_b = io.StringIO()
+    svc_b = SolveService(_cfg(), out=buf_b)
+    svc_b.submit(None, job_id="j", seed=9, generations=12,
+                 edit={"base": {"tim": dump_tim(p)}, "ops": ops,
+                       "w_anchor": 0})
+    svc_b.drive()
+    svc_b.close()
+    assert svc_b.result("j")["edit_demoted"] is True
+
+    assert solver_stream(buf_a) == solver_stream(buf_b)
+
+
+# ----------------------------------------------------------- obs surface
+
+def test_usage_entry_mode_tag_is_additive():
+    buf = io.StringIO()
+    ledger = obs_usage.UsageLedger(registry=MetricsRegistry(),
+                                   out=buf)
+    ledger.final("e1", "acme", {"gens": 5.0}, mode="edit")
+    ledger.final("p1", "acme", {"gens": 7.0}, mode="solve")
+    ledger.final("p2", "acme", {"gens": 2.0})
+    ledger.drain()
+    totals = {}
+    for rec in _records(buf):
+        body = rec.get("usageEntry", {})
+        if body.get("event") == "total":
+            totals[body["job"]] = body
+    assert totals["e1"]["mode"] == "edit"
+    # default-mode records keep the pre-edit shape byte-for-byte
+    assert "mode" not in totals["p1"]
+    assert "mode" not in totals["p2"]
+    # and the fold treats the tag as additive metadata
+    text = obs_usage.summarize_entries(_records(buf))
+    assert "acme" in text
+
+
+def test_stats_edit_row_rendering():
+    recs = [
+        {"jobEntry": {"job": "e1", "event": "admitted",
+                      "mode": "edit", "edit_of": "b1"}},
+        {"jobEntry": {"job": "e1", "event": "done", "mode": "edit",
+                      "edit_distance": 3, "best": 5, "gens": 10}},
+        {"solution": {"job": "e1", "totalBest": 5, "feasible": True,
+                      "totalTime": 1.25}},
+        {"jobEntry": {"job": "e2", "event": "admitted",
+                      "mode": "edit", "demoted": True}},
+        {"jobEntry": {"job": "e2", "event": "done", "mode": "edit",
+                      "best": 9, "gens": 10}},
+        {"solution": {"job": "e2", "totalBest": 9, "feasible": True,
+                      "totalTime": 2.5}},
+        {"jobEntry": {"job": "s1", "event": "admitted"}},
+        {"jobEntry": {"job": "s1", "event": "done", "best": 7,
+                      "gens": 4}},
+    ]
+    text = logstats.summarize(recs)
+    assert "e1 [edit]:" in text
+    assert "e2 [edit, demoted]:" in text
+    assert "edit: 2 jobs (1 demoted)" in text
+    assert "edit_distance p50 3 max 3" in text
+    # the plain job's line keeps the legacy shape
+    assert "s1: admitted->done" in text
+
+
+# ------------------------------------------------- fleet: settled base
+
+
+def test_gateway_edit_of_settled_base_warm_starts():
+    """`--edit-of` a base job that already SETTLED at the gateway
+    (payload released, snapshot cache dropped) still warm-starts:
+    the gateway resolves the instance from the retained edit basis
+    and grabs the base's FINAL ship unit live from its owner — the
+    replica keeps a terminal job's ship unit exactly for this."""
+    import time
+
+    from timetabling_ga_tpu.fleet.gateway import Gateway
+    from timetabling_ga_tpu.fleet.replicas import (
+        http_json, in_process_replica)
+    from timetabling_ga_tpu.runtime.config import FleetConfig
+
+    p = _base_problem(seed=23, n_events=12)
+    rep, handle = in_process_replica(
+        _cfg(http="127.0.0.1:0", quantum=5), "ed0")
+    gw = Gateway(FleetConfig(replicas=[handle.url],
+                             listen="127.0.0.1:0", probe_every=0.1,
+                             poll_every=0.05, dead_after=2),
+                 [handle]).start()
+    try:
+        http_json("POST", gw.url + "/v1/solve",
+                  {"tim": dump_tim(p), "id": "gb", "seed": 3,
+                   "generations": 10})
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            base = gw.jobs.get("gb")
+            if (base is not None and base.state == "done"
+                    and base.payload is None):     # settled: released
+                break
+            time.sleep(0.05)
+        base = gw.jobs["gb"]
+        assert base.state == "done" and base.payload is None
+        assert base.edit_basis is not None and "tim" in base.edit_basis
+        assert base.snap is None                   # cache share dropped
+
+        ops = [{"op": "set_attendance", "event": 1, "student": 0,
+                "value": 1}]
+        http_json("POST", gw.url + "/v1/solve",
+                  {"id": "ge", "seed": 4, "generations": 10,
+                   "edit": {"base": "gb", "ops": ops, "w_anchor": 1}})
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            view = http_json("GET", gw.url + "/v1/jobs/ge",
+                             ok=(200,))
+            if view["state"] in ("done", "failed"):
+                break
+            time.sleep(0.05)
+        assert view["state"] == "done", view.get("error")
+        res = view["result"]
+        assert res["mode"] == "edit"
+        assert res["edit_of"] == "gb"
+        # the warm path: the live final-wire grab made the transplant
+        # possible — no demotion
+        assert res["edit_demoted"] is False
+        assert isinstance(res["edit_distance"], int)
+    finally:
+        gw.close()
+        rep.kill()

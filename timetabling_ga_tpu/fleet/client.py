@@ -54,6 +54,29 @@ submit one instance to a fleet gateway (or a single replica) and wait:
                         progress instead of generation 0; the file is
                         a GET /v1/jobs/<id>?snapshot=1 view's
                         "snapshot" object, or the object itself
+                        (with --edit-of it is the BASE job's snapshot
+                        to transplant from instead of the gateway's
+                        cached/fetched one)
+  --edit-of <job id>    incremental re-solve (tt-edit, README
+                        "Incremental re-solve"): submit INSTANCE.tim
+                        as an EDIT of the named base job — the
+                        gateway resolves the base instance and its
+                        freshest snapshot, the replica diffs the two,
+                        transplants the base population onto the
+                        edited instance, and solves under the
+                        anchored objective; the result carries
+                        `edit_distance` (events moved vs the base
+                        solution)
+  --edit-ops <path>     JSON op list (the serve/editsolve.py grammar:
+                        add_event / remove_event / set_attendance /
+                        set_event_features / set_room_size /
+                        set_room_features) applied to the base
+                        instead of a full edited instance — INSTANCE
+                        may then be '-'
+  --anchor-weight <int> soft penalty per carried event placed away
+                        from the base solution's slot (default 1;
+                        0 = solve the plain objective, bit-identical
+                        to an unanchored stream)
   --no-wait             print the job id and exit without polling
   -h, --help            show this message and exit"""
 
@@ -112,6 +135,9 @@ def main_submit(argv) -> int:
                   "-s": ("seed", int),
                   "--generations": ("generations", int),
                   "--deadline": ("deadline", float)}
+    edit_of = None
+    edit_ops = None
+    anchor_w = None
     while i < len(rest):
         a = rest[i]
         if a in ("-h", "--help"):
@@ -149,6 +175,44 @@ def main_submit(argv) -> int:
             payload["snapshot"] = snap
             i += 2
             continue
+        if a == "--edit-of":
+            if i + 1 >= len(rest):
+                print("flag --edit-of needs a value", file=sys.stderr)
+                return 2
+            edit_of = rest[i + 1]
+            i += 2
+            continue
+        if a == "--edit-ops":
+            if i + 1 >= len(rest):
+                print("flag --edit-ops needs a value",
+                      file=sys.stderr)
+                return 2
+            try:
+                with open(rest[i + 1], "r", encoding="utf-8") as fh:
+                    ops = json.load(fh)
+            except (OSError, ValueError) as e:
+                print(f"tt submit: bad edit-ops file: {e}",
+                      file=sys.stderr)
+                return 2
+            # accept the bare op list or an {"ops": [...]} wrapper
+            if isinstance(ops, dict) and "ops" in ops:
+                ops = ops["ops"]
+            edit_ops = ops
+            i += 2
+            continue
+        if a == "--anchor-weight":
+            if i + 1 >= len(rest):
+                print("flag --anchor-weight needs a value",
+                      file=sys.stderr)
+                return 2
+            try:
+                anchor_w = int(rest[i + 1])
+            except ValueError:
+                print(f"flag --anchor-weight wants int, got "
+                      f"{rest[i + 1]!r}", file=sys.stderr)
+                return 2
+            i += 2
+            continue
         if a == "--no-wait":
             wait = False
             i += 1
@@ -184,9 +248,37 @@ def main_submit(argv) -> int:
                   f"{rest[i + 1]!r}", file=sys.stderr)
             return 2
         i += 2
+    if edit_ops is not None and edit_of is None:
+        print("--edit-ops needs --edit-of", file=sys.stderr)
+        return 2
     try:
-        with open(instance, "r") as fh:
-            payload["tim"] = fh.read()
+        tim_text = None
+        if instance != "-":
+            with open(instance, "r") as fh:
+                tim_text = fh.read()
+        if edit_of is not None:
+            edit: dict = {"base": edit_of}
+            if edit_ops is not None:
+                edit["ops"] = edit_ops
+            elif tim_text is not None:
+                edit["edited"] = {"tim": tim_text}
+            else:
+                print("tt submit: --edit-of needs an edited "
+                      "INSTANCE.tim or --edit-ops", file=sys.stderr)
+                return 2
+            if anchor_w is not None:
+                edit["w_anchor"] = anchor_w
+            if "snapshot" in payload:
+                # with --edit-of the snapshot file is the BASE job's
+                # wire to transplant from, not this job's own resume
+                edit["snapshot"] = payload.pop("snapshot")
+            payload["edit"] = edit
+        elif tim_text is not None:
+            payload["tim"] = tim_text
+        else:
+            print("tt submit: INSTANCE '-' needs --edit-of with "
+                  "--edit-ops", file=sys.stderr)
+            return 2
         view = submit_and_wait(url, payload, poll=poll,
                                timeout=timeout, wait=wait)
     except (FleetHTTPError, OSError, TimeoutError) as e:

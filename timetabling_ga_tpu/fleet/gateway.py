@@ -138,6 +138,17 @@ _PAYLOAD_KEYS = ("id", "tim", "problem", "priority", "seed",
                  # client may submit one directly (incremental
                  # re-solve warm starts ride the same seam)
                  "snapshot",
+                 # tt-edit (serve/editsolve.py; README "Incremental
+                 # re-solve"): an edit spec {"base": <job id or inline
+                 # instance>, "ops"|"edited": ..., "w_anchor": W}. The
+                 # gateway resolves a job-id base into the base
+                 # payload + its cached/fetched snapshot on the
+                 # dispatcher (_resolve_edit) before routing; the
+                 # REPLICA applies the edit, attaches the anchored
+                 # objective, and transplants the population —
+                 # diff/apply never run on the gateway (stdlib-only
+                 # discipline)
+                 "edit",
                  # tt-meter (obs/usage.py): the tenant tag rides the
                  # payload end to end — tt submit --tenant → gateway →
                  # replica → Job.tenant — so capacity attribution
@@ -164,9 +175,11 @@ def parse_solve_body(body: bytes) -> dict:
         except ValueError as e:
             raise ValueError(f"bad JSON body: {e}") from None
         payload = {k: obj[k] for k in _PAYLOAD_KEYS if k in obj}
-        if "tim" not in payload and "problem" not in payload:
+        if ("tim" not in payload and "problem" not in payload
+                and "edit" not in payload):
             raise ValueError(
-                "JSON body needs a 'tim' text or a 'problem' object")
+                "JSON body needs a 'tim' text, a 'problem' object, "
+                "or an 'edit' spec")
         return payload
     if not stripped:
         raise ValueError("empty body")
@@ -180,6 +193,9 @@ def payload_counts(payload: dict) -> tuple:
     conflict matrices would be pure waste."""
     days = int(payload.get("n_days", DAYS_DEFAULT))
     slots = int(payload.get("slots_per_day", SLOTS_PER_DAY_DEFAULT))
+    if "edit" in payload and "tim" not in payload \
+            and "problem" not in payload:
+        return edit_payload_counts(payload)
     if "problem" in payload:
         p = payload["problem"]
         try:
@@ -205,6 +221,54 @@ def payload_counts(payload: dict) -> tuple:
     if any(c < 0 for c in counts):
         raise ValueError(f"negative instance counts: {counts}")
     return counts + (days, slots)
+
+
+def edit_payload_counts(payload: dict):
+    """(E, R, F, S, n_days, slots_per_day) for an EDIT payload, or
+    None when routing counts must wait for the dispatcher to resolve
+    a job-id base (`_resolve_edit` — the handler thread must not read
+    the job table). Header-only arithmetic, stdlib throughout: an
+    inline 'edited' instance counts like any submit payload; an
+    inline base counts + per-op event deltas (only add_event /
+    remove_event change any routed dimension). Malformed specs raise
+    ValueError like every other bad payload."""
+    edit = payload.get("edit")
+    if not isinstance(edit, dict):
+        raise ValueError("'edit' must be an object")
+    if "base" not in edit:
+        raise ValueError("edit spec needs a 'base'")
+    if ("ops" in edit) == ("edited" in edit):
+        raise ValueError(
+            "edit spec needs exactly one of 'ops' or 'edited'")
+    carry = {k: payload[k] for k in ("n_days", "slots_per_day")
+             if k in payload}
+    if "edited" in edit:
+        edited = edit["edited"]
+        if not isinstance(edited, dict) or (
+                "tim" not in edited and "problem" not in edited):
+            raise ValueError("edit 'edited' needs a 'tim' text or a "
+                             "'problem' object")
+        return payload_counts({**carry, **edited})
+    ops = edit["ops"]
+    if not isinstance(ops, (list, tuple)):
+        raise ValueError("edit 'ops' must be a list")
+    base = edit["base"]
+    if isinstance(base, str):
+        return None                     # deferred: dispatcher resolves
+    if not isinstance(base, dict) or (
+            "tim" not in base and "problem" not in base):
+        raise ValueError("edit base needs a job id, a 'tim' text, or "
+                         "a 'problem' object")
+    e, r, f, s, days, slots = payload_counts({**carry, **base})
+    for op in ops:
+        kind = op.get("op") if isinstance(op, dict) else None
+        if kind == "add_event":
+            e += 1
+        elif kind == "remove_event":
+            e -= 1
+    if e <= 0:
+        raise ValueError("edit removes every event")
+    return (e, r, f, s, days, slots)
 
 
 # ---------------------------------------------------------------- handler
@@ -438,6 +502,11 @@ class GatewayJob:
         self.prefix_truncated = False  # some attached prefix was
         #                              capped: the settled stream must
         #                              carry records_truncated
+        self.edit_basis = None       # inline instance kept past settle
+        #                              (the payload is released there):
+        #                              a finished job may still become
+        #                              an edit BASE (tt-edit) — bounded
+        #                              by --retain-terminal eviction
 
     def terminal(self) -> bool:
         return self.state in TERMINAL
@@ -1139,6 +1208,11 @@ class Gateway:
                     job.flow = self.tracer.new_flow()
                 if job.place_started is None:
                     job.place_started = self.now()
+                edit = (job.payload or {}).get("edit")
+                if (isinstance(edit, dict)
+                        and isinstance(edit.get("base"), str)
+                        and not self._resolve_edit(job)):
+                    return        # _resolve_edit already failed it
                 self._place(job)
         elif kind == "cancel":
             self._cancel(cmd[1])
@@ -1159,6 +1233,80 @@ class Gateway:
                 except Exception:
                     pass       # prober/failover own an unreachable one
         # "wake" and anything else: just a loop tick
+
+    def _resolve_edit(self, job: GatewayJob) -> bool:
+        """Resolve an edit payload's job-id base on the dispatcher
+        (tt-edit; README "Incremental re-solve"): rewrite
+        `edit["base"]` from the base job's own payload (the inline
+        instance every replica can parse), remember the id in
+        `edit["base_id"]`, and attach the freshest base snapshot —
+        the client's own, the `--snapshot-hwm` cache's, or a live
+        `?snapshot=1` fetch from the base's owner. The rewritten
+        payload is CONCRETE: a failover replays it byte-stable with
+        no second resolution (the base job may be long gone by then).
+        False = the job was failed here (unknown/unusable base)."""
+        edit = dict((job.payload or {}).get("edit") or {})
+        base_id = edit.get("base")
+        if not isinstance(base_id, str):
+            return True
+        with self.jobs_lock:
+            base_job = self.jobs.get(base_id)
+        if base_job is None:
+            self._fail(job, f"edit base job {base_id!r} unknown to "
+                            f"this gateway")
+            return False
+        bp = base_job.payload or {}
+        inline = {k: bp[k] for k in ("tim", "problem", "n_days",
+                                     "slots_per_day") if k in bp}
+        if "tim" not in inline and "problem" not in inline:
+            # the base is itself an edit job: its payload holds an
+            # edit spec, not an instance — usable only when that spec
+            # shipped the full edited instance (an ops-built base
+            # would need the gateway to apply ops, which is the
+            # replica's job by layering). A SETTLED base's payload is
+            # released wholesale — its instance lives on in
+            # edit_basis until --retain-terminal evicts the job
+            base_edit = bp.get("edit") or {}
+            edited = base_edit.get("edited")
+            if isinstance(edited, dict):
+                inline = dict(edited)
+            elif base_job.edit_basis:
+                inline = dict(base_job.edit_basis)
+            else:
+                self._fail(
+                    job, f"edit base job {base_id!r} carries no "
+                         f"inline instance (an edit of an ops-built "
+                         f"edit job is not resolvable at the "
+                         f"gateway; submit the base with 'edited')")
+                return False
+        wire = edit.get("snapshot")
+        if wire is None:
+            wire = base_job.snap
+            if wire is None and base_job.replica:
+                # live grab from the base's owner (dispatcher thread,
+                # snapshot-timeout budget — same as any cache refresh);
+                # no snapshot anywhere just means the replica demotes
+                # the edit to a cold solve, counted there
+                handle = self.replicas.get(base_job.replica)
+                if handle is not None and not handle.dead:
+                    self._fetch_snapshot(base_job, handle)
+                    wire = base_job.snap
+        edit["base"] = inline
+        edit["base_id"] = base_id
+        if wire is not None:
+            edit["snapshot"] = wire
+        with self.jobs_lock:
+            job.payload = dict(job.payload, edit=edit)
+        try:
+            job.counts = payload_counts(job.payload)
+        except ValueError as e:
+            self._fail(job, str(e)[:300])
+            return False
+        if job.counts is None:
+            self._fail(job, f"edit base job {base_id!r} resolution "
+                            f"yielded no routing counts")
+            return False
+        return True
 
     def _place(self, job: GatewayJob, exclude: tuple = ()) -> None:
         """Route + submit one job, failing over across replicas until
@@ -1456,8 +1604,12 @@ class Gateway:
             # fingerprint gate either way (a bad snapshot demotes to
             # replay on arrival, never corrupts a stream)
             expect = None
-            if self.cfg.serve_args:
-                seed = int((job.payload or {}).get(
+            if self.cfg.serve_args and job.payload is not None:
+                # a SETTLED job's payload (and with it the submit
+                # seed) is released — its edit-base grab drops to the
+                # structural + bucket check below, and the replica's
+                # transplant classification stays the real gate
+                seed = int(job.payload.get(
                     "seed", self.serve_cfg.seed))
                 expect = snapshot_mod.wire_fingerprint(
                     job.bucket, self.serve_cfg.pop_size, seed)
@@ -1498,18 +1650,22 @@ class Gateway:
         return True
 
     def _evict_snapshots(self) -> None:
-        """Hold the cache under `--snapshot-hwm`: evict OLDEST-
-        PROGRESS first (the snapshot whose loss wastes the least
-        re-run). An evicted job fails over by replay — counted, never
-        silent (`fleet.resume.evictions`; the jobs fall into
+        """Hold the cache under `--snapshot-hwm`: evict SETTLED jobs'
+        snapshots first (a done base's final wire only warms future
+        edits — losing it demotes those to a counted cold solve,
+        never a lost resume), then OLDEST-PROGRESS (the snapshot
+        whose loss wastes the least re-run). An evicted job fails
+        over by replay — counted, never silent
+        (`fleet.resume.evictions`; the jobs fall into
         `fleet.resume.replays` if their failover comes)."""
         with self.jobs_lock:
             cached = [j for j in self.jobs.values()
                       if j.snap is not None]
             total = sum(j.snap_bytes for j in cached)
             while total > self.cfg.snapshot_hwm and cached:
-                victim = min(cached, key=lambda j: (j.snap_gens,
-                                                    j.submitted_t))
+                victim = min(cached, key=lambda j: (
+                    not (j.terminal() and j.records_final),
+                    j.snap_gens, j.submitted_t))
                 cached.remove(victim)
                 total -= victim.snap_bytes
                 victim.snap = None
@@ -1630,6 +1786,18 @@ class Gateway:
         job.records_final = True
         if job.finished_t is None:
             job.finished_t = self.now()
+        # a settled job may still be named as an edit BASE (tt-edit):
+        # keep just the inline instance (its edited form for an edit
+        # job) — the bulk of the payload (attached snapshots, op
+        # lists) is still released, and the basis leaves with the job
+        # at --retain-terminal eviction
+        bp = job.payload or {}
+        basis = {k: bp[k] for k in ("tim", "problem", "n_days",
+                                    "slots_per_day") if k in bp}
+        if "tim" not in basis and "problem" not in basis:
+            edited = (bp.get("edit") or {}).get("edited")
+            basis = dict(edited) if isinstance(edited, dict) else None
+        job.edit_basis = basis or None
         job.payload = None
         job.counts = None
         job.prefix = []

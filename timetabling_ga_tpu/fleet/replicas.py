@@ -597,7 +597,12 @@ class Replica:
             flow = cmd[3] if len(cmd) > 3 else 0
             resubmit = bool(cmd[4]) if len(cmd) > 4 else False
             try:
-                problem = payload_problem(payload)
+                # tt-edit: an edit payload carries no instance of its
+                # own — the service derives the edited problem from
+                # the spec (serve/editsolve.py applies ops / diffs,
+                # attaches the anchor, transplants the population)
+                problem = (None if "edit" in payload
+                           else payload_problem(payload))
                 self.svc.submit(
                     problem, job_id=job_id,
                     priority=int(payload.get("priority", 0)),
@@ -607,7 +612,8 @@ class Replica:
                     flow=flow,
                     snapshot=payload.get("snapshot"),
                     tenant=payload.get("tenant"),
-                    count_job=not resubmit)
+                    count_job=not resubmit,
+                    edit=payload.get("edit"))
                 with self.index_lock:
                     self.index.pop(job_id, None)
             except Exception as e:
@@ -686,14 +692,20 @@ class Replica:
         oldest settled jobs beyond TAIL_JOBS. Without this a
         long-running replica pins every job it ever solved in HBM —
         the exact unbounded retention the gateway's
-        --retain-terminal exists to prevent."""
+        --retain-terminal exists to prevent. The final park-fence
+        SHIP UNIT is the one reference that stays: it is host bytes
+        (npz b64 + a capped record prefix, no device arrays), and a
+        settled job may still become an edit BASE (tt-edit) — the
+        gateway's `?snapshot=1` grab of the final wire is what turns
+        an edit of a finished job into a warm transplant instead of
+        a cold demote. It leaves with the job at the TAIL_JOBS
+        forget, the same bound the record tails live under."""
         for job in list(self.svc.queue._jobs.values()):
             if job.state in TERMINAL and job.pa_dev is not None:
                 job.pa_dev = None
                 job.padded = None
                 job.problem = None
                 job.snapshot = None
-                job.ship = None
                 job.ship_records = []
                 self._reaped.append(job.id)
         while len(self._reaped) > TAIL_JOBS:

@@ -264,6 +264,9 @@ def summarize(records) -> str:
     if jobs:
         lines.append(f"== jobs ({len(jobs)})")
         lats = []
+        edit_lats = []          # mode=edit jobs, split out (tt-edit)
+        edit_demoted = 0
+        edit_dists = []
         for jid, evs in sorted(jobs.items()):
             events = [e.get("event") for e in evs]
             sol = solutions.get(f"job {jid}")
@@ -272,8 +275,21 @@ def summarize(records) -> str:
                 lats.append(lat)
             done = next((e for e in evs if e.get("event") == "done"),
                         None)
+            mode = next((e.get("mode") for e in evs
+                         if e.get("mode")), None)
+            tag = ""
+            if mode:
+                tag = f" [{mode}]"
+                if mode == "edit":
+                    if lat is not None:
+                        edit_lats.append(lat)
+                    if any(e.get("demoted") for e in evs):
+                        edit_demoted += 1
+                        tag = " [edit, demoted]"
+                    if done and done.get("edit_distance") is not None:
+                        edit_dists.append(int(done["edit_distance"]))
             lines.append(
-                f"  {jid}: {'->'.join(events)}"
+                f"  {jid}{tag}: {'->'.join(events)}"
                 + (f" best {done.get('best')} gens {done.get('gens')}"
                    if done else "")
                 + (f" latency {lat:.2f}s" if lat is not None else ""))
@@ -283,6 +299,23 @@ def summarize(records) -> str:
                                     int(q * len(lats)))])
             lines.append(f"  latency p50 {p(0.5):.2f}s "
                          f"p95 {p(0.95):.2f}s max {lats[-1]:.2f}s")
+        if edit_lats or edit_demoted:
+            # incremental re-solves get their own latency row: warm
+            # edits are the latency story tt-edit exists to improve,
+            # so averaging them into cold solves would hide it
+            edit_lats.sort()
+            parts = [f"  edit: {len(edit_lats)} jobs"
+                     + (f" ({edit_demoted} demoted)"
+                        if edit_demoted else "")]
+            if edit_lats:
+                parts.append(
+                    f"latency p50 {_pctl(edit_lats, 0.5):.2f}s "
+                    f"p95 {_pctl(edit_lats, 0.95):.2f}s")
+            if edit_dists:
+                ds = sorted(edit_dists)
+                parts.append(f"edit_distance p50 {_pctl(ds, 0.5)} "
+                             f"max {ds[-1]}")
+            lines.append(" ".join(parts))
 
     breakdown = _job_breakdown(spans)
     if breakdown:

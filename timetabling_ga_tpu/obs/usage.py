@@ -288,12 +288,17 @@ class UsageLedger:
         conservation invariant the scheduler's `split` guarantees."""
         self._push(("dispatch", payload))
 
-    def final(self, job_id: str, tenant: str, usage: dict) -> None:
+    def final(self, job_id: str, tenant: str, usage: dict,
+              mode: str = None) -> None:
         """A job settled: emit its cumulative meter as one usageEntry
         (event "total") — the authoritative per-job line `tt usage`
-        prefers when summarizing a log."""
+        prefers when summarizing a log. `mode` (tt-edit) tags
+        non-default job modes ("edit") on the record so `tt usage`
+        and `tt stats` can split edit traffic out; None/"solve" emits
+        the pre-edit record byte-identically."""
         self._push(("final", str(job_id), tenant_label(tenant),
-                    dict(usage or {})))
+                    dict(usage or {}),
+                    mode if mode and mode != "solve" else None))
 
     # -- the ledger thread ----------------------------------------------
 
@@ -377,9 +382,13 @@ class UsageLedger:
             self._reg.counter("usage.dispatches").inc()
             self._emit(dict(payload))
         elif kind == "final":
-            _, job_id, label, usage = ev
-            self._emit({"event": "total", "job": job_id,
-                        "tenant": label, **rounded(usage)})
+            _, job_id, label, usage = ev[:4]
+            mode = ev[4] if len(ev) > 4 else None
+            payload = {"event": "total", "job": job_id,
+                       "tenant": label}
+            if mode:
+                payload["mode"] = mode
+            self._emit({**payload, **rounded(usage)})
 
     def _emit(self, payload: dict) -> None:
         out = self._out

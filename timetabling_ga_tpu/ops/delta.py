@@ -223,19 +223,29 @@ def batch_local_search_delta(pa, key, slots, rooms_arr, n_rounds: int,
             evs, new_slots, active = _gen_candidate(pa, k, s, p1, p2, p3)
             d_hcv, d_scv, new_rooms = _delta_one(
                 pa, s, r, att, occ, evs, new_slots, active, cap_rank)
-            return d_hcv, d_scv, evs, new_slots, new_rooms
+            # anchored-objective delta: inactive pad lanes pass new ==
+            # old and cancel; zero-weight events contribute 0, so on
+            # unanchored instances d_anc is exactly 0
+            d_anc = fitness.anchor_delta(pa, s, evs, new_slots)
+            return d_hcv, d_scv, d_anc, evs, new_slots, new_rooms
 
         return jax.vmap(per_ind)(keys, st.slots, st.rooms, st.att, st.occ)
 
     def one_round(st, k):
         cand_keys = jax.random.split(k, n_candidates)
-        d_hcv, d_scv, evs, new_slots, new_rooms = lax.map(
+        d_hcv, d_scv, d_anc, evs, new_slots, new_rooms = lax.map(
             lambda kk: eval_candidate(kk, st), cand_keys)   # (K, P, ...)
 
+        # The maintained pen includes the anchor term (init_state uses
+        # batch_penalty); recover each individual's anchor residual
+        # exactly and carry it through the candidate penalties, so
+        # selection here agrees with fitness.compute_penalty on the
+        # SAME anchored objective.
+        anc = st.pen - fitness.base_penalty(st.hcv, st.scv)  # (P,)
         new_hcv = st.hcv[None, :] + d_hcv                   # (K, P)
         new_scv = st.scv[None, :] + d_scv
-        new_pen = jnp.where(new_hcv == 0, new_scv,
-                            fitness.INFEASIBLE_OFFSET + new_hcv)
+        new_pen = (fitness.base_penalty(new_hcv, new_scv)
+                   + anc[None, :] + d_anc)
         best = jnp.argmin(new_pen, axis=0)                  # (P,)
         ar = jnp.arange(P)
         best_pen = new_pen[best, ar]

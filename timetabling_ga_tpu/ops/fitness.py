@@ -130,15 +130,50 @@ def compute_feasible(pa, slots, rooms) -> jnp.ndarray:
     return compute_hcv(pa, slots, rooms) == 0
 
 
-def compute_penalty(pa, slots, rooms):
-    """Internal selection penalty (Solution.cpp:162-170):
-    scv if feasible else 1_000_000 + hcv.
+def base_penalty(hcv, scv):
+    """The un-anchored penalty encoding (Solution.cpp:162-170): scv if
+    feasible else 1_000_000 + hcv. Shared by compute_penalty and every
+    delta-path acceptance site, which recovers a state's anchor residual
+    as `pen - base_penalty(hcv, scv)` (exact: all integer arithmetic)."""
+    return jnp.where(hcv == 0, scv, INFEASIBLE_OFFSET + hcv)
 
-    Returns (penalty, hcv, scv) — callers almost always want the parts too.
+
+def anchor_cost(pa, slots) -> jnp.ndarray:
+    """Anchored-objective term of one individual (int32 scalar):
+    `sum_e anchor_w[e] * [slots[e] != anchor_slots[e]]` — a weighted
+    Hamming distance to the base solution (serve/editsolve.py). The
+    mask discipline rides the weights: padded and newly-added events
+    carry anchor_w == 0, so no event_mask gating is needed, and an
+    all-zero weight column makes this exactly 0 (w_anchor == 0 is
+    bit-identical to the unanchored objective)."""
+    return jnp.sum(pa.anchor_w
+                   * (slots != pa.anchor_slots).astype(jnp.int32))
+
+
+def anchor_delta(pa, slots, evs, new_slots) -> jnp.ndarray:
+    """Anchor-cost change of a sparse move: events `evs` (M,) moving from
+    `slots[evs]` to `new_slots` (M,). Inactive move lanes (padding in the
+    fixed-width move encoding, ops/delta.py) pass new == old and cancel
+    exactly; events with anchor_w == 0 contribute 0 either way."""
+    w = pa.anchor_w[evs]
+    old = slots[evs]
+    anc = pa.anchor_slots[evs]
+    return jnp.sum(w * ((new_slots != anc).astype(jnp.int32)
+                        - (old != anc).astype(jnp.int32)))
+
+
+def compute_penalty(pa, slots, rooms):
+    """Internal selection penalty (Solution.cpp:162-170) plus the
+    anchored-objective term: base_penalty(hcv, scv) + anchor_cost.
+
+    Returns (penalty, hcv, scv) — callers almost always want the parts
+    too. hcv/scv stay pure constraint counts (the anchor term never
+    leaks into reported evaluations); only the selection/acceptance
+    penalty is anchored.
     """
     hcv = compute_hcv(pa, slots, rooms)
     scv = compute_scv(pa, slots)
-    penalty = jnp.where(hcv == 0, scv, INFEASIBLE_OFFSET + hcv)
+    penalty = base_penalty(hcv, scv) + anchor_cost(pa, slots)
     return penalty, hcv, scv
 
 

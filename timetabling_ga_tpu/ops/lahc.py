@@ -126,31 +126,38 @@ def lahc_steps(pa, key, state: LahcState, n_steps,
         keys = jax.random.split(jax.random.fold_in(key, i), P)
 
         def per_walker(k, s, r, att, occ, pen, hcv, scv, hp, hs, step):
+            # anchor residual of the walker's maintained pen (exact:
+            # init_lahc's pen rides batch_penalty, which includes the
+            # anchor term; 0 on unanchored instances) — candidates carry
+            # it plus their own anchor delta so the chain accepts on the
+            # same anchored objective selection uses
+            anc = pen - fitness.base_penalty(hcv, scv)
+
             def one_cand(kc):
                 evs, new_slots, active = sample_move(pa, kc, s, p1, p2,
                                                      p3)
                 d_hcv, d_scv, new_rooms = _delta_one(
                     pa, s, r, att, occ, evs, new_slots, active,
                     cap_rank)
-                return d_hcv, d_scv, evs, new_slots, new_rooms
+                d_anc = fitness.anchor_delta(pa, s, evs, new_slots)
+                return d_hcv, d_scv, d_anc, evs, new_slots, new_rooms
 
             if k_cands > 1:
-                dh, ds, evs_k, ns_k, nr_k = jax.vmap(one_cand)(
+                dh, ds, da, evs_k, ns_k, nr_k = jax.vmap(one_cand)(
                     jax.random.split(k, k_cands))
                 ch = hcv + dh
                 cs = scv + ds
-                cp = jnp.where(ch == 0, cs,
-                               fitness.INFEASIBLE_OFFSET + ch)
+                cp = fitness.base_penalty(ch, cs) + anc + da
                 # lex-argmin over the block (exact integer arithmetic)
                 b = jnp.lexsort((cs, cp))[0]
                 evs, new_slots, new_rooms = evs_k[b], ns_k[b], nr_k[b]
                 c_hcv, c_scv, c_pen = ch[b], cs[b], cp[b]
             else:
-                d_hcv, d_scv, evs, new_slots, new_rooms = one_cand(k)
+                d_hcv, d_scv, d_anc, evs, new_slots, new_rooms = one_cand(k)
                 c_hcv = hcv + d_hcv
                 c_scv = scv + d_scv
-                c_pen = jnp.where(c_hcv == 0, c_scv,
-                                  fitness.INFEASIBLE_OFFSET + c_hcv)
+                c_pen = (fitness.base_penalty(c_hcv, c_scv)
+                         + anc + d_anc)
             v = step % Lh
             accept = (_lex_le(c_pen, c_scv, hp[v], hs[v])
                       | _lex_le(c_pen, c_scv, pen, scv))

@@ -17,6 +17,13 @@ exist here by construction).
 
 `random_move` mirrors the reference's move-type sampling (p1/p2/p3
 normalized, distinct events, uniform target slot) with threefry keys.
+
+Moves are objective-agnostic: they sample and apply relocations but
+never score them. Under the anchored objective (serve/editsolve.py) the
+anchor term is charged where moves are EVALUATED — `fitness.anchor_cost`
+in the full penalty, `fitness.anchor_delta` at every delta-acceptance
+site (ops/delta.py, ops/sweep.py, ops/lahc.py) — so nothing here changes
+and the sampled candidate streams stay bit-identical.
 """
 
 from __future__ import annotations

@@ -347,6 +347,13 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
             # Move1: all T targets
             dh1, ds1, rooms1 = _move1_sweep(pa, s, r, att, occ, e_i,
                                             cap_rank)
+            # anchored-objective delta per target slot: the pad events
+            # below keep their slots (contribute 0), and a padded pivot
+            # carries anchor_w == 0, so no `live` gating is needed
+            anc_e = pa.anchor_slots[e_i]
+            da1 = pa.anchor_w[e_i] * (
+                (jnp.arange(T, dtype=jnp.int32) != anc_e).astype(jnp.int32)
+                - (s[e_i] != anc_e).astype(jnp.int32))
             # pad events: distinct from e (and each other) so the padded
             # 3-relocation form's correlation terms stay exact
             p1 = _distinct_pad(e_i, e_i, E)
@@ -358,21 +365,21 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
             nr1 = jnp.stack([rooms1,
                              jnp.broadcast_to(r[p1], (T,)),
                              jnp.broadcast_to(r[p2], (T,))], axis=1)
-            return dh1, ds1, evs1, ns1, nr1
+            return dh1, ds1, da1, evs1, ns1, nr1
 
         def per_ind(es, s, r, att, occ):
             # (B, T), (B, T, 3), ... -> flatten candidates across block
-            dh1, ds1, evs1, ns1, nr1 = jax.vmap(
+            dh1, ds1, da1, evs1, ns1, nr1 = jax.vmap(
                 lambda e_i: per_e(e_i, s, r, att, occ))(es)
-            return (dh1.reshape(-1), ds1.reshape(-1),
+            return (dh1.reshape(-1), ds1.reshape(-1), da1.reshape(-1),
                     evs1.reshape(-1, 3), ns1.reshape(-1, 3),
                     nr1.reshape(-1, 3))
 
         # Move1 sweep for every individual
-        dh1, ds1, evs1, ns1, nr1 = jax.vmap(per_ind)(
+        dh1, ds1, da1, evs1, ns1, nr1 = jax.vmap(per_ind)(
             e_blk, st.slots, st.rooms, st.att, st.occ)
 
-        cand_dh, cand_ds = dh1, ds1                        # (P, B*T)
+        cand_dh, cand_ds, cand_da = dh1, ds1, da1          # (P, B*T)
         cand_evs, cand_ns, cand_nr = evs1, ns1, nr1        # (P, B*T, 3)
 
         if swap_block > 0:
@@ -401,21 +408,23 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
                 active = jnp.array([True, True, False])
                 dh, ds, nr = _delta_one(pa, s, r, att, occ, evs, ns,
                                         active, cap_rank)
+                da = fitness.anchor_delta(pa, s, evs, ns)
                 dh = jnp.where(q == e_i, BIG, dh)
-                return dh, ds, evs, ns, nr
+                return dh, ds, da, evs, ns, nr
 
             def swaps_per_ind(es, qss, s, r, att, occ):
-                dh, ds, evs, ns, nr = jax.vmap(jax.vmap(
+                dh, ds, da, evs, ns, nr = jax.vmap(jax.vmap(
                     lambda e_i, q: swap_one(e_i, q, s, r, att, occ)))(
                         jnp.broadcast_to(es[:, None], qss.shape), qss)
-                return (dh.reshape(-1), ds.reshape(-1),
+                return (dh.reshape(-1), ds.reshape(-1), da.reshape(-1),
                         evs.reshape(-1, 3), ns.reshape(-1, 3),
                         nr.reshape(-1, 3))
 
-            dh2, ds2, evs2, ns2, nr2 = jax.vmap(swaps_per_ind)(
+            dh2, ds2, da2, evs2, ns2, nr2 = jax.vmap(swaps_per_ind)(
                 e_blk, partners, st.slots, st.rooms, st.att, st.occ)
             cand_dh = jnp.concatenate([cand_dh, dh2], axis=1)
             cand_ds = jnp.concatenate([cand_ds, ds2], axis=1)
+            cand_da = jnp.concatenate([cand_da, da2], axis=1)
             cand_evs = jnp.concatenate([cand_evs, evs2], axis=1)
             cand_ns = jnp.concatenate([cand_ns, ns2], axis=1)
             cand_nr = jnp.concatenate([cand_nr, nr2], axis=1)
@@ -437,9 +446,10 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
                     active = jnp.array([True, True, True])
                     dh, ds, nr = _delta_one(pa, s, r, att, occ, evs,
                                             ns, active, cap_rank)
+                    da = fitness.anchor_delta(pa, s, evs, ns)
                     invalid = (q1 == e_i) | (q2 == e_i) | (q1 == q2)
                     dh = jnp.where(invalid, BIG, dh)
-                    return dh, ds, evs, ns, nr
+                    return dh, ds, da, evs, ns, nr
 
                 def cycs_per_ind(es, qss, s, r, att, occ):
                     # (B, SB-1) adjacent pairs x 2 orientations
@@ -453,23 +463,32 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
                                 e_i, a, b2, o, s, r, att, occ)))(
                                     eb, q1, q2)
 
-                    dh, ds, evs, ns, nr = jax.vmap(for_orient)(orients)
-                    return (dh.reshape(-1), ds.reshape(-1),
+                    dh, ds, da, evs, ns, nr = jax.vmap(for_orient)(orients)
+                    return (dh.reshape(-1), ds.reshape(-1), da.reshape(-1),
                             evs.reshape(-1, 3), ns.reshape(-1, 3),
                             nr.reshape(-1, 3))
 
-                dh3, ds3, evs3, ns3, nr3 = jax.vmap(cycs_per_ind)(
+                dh3, ds3, da3, evs3, ns3, nr3 = jax.vmap(cycs_per_ind)(
                     e_blk, partners, st.slots, st.rooms, st.att, st.occ)
                 cand_dh = jnp.concatenate([cand_dh, dh3], axis=1)
                 cand_ds = jnp.concatenate([cand_ds, ds3], axis=1)
+                cand_da = jnp.concatenate([cand_da, da3], axis=1)
                 cand_evs = jnp.concatenate([cand_evs, evs3], axis=1)
                 cand_ns = jnp.concatenate([cand_ns, ns3], axis=1)
                 cand_nr = jnp.concatenate([cand_nr, nr3], axis=1)
 
+        # Anchored acceptance: recover the maintained states' anchor
+        # residual exactly (init_state's pen rides batch_penalty, which
+        # includes the anchor term) and carry each candidate's anchor
+        # delta, so the sweep optimizes the SAME anchored objective as
+        # selection (fitness.compute_penalty). On unanchored instances
+        # both terms are exactly 0. The scv tie-break below stays a pure
+        # constraint count — the anchor only orders the primary penalty.
+        anc = st.pen - fitness.base_penalty(st.hcv, st.scv)  # (P,)
         new_hcv = st.hcv[:, None] + cand_dh                # (P, C)
         new_scv = st.scv[:, None] + cand_ds
-        new_pen = jnp.where(new_hcv == 0, new_scv,
-                            fitness.INFEASIBLE_OFFSET + new_hcv)
+        new_pen = (fitness.base_penalty(new_hcv, new_scv)
+                   + anc[:, None] + cand_da)
         ar = jnp.arange(P)
         # Candidate choice and acceptance use the LEXICOGRAPHIC
         # (penalty, scv) order — the reported evaluation's total order

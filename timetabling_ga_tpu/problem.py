@@ -68,10 +68,28 @@ class Problem:
     # them through ProblemArrays.event_mask / room_mask below.
     n_live_events: Union[int, None] = None
     n_live_rooms: Union[int, None] = None
+    # Anchored objective (serve/editsolve.py): per-event anchor timeslot
+    # and per-event integer weight. An edit job re-solves an edited
+    # instance while paying `anchor_w[e]` for every event whose slot
+    # differs from `anchor_slots[e]` (the base job's best solution), so
+    # the search stays NEAR the published timetable. None = unanchored
+    # (every instance outside the edit path); device_arrays() then emits
+    # all-zero columns, and a zero weight vector makes the anchor term
+    # exactly 0 in integer arithmetic — bit-identical to the unanchored
+    # objective. New/padded events carry weight 0 by construction.
+    anchor_slots: Union[np.ndarray, None] = None  # (E,) int32
+    anchor_w: Union[np.ndarray, None] = None      # (E,) int32
 
     @property
     def n_slots(self) -> int:
         return self.n_days * self.slots_per_day
+
+    def to_tim(self) -> str:
+        """Serialize to canonical `.tim` text (see dump_tim).
+
+        The edit differ and the gateway edit payload both ship problems
+        in this form; round-trips load_tim bit-exactly."""
+        return dump_tim(self)
 
     def device_arrays(self):
         """Upload the kernel-facing arrays to the default device once.
@@ -85,6 +103,10 @@ class Problem:
                   else self.n_live_events)
         live_r = (self.n_rooms if self.n_live_rooms is None
                   else self.n_live_rooms)
+        anchor_slots = (np.zeros(self.n_events, dtype=np.int32)
+                        if self.anchor_slots is None else self.anchor_slots)
+        anchor_w = (np.zeros(self.n_events, dtype=np.int32)
+                    if self.anchor_w is None else self.anchor_w)
         return ProblemArrays(
             attends=jnp.asarray(self.attends, dtype=jnp.float32),
             conflict=jnp.asarray(self.conflict, dtype=jnp.float32),
@@ -95,6 +117,8 @@ class Problem:
                 np.arange(self.n_events) < live_e, dtype=jnp.float32),
             room_mask=jnp.asarray(
                 np.arange(self.n_rooms) < live_r, dtype=jnp.bool_),
+            anchor_slots=jnp.asarray(anchor_slots, dtype=jnp.int32),
+            anchor_w=jnp.asarray(anchor_w, dtype=jnp.int32),
             n_days=self.n_days,
             slots_per_day=self.slots_per_day,
         )
@@ -122,6 +146,13 @@ class ProblemArrays:
     # one-hot operands of the fitness contractions.
     event_mask: "object"     # (E,)   f32  1.0 live / 0.0 padded
     room_mask: "object"      # (R,)   bool True live / False padded
+    # Anchored objective columns (serve/editsolve.py): anchor_w already
+    # folds the edit's w_anchor weight with the carried-event mask, so
+    # padded and newly-added events hold weight 0 and the masked-Hamming
+    # anchor cost needs no extra gating. All-zero (the exact unanchored
+    # objective) outside the edit path.
+    anchor_slots: "object"   # (E,)   i32  anchor timeslot per event
+    anchor_w: "object"       # (E,)   i32  0 = unanchored event
     n_days: int
     slots_per_day: int
 
@@ -141,18 +172,19 @@ class ProblemArrays:
 # Register ProblemArrays as a pytree with static day/slot geometry.
 def _pa_flatten(pa: ProblemArrays):
     children = (pa.attends, pa.conflict, pa.possible, pa.student_count,
-                pa.room_size, pa.event_mask, pa.room_mask)
+                pa.room_size, pa.event_mask, pa.room_mask,
+                pa.anchor_slots, pa.anchor_w)
     aux = (pa.n_days, pa.slots_per_day)
     return children, aux
 
 
 def _pa_unflatten(aux, children):
     (attends, conflict, possible, student_count, room_size, event_mask,
-     room_mask) = children
+     room_mask, anchor_slots, anchor_w) = children
     n_days, slots_per_day = aux
     return ProblemArrays(attends, conflict, possible, student_count,
-                         room_size, event_mask, room_mask, n_days,
-                         slots_per_day)
+                         room_size, event_mask, room_mask, anchor_slots,
+                         anchor_w, n_days, slots_per_day)
 
 
 jax.tree_util.register_pytree_node(ProblemArrays, _pa_flatten, _pa_unflatten)

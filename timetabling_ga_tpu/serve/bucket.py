@@ -154,8 +154,21 @@ def pad_problem(problem: Problem, spec: BucketSpec = DEFAULT_SPEC
     possible = np.array(padded.possible)
     possible[E:, :] = False       # padded events suit NO room
     possible[:, R:] = False       # padded rooms suit NO event
+    # anchored-objective columns (serve/editsolve.py) ride along zero-
+    # padded: padded events carry anchor weight 0, so the anchor cost of
+    # a padded genotype equals the unpadded instance's bit-exactly (the
+    # same neutrality contract as every other term)
+    anchor_slots = anchor_w = None
+    if problem.anchor_slots is not None:
+        anchor_slots = np.zeros((Ep,), np.int32)
+        anchor_slots[:E] = problem.anchor_slots
+    if problem.anchor_w is not None:
+        anchor_w = np.zeros((Ep,), np.int32)
+        anchor_w[:E] = problem.anchor_w
     return dataclasses.replace(padded, possible=possible,
-                               n_live_events=E, n_live_rooms=R)
+                               n_live_events=E, n_live_rooms=R,
+                               anchor_slots=anchor_slots,
+                               anchor_w=anchor_w)
 
 
 def embed_population(slots: np.ndarray, rooms: np.ndarray,

@@ -1,0 +1,441 @@
+"""tt-edit: incremental re-solve — edit specs, population transplant,
+and the anchored objective's host side.
+
+Traffic shape (ROADMAP item 5a): a timetabling service at scale sees
+many SMALL EDITS against few cold solves — one event added, one
+attendance list changed — yet a cold solve re-derives everything the
+base job already learned. This module turns an edit into a warm
+restart that INHERITS the base job's search state instead of
+recomputing it (the increasing-population-restart idea from the CMA-ES
+literature, applied to the tt-resume wire snapshot):
+
+  edit spec     {"edit": {"base": <job_id> | {"tim"|"problem": ...},
+                          "ops": [...] | "edited": {"tim"|"problem":
+                          ...}, "w_anchor": W, "snapshot": <wire>}}
+                ops grammar (applied in order, events indexed in the
+                CURRENT problem at each step):
+                  {"op": "add_event", "students": [s...],
+                   "features": [f...]}            append one event
+                  {"op": "remove_event", "event": e}
+                  {"op": "set_attendance", "event": e, "student": s,
+                   "value": 0|1}
+                  {"op": "set_event_features", "event": e,
+                   "features": [f...]}            replace requirement row
+                  {"op": "set_room_size", "room": r, "size": n}
+                  {"op": "set_room_features", "room": r,
+                   "features": [f...]}            replace feature row
+                Alternatively "edited" ships the full edited instance
+                and `diff_problems` recovers the event mapping
+                positionally (equal-count prefix matches 1:1, extra
+                trailing events are adds, missing ones removes).
+
+  warm vs cold  the edit is WARM-COMPATIBLE iff the edited instance
+                pads into the SAME shape bucket as the base snapshot
+                (serve/bucket.bucket_key == wire["bucket"]): every
+                compiled island program then fits the transplanted
+                population unchanged. A cross-bucket edit, a missing/
+                undecodable base snapshot, or a population-size
+                mismatch DEMOTES the job to a cold solve (counted —
+                serve.jobs_edit_demoted — never an error).
+
+  transplant    carried events keep their slot/room genes from the
+                base job's park-fence snapshot; new events enter
+                parked at seeded-random slots (room 0 — the greedy
+                matcher re-rooms on first touch); removed events drop.
+                The population is re-evaluated under the EDITED
+                problem (the base snapshot's penalties are stale by
+                construction), lex-sorted, and packed into a fresh
+                wire carrying the EDIT job's own fingerprint with
+                cursors reset (gens_done=0, chunks=0 — the edit job's
+                lane RNG starts from ITS seed) — then admitted PARKED
+                through the scheduler's `_admit_resumed` seam.
+
+  anchor        the base job's published timetable (the snapshot's
+                lex-best row) becomes `Problem.anchor_slots`, with
+                `anchor_w[e] = w_anchor` on carried events and 0 on
+                new ones, so the kernels charge w_anchor per carried
+                event moved away from its published slot
+                (ops/fitness.anchor_cost — threaded through every
+                delta-acceptance site). w_anchor == 0 keeps the
+                anchor columns numerically inert (integer weight 0),
+                so those streams stay byte-identical to unanchored
+                solves.
+
+Layering: everything here is host-side numpy + stdlib except
+`transplant`'s one batched re-evaluation (fitness.batch_penalty), and
+it runs at ADMISSION time only — never inside a dispatch loop or a
+traced function (tt-analyze TT309 bans `editsolve.*` there).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from timetabling_ga_tpu.problem import Problem, derive, load_tim
+from timetabling_ga_tpu.serve import bucket as bucket_mod
+from timetabling_ga_tpu.serve import snapshot as snapshot_mod
+
+#: default anchor weight when the edit spec omits `w_anchor`: one soft
+#: point per moved carried event — enough to prefer the published slot
+#: among otherwise-equal candidates, never enough to trade a hard
+#: constraint for stability (any hcv dominates through the
+#: INFEASIBLE_OFFSET encoding).
+DEFAULT_ANCHOR_W = 1
+
+_OPS = ("add_event", "remove_event", "set_attendance",
+        "set_event_features", "set_room_size", "set_room_features")
+
+
+class EditError(ValueError):
+    """The edit spec is malformed or inapplicable to its base problem
+    (bad op name, out-of-range index, missing base). Raised at
+    admission — an edit job with a bad spec is REJECTED, not demoted
+    (demotion is for valid edits that merely cannot warm-start)."""
+
+
+class EditDemoted(RuntimeError):
+    """A valid edit cannot warm-start (cross-bucket shape, missing or
+    undecodable base snapshot, population mismatch). The scheduler
+    catches this, counts serve.jobs_edit_demoted, and runs the job as
+    a plain cold solve of the edited instance."""
+
+
+def parse_edit_spec(edit) -> dict:
+    """Validate the edit object's structure (not its applicability —
+    that needs the base problem). Returns the dict unchanged."""
+    if not isinstance(edit, dict):
+        raise EditError(f"edit spec is {type(edit).__name__}, "
+                        f"not an object")
+    if "base" not in edit:
+        raise EditError("edit spec needs a 'base' (job id or inline "
+                        "problem object)")
+    has_ops = "ops" in edit
+    has_edited = "edited" in edit
+    if has_ops == has_edited:
+        raise EditError("edit spec needs exactly one of 'ops' or "
+                        "'edited'")
+    if has_ops:
+        ops = edit["ops"]
+        if not isinstance(ops, (list, tuple)):
+            raise EditError("edit 'ops' must be a list")
+        for i, op in enumerate(ops):
+            if not isinstance(op, dict) or op.get("op") not in _OPS:
+                raise EditError(
+                    f"edit op {i} is not one of {_OPS}: {op!r}")
+    w = edit.get("w_anchor", DEFAULT_ANCHOR_W)
+    try:
+        if int(w) < 0:
+            raise ValueError
+    except (TypeError, ValueError):
+        raise EditError(f"edit w_anchor must be a non-negative "
+                        f"integer, got {w!r}") from None
+    return edit
+
+
+def load_base_problem(base, n_days=None, slots_per_day=None) -> Problem:
+    """The edit's base problem from its inline payload form — the same
+    {"tim": ...} / {"problem": ...} shapes every submit payload uses
+    (the gateway rewrites a job-id base into this form before
+    forwarding, so the replica never resolves ids)."""
+    if not isinstance(base, dict):
+        raise EditError(
+            f"edit base must be resolved to an inline problem object "
+            f"before it reaches the solver, got {type(base).__name__} "
+            f"(unresolved job-id bases are a gateway-only form)")
+    kw = {}
+    days = base.get("n_days", n_days)
+    spd = base.get("slots_per_day", slots_per_day)
+    if days is not None:
+        kw["n_days"] = int(days)
+    if spd is not None:
+        kw["slots_per_day"] = int(spd)
+    if "problem" in base:
+        # lazy: the JSON problem codec lives with the fleet wire code
+        from timetabling_ga_tpu.fleet.replicas import problem_from_json
+        return problem_from_json(base["problem"])
+    if "tim" in base:
+        return load_tim(str(base["tim"]), **kw)
+    raise EditError("edit base object needs a 'tim' text or a "
+                    "'problem' object")
+
+
+def _check_index(name: str, idx, bound: int) -> int:
+    try:
+        i = int(idx)
+    except (TypeError, ValueError):
+        raise EditError(f"edit op {name} index {idx!r} is not an "
+                        f"int") from None
+    if not 0 <= i < bound:
+        raise EditError(f"edit op {name} index {i} out of range "
+                        f"[0, {bound})")
+    return i
+
+
+def _feature_row(features, n_features: int) -> np.ndarray:
+    row = np.zeros((n_features,), np.int8)
+    for f in features or ():
+        row[_check_index("feature", f, n_features)] = 1
+    return row
+
+
+def apply_ops(base: Problem, ops) -> tuple[Problem, np.ndarray]:
+    """Apply an op list to `base`; returns (edited, event_map) where
+    event_map[e_edited] = the base event index it carries, or -1 for a
+    newly added event. All stdlib/numpy — the differ side of the
+    edit-spec grammar (module docstring)."""
+    attends = np.array(base.attends, dtype=np.int8)        # (S, E)
+    event_features = np.array(base.event_features, np.int8)
+    room_features = np.array(base.room_features, np.int8)
+    room_size = np.array(base.room_size, np.int32)
+    event_map = list(range(base.n_events))
+    S, F = base.n_students, base.n_features
+
+    for op in ops:
+        kind = op.get("op")
+        E = attends.shape[1]
+        if kind == "add_event":
+            col = np.zeros((S, 1), np.int8)
+            for s in op.get("students") or ():
+                col[_check_index("student", s, S), 0] = 1
+            attends = np.concatenate([attends, col], axis=1)
+            event_features = np.concatenate(
+                [event_features,
+                 _feature_row(op.get("features"), F)[None, :]], axis=0)
+            event_map.append(-1)
+        elif kind == "remove_event":
+            e = _check_index("event", op.get("event"), E)
+            attends = np.delete(attends, e, axis=1)
+            event_features = np.delete(event_features, e, axis=0)
+            del event_map[e]
+        elif kind == "set_attendance":
+            e = _check_index("event", op.get("event"), E)
+            s = _check_index("student", op.get("student"), S)
+            attends[s, e] = 1 if op.get("value") else 0
+        elif kind == "set_event_features":
+            e = _check_index("event", op.get("event"), E)
+            event_features[e] = _feature_row(op.get("features"), F)
+        elif kind == "set_room_size":
+            r = _check_index("room", op.get("room"), base.n_rooms)
+            size = int(op.get("size", 0))
+            if size < 0:
+                raise EditError(f"edit op set_room_size: negative "
+                                f"size {size}")
+            room_size[r] = size
+        elif kind == "set_room_features":
+            r = _check_index("room", op.get("room"), base.n_rooms)
+            room_features[r] = _feature_row(op.get("features"), F)
+        else:
+            raise EditError(f"unknown edit op {kind!r}")
+
+    if attends.shape[1] == 0:
+        raise EditError("edit removes every event")
+    edited = derive(attends.shape[1], base.n_rooms, F, S, room_size,
+                    attends, room_features, event_features,
+                    n_days=base.n_days,
+                    slots_per_day=base.slots_per_day)
+    return edited, np.asarray(event_map, np.int32)
+
+
+def diff_problems(base: Problem, edited: Problem
+                  ) -> tuple[list, np.ndarray]:
+    """Positional differ for full-instance edits (`tt submit EDITED.tim
+    --edit-of BASE`): events are matched BY POSITION — the common
+    prefix min(E_base, E_edited) carries 1:1, trailing extra edited
+    events are adds, trailing missing base events are removes. Simple
+    and predictable: a client that reorders events gets a (valid but
+    cold-ish) high-distance mapping, not a guess. Returns (ops,
+    event_map) where ops is a summary op list in the apply_ops grammar
+    and event_map matches apply_ops' convention."""
+    if (base.n_students, base.n_features, base.n_rooms) != (
+            edited.n_students, edited.n_features, edited.n_rooms):
+        raise EditError(
+            f"diff needs matching (students, features, rooms) axes: "
+            f"base ({base.n_students}, {base.n_features}, "
+            f"{base.n_rooms}) != edited ({edited.n_students}, "
+            f"{edited.n_features}, {edited.n_rooms})")
+    if (base.n_days, base.slots_per_day) != (edited.n_days,
+                                             edited.slots_per_day):
+        raise EditError("diff needs matching slot grids")
+    Eb, Ee = base.n_events, edited.n_events
+    common = min(Eb, Ee)
+    ops: list = []
+    for e in range(common):
+        changed = np.flatnonzero(base.attends[:, e]
+                                 != edited.attends[:, e])
+        for s in changed:
+            ops.append({"op": "set_attendance", "event": e,
+                        "student": int(s),
+                        "value": int(edited.attends[s, e])})
+        if np.any(base.event_features[e] != edited.event_features[e]):
+            ops.append({"op": "set_event_features", "event": e,
+                        "features": np.flatnonzero(
+                            edited.event_features[e]).tolist()})
+    for r in range(base.n_rooms):
+        if int(base.room_size[r]) != int(edited.room_size[r]):
+            ops.append({"op": "set_room_size", "room": r,
+                        "size": int(edited.room_size[r])})
+        if np.any(base.room_features[r] != edited.room_features[r]):
+            ops.append({"op": "set_room_features", "room": r,
+                        "features": np.flatnonzero(
+                            edited.room_features[r]).tolist()})
+    for e in range(common, Ee):                    # trailing adds
+        ops.append({"op": "add_event",
+                    "students": np.flatnonzero(
+                        edited.attends[:, e]).tolist(),
+                    "features": np.flatnonzero(
+                        edited.event_features[e]).tolist()})
+    for e in range(Eb - 1, common - 1, -1):        # trailing removes
+        ops.append({"op": "remove_event", "event": e})
+    event_map = np.concatenate(
+        [np.arange(common, dtype=np.int32),
+         np.full((Ee - common,), -1, np.int32)])
+    return ops, event_map
+
+
+def resolve_edit(edit, n_days=None, slots_per_day=None):
+    """Edit spec -> (base, edited, event_map, ops). Validates the spec,
+    loads the base, and applies/diffs — everything about the edit that
+    does not need the snapshot or the scheduler."""
+    parse_edit_spec(edit)
+    base = load_base_problem(edit["base"], n_days=n_days,
+                             slots_per_day=slots_per_day)
+    if "ops" in edit:
+        ops = list(edit["ops"])
+        edited, event_map = apply_ops(base, ops)
+    else:
+        edited_p = load_base_problem(edit["edited"],
+                                     n_days=base.n_days,
+                                     slots_per_day=base.slots_per_day)
+        ops, event_map = diff_problems(base, edited_p)
+        edited = edited_p
+    return base, edited, event_map, ops
+
+
+def anchor_from_wire(wire) -> np.ndarray | None:
+    """The base job's published timetable: the snapshot population's
+    lex-best row of slots ((E_padded,) int32), or None when the wire
+    is missing/undecodable. Host-only (numpy lexsort)."""
+    if wire is None:
+        return None
+    try:
+        state, _meta = snapshot_mod.unpack_state(wire)
+    except Exception:
+        return None
+    best = int(np.lexsort((np.asarray(state.scv),
+                           np.asarray(state.penalty)))[0])
+    return np.asarray(state.slots[best], np.int32)
+
+
+def attach_anchor(edited: Problem, event_map: np.ndarray,
+                  base_anchor: np.ndarray | None,
+                  w_anchor: int) -> Problem:
+    """Attach the anchored-objective columns to the edited problem:
+    anchor_slots[e] = the base best solution's slot for carried events
+    (event_map[e] >= 0), weight w_anchor there and 0 on new events.
+    With no decodable base solution the problem is returned unanchored
+    (the cold/demoted legs still solve the plain objective)."""
+    if base_anchor is None or w_anchor is None:
+        return edited
+    E = edited.n_events
+    anchor_slots = np.zeros((E,), np.int32)
+    anchor_w = np.zeros((E,), np.int32)
+    carried = event_map >= 0
+    # base live events occupy the padded prefix, so live base indices
+    # index base_anchor directly
+    anchor_slots[carried] = base_anchor[event_map[carried]]
+    anchor_w[carried] = int(w_anchor)
+    return dataclasses.replace(edited, anchor_slots=anchor_slots,
+                               anchor_w=anchor_w)
+
+
+def classify(edited_padded_key: tuple, wire) -> bool:
+    """Warm-compatible iff the edited instance's bucket equals the
+    base snapshot's (module docstring). False = cold."""
+    if wire is None:
+        return False
+    return [int(d) for d in edited_padded_key] == [
+        int(d) for d in wire.get("bucket", ())]
+
+
+def transplant(edited_padded: Problem, event_map: np.ndarray, wire,
+               *, bucket, pop_size: int, seed: int) -> dict:
+    """Build the edit job's warm-start wire: carried events keep their
+    base slot/room genes, new events enter at seeded-random slots,
+    removed events drop; the population is re-evaluated under the
+    edited problem, lex-sorted, and packed with the EDIT job's own
+    fingerprint and RESET cursors (gens_done=0, chunks=0 — its lane
+    RNG starts from its own seed; emitted/best at the fresh-job floor
+    so the record stream starts clean). Raises EditDemoted on any
+    warm-start obstacle; the caller runs the job cold."""
+    if wire is None:
+        raise EditDemoted("no base snapshot to transplant from")
+    if not classify(bucket, wire):
+        raise EditDemoted(
+            f"cross-bucket edit: edited bucket {list(bucket)} != base "
+            f"snapshot bucket {list(wire.get('bucket', ()))}")
+    try:
+        base_state, _meta = snapshot_mod.unpack_state(wire)
+    except Exception as e:
+        raise EditDemoted(f"base snapshot undecodable: {e}") from e
+    b_slots = np.asarray(base_state.slots)
+    b_rooms = np.asarray(base_state.rooms)
+    if b_slots.shape[0] != pop_size:
+        raise EditDemoted(
+            f"base snapshot population {b_slots.shape[0]} != "
+            f"configured pop_size {pop_size}")
+
+    Ep = edited_padded.n_events
+    live = (edited_padded.n_live_events
+            if edited_padded.n_live_events is not None else Ep)
+    T = edited_padded.n_slots
+    if np.any(event_map[:live] >= b_slots.shape[1]):
+        raise EditDemoted("event map exceeds base genotype width")
+    rng = np.random.default_rng(seed)
+    slots = np.zeros((pop_size, Ep), np.int32)
+    rooms = np.zeros((pop_size, Ep), np.int32)
+    carried = np.flatnonzero(event_map[:live] >= 0)
+    fresh = np.flatnonzero(event_map[:live] < 0)
+    slots[:, carried] = b_slots[:, event_map[carried]]
+    rooms[:, carried] = b_rooms[:, event_map[carried]]
+    if fresh.size:
+        slots[:, fresh] = rng.integers(
+            0, T, size=(pop_size, fresh.size), dtype=np.int32)
+        # room 0 is a placeholder: the first local-search touch
+        # re-rooms greedily, and an unsuitable room is just hcv the
+        # search immediately repairs
+
+    # the base penalties are STALE under the edited problem (changed
+    # attendance/suitability, dropped events): one batched
+    # re-evaluation under the edited padded instance — admission-time
+    # device work, the one jax call in this module (never inside a
+    # dispatch loop: TT309)
+    from timetabling_ga_tpu.ops import fitness, ga
+    pa = edited_padded.device_arrays()
+    pen_d, hcv_d, scv_d = fitness.batch_penalty(pa, slots, rooms)
+    pen = np.asarray(pen_d)
+    hcv = np.asarray(hcv_d)
+    scv = np.asarray(scv_d)
+    order = np.asarray(fitness.lex_order(pen_d, scv_d))
+    state = ga.PopState(slots=slots[order], rooms=rooms[order],
+                        penalty=pen[order], hcv=hcv[order],
+                        scv=scv[order])
+    fresh_floor = 2**31 - 1
+    return snapshot_mod.pack_state(
+        state, bucket=bucket, pop_size=pop_size, seed=seed,
+        gens_done=0, chunks=0, emitted=fresh_floor, best=fresh_floor)
+
+
+def edit_distance(final_slots, anchor_slots, event_map) -> int | None:
+    """Events MOVED vs the anchor: carried live events whose final
+    slot differs from the base solution's. Computed from the event map
+    (not anchor_w — the w_anchor=0 bench leg must still report its
+    true distance). None when the job never had a decodable anchor."""
+    if anchor_slots is None or event_map is None:
+        return None
+    final_slots = np.asarray(final_slots)
+    live = min(final_slots.shape[-1], len(event_map))
+    carried = np.asarray(event_map[:live]) >= 0
+    return int(np.sum((final_slots[..., :live][..., carried]
+                       != np.asarray(anchor_slots)[:live][carried])))

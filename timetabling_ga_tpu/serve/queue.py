@@ -157,6 +157,28 @@ class Job:
     #                                   queue_seconds component's end
     last_fence_t: Optional[float] = None  # latest park fence: the next
     #                                   cycle's park_seconds baseline
+    # -- tt-edit (serve/editsolve.py; README "Incremental re-solve") -----
+    mode: str = "solve"               # "solve" | "edit": an edit job
+    #                                   solves an EDITED instance
+    #                                   warm-started from its base
+    #                                   job's snapshot under the
+    #                                   anchored objective; the tag
+    #                                   rides jobEntry/usageEntry and
+    #                                   the result so tt stats can
+    #                                   split edit latency out
+    edit_of: Optional[str] = None     # base job id (or None for an
+    #                                   inline base instance)
+    edit_map: object = None           # (E_edited,) int32 event map:
+    #                                   edited event -> base event
+    #                                   index, -1 for added events —
+    #                                   what edit_distance reports
+    #                                   against at finalize
+    edit_demoted: bool = False        # the warm start failed (cross-
+    #                                   bucket edit, missing/bad base
+    #                                   snapshot): the job ran as a
+    #                                   cold solve of the edited
+    #                                   instance (counted, never an
+    #                                   error)
 
     def runnable(self) -> bool:
         return self.state in (JobState.PENDING, JobState.RUNNING,

@@ -217,6 +217,40 @@ class Scheduler:
         job.bucket = bucket_mod.bucket_key(job.problem, self.spec)
         job.pa_dev = job.padded.device_arrays()
 
+    def prepare_edit(self, job: Job, base_wire) -> None:
+        """Warm-start an edit job from its base snapshot (serve/
+        editsolve.py; README "Incremental re-solve"). Called by the
+        service AFTER prepare (the transplant needs the padded
+        instance and bucket) and only when the job carries no resume
+        wire of its own — a failed-over edit job's OWN snapshot is
+        newer than any re-transplant and takes precedence.
+
+        Success parks the transplanted population in job.resume_wire
+        (admit's `_admit_resumed` seam restores it exactly like any
+        other warm start). ANY failure — cross-bucket edit, missing or
+        undecodable base snapshot, population mismatch — DEMOTES the
+        job to a cold solve of the edited instance: one faultEntry
+        (site=edit action=demote), the serve.jobs_edit_demoted
+        counter, never an error. Admission-time host work only: this
+        is the one place the scheduler touches editsolve, and it is
+        outside every dispatch loop (tt-analyze TT309)."""
+        from timetabling_ga_tpu.serve import editsolve
+        self._metrics.counter("serve.jobs_edit").inc()
+        try:
+            faults.maybe_fail("edit")
+            job.resume_wire = editsolve.transplant(
+                job.padded, job.edit_map, base_wire,
+                bucket=job.bucket, pop_size=self.cfg.pop_size,
+                seed=job.seed)
+        except (KeyboardInterrupt,):
+            raise
+        except BaseException as e:
+            job.edit_demoted = True
+            job.resume_wire = None
+            jsonl.fault_entry(self.out, "edit", "demote", e, 0, 0, 0,
+                              self.tracer.now(), job=job.id)
+            self._metrics.counter("serve.jobs_edit_demoted").inc()
+
     def admit(self, job: Job) -> None:
         """Record the admission (after queue.submit succeeds). The job
         gets its causal flow id here — every span of its life (admit →
@@ -241,9 +275,17 @@ class Scheduler:
         error: a poisoned snapshot may cost progress, not the job."""
         if not job.flow:
             job.flow = self.tracer.new_flow()
-        if job.resume_wire is not None and self._admit_resumed(job):
+        resumed = (job.resume_wire is not None
+                   and self._admit_resumed(job))
+        if resumed and not (job.mode == "edit" and job.count_usage):
             self._metrics.counter("serve.jobs_admitted").inc()
             return
+        # an edit job's FIRST admission falls through even when its
+        # transplant wire resumed it (count_usage distinguishes first
+        # admission from a fleet failover resend): the admitted
+        # jobEntry with the mode=edit tag and the tenant jobs count
+        # must happen exactly once, and the transplant path is the
+        # edit job's normal birth, not a recovery seam
         with self.tracer.span("admit", cat="serve", job=job.id,
                               flow=job.flow):
             extra = {}
@@ -253,6 +295,12 @@ class Scheduler:
                 # tenant, keeping untagged streams byte-identical to
                 # pre-meter ones
                 extra["tenant"] = job.tenant
+            if job.mode != "solve":
+                extra["mode"] = job.mode
+                if job.edit_of:
+                    extra["edit_of"] = job.edit_of
+                if job.edit_demoted:
+                    extra["demoted"] = True
             self._ship_rec(job, jsonl.job_entry(
                 self.out, job.id, "admitted",
                 bucket=list(job.bucket),
@@ -1028,9 +1076,25 @@ class Scheduler:
         jsonl.run_entry(self.out, job.best, feasible, procs_num=1,
                         threads_num=1, total_time=total_time,
                         job=job.id)
+        done_extra = {}
+        edit_dist = None
+        if job.mode == "edit":
+            # distance vs the base job's published timetable, from the
+            # event map — NOT anchor_w (a w_anchor=0 edit still reports
+            # its true distance; the bench A/B's cold leg needs it)
+            from timetabling_ga_tpu.serve import editsolve
+            edit_dist = editsolve.edit_distance(
+                snap.slots[0],
+                getattr(job.padded, "anchor_slots", None),
+                job.edit_map)
+            done_extra["mode"] = job.mode
+            if edit_dist is not None:
+                done_extra["edit_distance"] = edit_dist
+            if job.edit_demoted:
+                done_extra["demoted"] = True
         jsonl.job_entry(self.out, job.id, "done", gens=job.gens_done,
                         best=job.best, feasible=feasible,
-                        deadline_hit=deadline_hit)
+                        deadline_hit=deadline_hit, **done_extra)
         job.state = JobState.DONE
         job.finished_t = self._now()
         self._metrics.counter("serve.jobs_done").inc()
@@ -1042,6 +1106,12 @@ class Scheduler:
                       "resumed_at": job.resumed_at,
                       "timeslots": slots.tolist(),
                       "rooms": rooms.tolist()}
+        if job.mode != "solve":
+            job.result["mode"] = job.mode
+            job.result["edit_distance"] = edit_dist
+            job.result["edit_demoted"] = job.edit_demoted
+            if job.edit_of:
+                job.result["edit_of"] = job.edit_of
         if self._usage is not None:
             # the settled meter travels with the result (the /v1 job
             # view a billing consumer reads) and lands on the record
@@ -1050,7 +1120,11 @@ class Scheduler:
             # resumed job (the wire cursor seeded it)
             job.result["tenant"] = job.tenant
             job.result["usage"] = usage_mod.rounded(job.usage)
-            self._usage.final(job.id, job.tenant, job.usage)
+            self._usage.final(job.id, job.tenant, job.usage,
+                              mode=job.mode)
         job.snapshot = None        # parked memory released
-        job.ship = None            # a settled job ships nothing — the
+        # the FINAL park-fence ship unit stays (host bytes, no device
+        # refs): a done job may become an edit BASE (tt-edit), and its
+        # final wire is what turns that edit into a warm transplant —
+        # the replica's TAIL_JOBS forget is the retention bound
         job.ship_records = []      # live tail serves its records

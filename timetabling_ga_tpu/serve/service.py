@@ -20,6 +20,12 @@ the engine's JSONL protocol with each record tagged `"job"`, plus the
                 "seed": 42, "generations": 200, "deadline": 30.0,
                 "tenant": "acme"}}
     {"submit": {"id": "j2", "tim": "4 2 2 5\\n..."}}   inline instance
+    {"submit": {"id": "j3", "edit": {"base": {"tim": "..."},
+                "ops": [...], "snapshot": {...}, "w_anchor": 1}}}
+                                       incremental re-solve (tt-edit:
+                                       serve/editsolve.py — warm-start
+                                       from the base snapshot under
+                                       the anchored objective)
     {"cancel": "j1"}
     {"stats": true}                    live metricsEntry snapshot
     {"stats": "prometheus"}            snapshot + Prometheus text
@@ -206,7 +212,7 @@ class SolveService:
     def submit(self, problem, job_id=None, priority: int = 0,
                seed=None, generations=None, deadline_s=None,
                flow: int = 0, snapshot=None, tenant=None,
-               count_job: bool = True) -> str:
+               count_job: bool = True, edit=None) -> str:
         """Admit one job; returns its id. Raises AdmissionError when
         the backlog is full or the id is taken (admission control).
         `flow` (optional) is an inherited causal flow id — the fleet
@@ -226,10 +232,46 @@ class SolveService:
         marks a fleet RESEND (the gateway's X-TT-Resubmit): the job
         is metered as usual but NOT re-counted in its tenant's `jobs`
         ledger — its first admission, possibly on a now-dead replica
-        whose cached ledger the gateway still sums, already did."""
+        whose cached ledger the gateway still sums, already did.
+
+        `edit` (optional; serve/editsolve.py, README "Incremental
+        re-solve") is an edit spec {"base": ..., "ops"|"edited": ...,
+        "w_anchor": W, "snapshot": <base wire>, "base_id": ...}: the
+        service derives the EDITED instance from it (`problem` may be
+        None), attaches the anchored objective (the base snapshot's
+        best timetable at weight W on carried events — deterministic,
+        so a failed-over edit job re-derives the SAME objective), and
+        warm-starts from a population transplanted out of the base
+        snapshot when the edit stays in the base's shape bucket. A
+        cross-bucket edit or missing/bad base snapshot DEMOTES to a
+        cold solve of the edited instance (counted, never an error); a
+        malformed spec is a rejection like any other bad submit. An
+        edit job that ALSO carries `snapshot` (its own resume wire — a
+        fleet failover) resumes from that instead of re-transplanting:
+        its own wire is strictly newer."""
         if job_id is None:
             self._auto_id += 1
             job_id = f"job-{self._auto_id}"
+        mode = "solve"
+        edit_map = None
+        edit_of = None
+        base_wire = None
+        if edit is not None:
+            from timetabling_ga_tpu.serve import editsolve
+            _base, edited, edit_map, _ops = editsolve.resolve_edit(
+                edit, n_days=getattr(self.cfg, "n_days", None),
+                slots_per_day=getattr(self.cfg, "slots_per_day",
+                                      None))
+            base_wire = edit.get("snapshot")
+            w_anchor = int(edit.get("w_anchor",
+                                    editsolve.DEFAULT_ANCHOR_W))
+            problem = editsolve.attach_anchor(
+                edited, edit_map,
+                editsolve.anchor_from_wire(base_wire), w_anchor)
+            mode = "edit"
+            edit_of = edit.get("base_id") or (
+                edit["base"] if isinstance(edit["base"], str)
+                else None)
         job = Job(id=str(job_id), problem=problem,
                   priority=int(priority),
                   seed=int(self.cfg.seed if seed is None else seed),
@@ -239,12 +281,18 @@ class SolveService:
                   deadline_s=deadline_s, flow=int(flow or 0),
                   resume_wire=snapshot,
                   tenant=obs_usage.tenant_label(tenant),
-                  count_usage=bool(count_job))
+                  count_usage=bool(count_job),
+                  mode=mode, edit_of=edit_of, edit_map=edit_map)
         # prepare (pad + place) BEFORE the queue takes the job: a
         # failing instance is rejected here with the queue untouched —
         # no half-admitted job can reach the scheduler
         self.scheduler.prepare(job)
         self.queue.submit(job)
+        if mode == "edit" and job.resume_wire is None:
+            # transplant the base population (or demote to cold) —
+            # after the queue takes the job so its faultEntry joins
+            # the job's stream, before admit so the wire warm-starts
+            self.scheduler.prepare_edit(job, base_wire)
         self.scheduler.admit(job)
         return job.id
 
@@ -323,6 +371,8 @@ class SolveService:
 
 
 def _load_submit_problem(req: dict):
+    if "edit" in req:
+        return None          # the edit spec derives the instance
     if "tim" in req:
         return load_tim(req["tim"])
     return load_tim_file(req["instance"])
@@ -357,7 +407,8 @@ def serve_stream(cfg: ServeConfig, in_stream, out_stream=None,
                                generations=sub.get("generations"),
                                deadline_s=sub.get("deadline"),
                                snapshot=sub.get("snapshot"),
-                               tenant=sub.get("tenant"))
+                               tenant=sub.get("tenant"),
+                               edit=sub.get("edit"))
                 except Exception as e:
                     # one bad tenant must not take down the service:
                     # ANY submit-side failure (parse error, admission

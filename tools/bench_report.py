@@ -112,6 +112,16 @@ _METRICS = [
     ("accord ms/agree", "accord", "agree_ms_per_fence"),
     ("accord ms/guard", "accord", "guard_ms_per_fence"),
     ("accord identical", "accord", "records_identical"),
+    # extra.edit (ISSUE 19, tt-edit): warm vs cold incremental
+    # re-solve — generations to reach the base job's final quality,
+    # the anchored stability (events moved vs the base timetable), the
+    # same-bucket no-demotion pin, and the w_anchor=0 stream identity
+    ("edit gens-to-base warm", "edit.warm", "gens_to_base_quality"),
+    ("edit gens-to-base cold", "edit.cold", "gens_to_base_quality"),
+    ("edit t-feas warm s", "edit.warm", "time_to_feasible_s"),
+    ("edit distance warm", "edit.warm", "edit_distance"),
+    ("edit demoted warm", "edit.warm", "demoted"),
+    ("edit identical w0", "edit", "records_identical_w0"),
 ]
 
 _NUM = r"(-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)"
@@ -293,6 +303,21 @@ def _scaling_section(rounds, multis) -> list:
                          f"r{_fmt(n)} {_fmt(v)} ms/agree"
                          f" identical={'yes' if ident else 'NO'}"
                          for n, v, ident in accord))
+    # tt-edit (ISSUE 19): warm-start leverage per round — how many
+    # generations the transplanted population saves on the way back to
+    # the base job's quality (the at-scale traffic is mostly edits)
+    edit = [(r["round"], r["metrics"].get("edit gens-to-base warm"),
+             r["metrics"].get("edit gens-to-base cold"),
+             r["metrics"].get("edit demoted warm"))
+            for r in rounds
+            if r["metrics"].get("edit gens-to-base warm") is not None]
+    if edit:
+        lines.append("edit warm-start (extra.edit, gens to base "
+                     "quality warm vs cold): "
+                     + ", ".join(
+                         f"r{_fmt(n)} {_fmt(w)} vs {_fmt(c)}"
+                         f" demoted={_fmt(d)}"
+                         for n, w, c, d in edit))
     if multis:
         lines.append("multichip dry-run (devices -> gens): "
                      + ", ".join(

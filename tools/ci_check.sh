@@ -9,7 +9,7 @@
 #
 # Steps (each failure is fatal):
 #   1. tt-analyze --strict --warn-unused-ignores over timetabling_ga_tpu/
-#      — the JAX-aware static rules, 24 of them including the
+#      — the JAX-aware static rules, 25 of them including the
 #      whole-program device-taint/donation/fence/residency pass
 #      (TT303/TT304/TT305/TT306) and the tt-accord recovery-path
 #      collective ban (TT307), plus stale-suppression detection
@@ -94,6 +94,13 @@ if [ "${1:-}" = "--fast" ]; then
     step "accord channel tests (tests/test_accord.py)"
     timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_accord.py -q -p no:cacheprovider -m 'not slow' \
+        || fail=1
+    # tt-edit: anchored-objective neutrality/bit-exactness, the
+    # transplant warm/demote matrix, and the w_anchor=0 stream-
+    # identity pin — the incremental re-solve acceptance tier
+    step "incremental re-solve tests (tests/test_edit.py)"
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_edit.py -q -p no:cacheprovider -m 'not slow' \
         || fail=1
     [ "$fail" -eq 0 ] && step "OK (fast mode: full test tier skipped)"
     exit $fail
