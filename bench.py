@@ -2101,6 +2101,105 @@ def measure_obs(problem, pop: int = 256, gens: int = 600) -> dict:
     return out
 
 
+def measure_prof(problem, pop: int = 256, gens: int = 600) -> dict:
+    """extra.prof leg (ISSUE 20, tt-prof): phase-scope + capture cost
+    and the attribution itself, same-seed A/B.
+
+    Two legs of the SAME run (same seed, same programs, obs on both):
+    profiler capture OFF vs ON (jax.profiler tracing the whole gen
+    loop, scopes active on both legs — scopes are trace-time metadata,
+    so they cost nothing at dispatch). `strip_timing` asserts the
+    record streams bit-identical: profiling must never change what a
+    run computes. The ON leg's capture then runs through the tt-prof
+    attribution (obs/prof.py): reported are the attributed
+    rooms/sweep/fitness fractions and the honest unattributed share —
+    the measured answer to 'where do the device-seconds actually go'
+    (ROADMAP item 4 wants the attack order, not a guess)."""
+    import dataclasses
+    import io
+    import json as _json
+    import shutil
+    import tempfile
+
+    import jax
+
+    from timetabling_ga_tpu.obs import prof as obs_prof
+    from timetabling_ga_tpu.problem import dump_tim
+    from timetabling_ga_tpu.runtime import engine, jsonl
+    from timetabling_ga_tpu.runtime.config import RunConfig
+
+    with tempfile.NamedTemporaryFile("w", suffix=".tim",
+                                     delete=False) as f:
+        f.write(dump_tim(problem))
+        tim = f.name
+    capture_dir = tempfile.mkdtemp(prefix="tt-prof-bench-")
+    try:
+        base = RunConfig(input=tim, seed=1234, pop_size=pop, islands=1,
+                         generations=gens, migration_period=50,
+                         epochs_per_dispatch=4, ls_mode="sweep",
+                         ls_sweeps=1, init_sweeps=0,
+                         time_limit=100000.0, auto_tune=False,
+                         trace=True, obs=True, metrics_every=1)
+        # compiles run note_executable (obs/cost.py) — the sidecar join
+        # table is harvested HERE, before any capture exists
+        engine.precompile(base)
+
+        def leg(capture):
+            buf = io.StringIO()
+            if capture:
+                jax.profiler.start_trace(capture_dir)
+            try:
+                best = engine.run(base, out=buf)
+            finally:
+                if capture:
+                    jax.profiler.stop_trace()
+            lines = [_json.loads(x)
+                     for x in buf.getvalue().splitlines()]
+            loop = [x["phase"] for x in lines if "phase" in x
+                    and x["phase"]["name"] == "gen-loop"][0]
+            return {"best": best, "loop_s": loop["seconds"],
+                    "dispatches": loop["dispatches"],
+                    "recs": jsonl.strip_timing(lines)}
+
+        off = leg(False)
+        on = leg(True)
+        obs_prof.write_scope_map(capture_dir)
+        attr = obs_prof.attribute(capture_dir)
+    finally:
+        os.unlink(tim)
+        shutil.rmtree(capture_dir, ignore_errors=True)
+
+    phases = attr["phases"]
+
+    def frac(name):
+        return round(phases.get(name, {}).get("frac", 0.0), 4)
+
+    out = {
+        "pop": pop, "gens": gens, "dispatches": off["dispatches"],
+        "loop_s_capture_off": round(off["loop_s"], 3),
+        "loop_s_capture_on": round(on["loop_s"], 3),
+        "prof_overhead_ms_per_dispatch": round(
+            (on["loop_s"] - off["loop_s"]) / max(1, on["dispatches"])
+            * 1e3, 3),
+        "device_s_attributed": round(
+            attr["total_s"] - attr["unattributed_s"], 4),
+        "frac_rooms": frac("rooms"),
+        "frac_sweep": frac("sweep"),
+        "frac_fitness": frac("fitness"),
+        "unattributed_frac": round(attr["unattributed_frac"], 4),
+        "records_identical_modulo_timing": off["recs"] == on["recs"],
+    }
+    print(f"# prof A/B (pop {pop}, {off['dispatches']} dispatches): "
+          f"loop {off['loop_s']:.3f}s off vs {on['loop_s']:.3f}s "
+          f"capture on ({out['prof_overhead_ms_per_dispatch']} "
+          f"ms/dispatch); attributed rooms {out['frac_rooms']:.1%} "
+          f"sweep {out['frac_sweep']:.1%} fitness "
+          f"{out['frac_fitness']:.1%}, unattributed "
+          f"{out['unattributed_frac']:.1%}; records identical="
+          f"{out['records_identical_modulo_timing']}", file=sys.stderr)
+    return out
+
+
 def measure_flight(problem, pop: int = 256, gens: int = 600) -> dict:
     """extra.flight leg (ISSUE 13): the flight recorder + history
     sampler's cost and its black-box output, same-seed A/B.
@@ -2340,6 +2439,7 @@ def main(argv=None) -> None:
             ("pipeline", lambda: measure_pipeline(problem)),
             ("accord", lambda: measure_accord(problem)),
             ("obs", lambda: measure_obs(problem)),
+            ("prof", lambda: measure_prof(problem)),
             ("quality", lambda: measure_quality(problem)),
             ("flight", lambda: measure_flight(problem)),
             ("serve", measure_serve),

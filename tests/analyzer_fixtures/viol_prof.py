@@ -1,0 +1,53 @@
+"""TT310 fixture: phase scopes outside the tt-prof registry / on
+handler paths.
+
+Not imported or executed — parsed by tests/test_analysis.py. tt-prof's
+contract (obs/prof.py): every phase scope string comes from the ONE
+registry (PHASES), statically checkable, and HTTP handler paths never
+enter scopes at all (named_scope is jax machinery on a scrape thread).
+"""
+import jax
+
+from timetabling_ga_tpu.obs import prof as obs_prof
+from timetabling_ga_tpu.obs.prof import scope
+
+
+@obs_prof.scope("tt.breeding")                             # EXPECT TT310
+def decorated_unregistered(x):
+    return x * 2
+
+
+@obs_prof.scope("tt.fitness")
+def decorated_registered_ok(x):
+    return x * 2
+
+
+def freehand_named_scope(x):
+    with jax.named_scope("my_phase"):                      # EXPECT TT310
+        return x + 1
+
+
+def bare_import_unregistered(x):
+    with scope("tt.nope"):                                 # EXPECT TT310
+        return x + 1
+
+
+def dynamic_phase_name(x, which):
+    with obs_prof.scope("tt." + which):                    # EXPECT TT310
+        return x + 1
+
+
+def registered_with_ok(x):
+    with obs_prof.scope("tt.sweep"):
+        return x + 1
+
+
+class StatsHandler:
+    """Duck-typed http.server handler (do_* routing convention)."""
+
+    def do_GET(self):
+        self._render()
+
+    def _render(self):
+        with obs_prof.scope("tt.quality"):                 # EXPECT TT310
+            self.wfile.write(b"ok")

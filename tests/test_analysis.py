@@ -102,6 +102,9 @@ def analyze_fixture(fixture: str):
     "viol_supervisor.py",  # TT307 collectives inside *Supervisor
     #                        recovery-policy bodies (with the healthy-
     #                        path collective as a negative)
+    "viol_prof.py",        # TT310 phase scopes outside the tt-prof
+    #                        registry + scopes on handler paths
+    #                        (tt-prof), with registered-scope negatives
 ])
 def test_rule_fires_at_expected_lines(fixture):
     """Each rule family fires exactly at the marked (rule, line) pairs —

@@ -131,8 +131,9 @@ def _rule_modules():
     from timetabling_ga_tpu.analysis import (
         rules_accord, rules_api, rules_cost, rules_donate,
         rules_edit, rules_fleet, rules_flight, rules_http,
-        rules_interproc, rules_obs, rules_quality, rules_recompile,
-        rules_rng, rules_scale, rules_sync, rules_trace, rules_usage)
+        rules_interproc, rules_obs, rules_prof, rules_quality,
+        rules_recompile, rules_rng, rules_scale, rules_sync,
+        rules_trace, rules_usage)
     return {
         "TT101": rules_trace,
         "TT102": rules_trace,
@@ -147,6 +148,7 @@ def _rule_modules():
         "TT306": rules_interproc,
         "TT307": rules_accord,
         "TT309": rules_edit,
+        "TT310": rules_prof,
         "TT401": rules_rng,
         "TT402": rules_rng,
         "TT501": rules_api,

@@ -17,9 +17,9 @@ import re
 
 ALL_RULES = ("TT101", "TT102", "TT201", "TT202", "TT203", "TT301",
              "TT302", "TT303", "TT304", "TT305", "TT306", "TT307",
-             "TT309", "TT401", "TT402", "TT501", "TT502", "TT601",
-             "TT602", "TT603", "TT604", "TT605", "TT606", "TT607",
-             "TT608")
+             "TT309", "TT310", "TT401", "TT402", "TT501", "TT502",
+             "TT601", "TT602", "TT603", "TT604", "TT605", "TT606",
+             "TT607", "TT608")
 
 
 @dataclasses.dataclass

@@ -1,0 +1,139 @@
+"""TT310 — phase scopes outside the tt-prof registry, or on handler
+paths.
+
+tt-prof (obs/prof.py) attributes device time to phases by joining
+profiler events back to `jax.named_scope` strings. That join is only
+as good as the scope discipline:
+
+  - every phase scope must come from the ONE registry
+    (`obs.prof.PHASES`): a free-hand `jax.named_scope("my_phase")`
+    (or an `obs_prof.scope(...)` with an unregistered / non-literal
+    name) silently lands in the profiler's `unattributed` bucket —
+    or worse, collides with a future registry name and mis-attributes
+    someone else's ops. Scope names are a shared namespace; the
+    registry is where they are declared.
+  - HTTP handler paths (the TT602-reachable set: `do_*` methods, their
+    intra-module callees, `*Api` fronts) must not ENTER scopes at all:
+    `jax.named_scope` pushes onto jax's thread-local trace-name stack,
+    i.e. it is jax machinery on a scrape thread — the pull front's
+    contract is stdlib-only reads (obs/http.py design rules), and a
+    scope pushed around a handler body would stamp the NEXT trace on
+    that thread with a phase that never ran.
+
+Binding-aware like TT309: recognizes `jax.named_scope(...)` directly,
+`obs_prof.scope(...)` / `prof.scope(...)` via import aliases of
+`timetabling_ga_tpu.obs.prof`, and bare names imported with
+`from ...prof import scope` — decorator position included (that is how
+the ops modules thread phases). obs/prof.py itself is exempt: it is
+the registry's implementation and constructs scopes from validated
+variables.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from timetabling_ga_tpu.analysis.core import Finding, qualname
+from timetabling_ga_tpu.analysis.rules_http import _reachable
+from timetabling_ga_tpu.obs.prof import PHASES
+
+RULE = "TT310"
+
+_MODULE = "timetabling_ga_tpu.obs.prof"
+_PHASE_SET = frozenset(PHASES)
+
+# the registry implementation itself (validates names at runtime)
+_EXEMPT_SUFFIXES = ("obs/prof.py",)
+
+
+def _prof_bindings(tree: ast.Module):
+    """(prefixes, names): dotted call prefixes bound to the obs.prof
+    module and bare callables imported from it, across the whole file
+    (function-level lazy imports included)."""
+    prefixes: set[str] = set()
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == _MODULE or a.name.endswith(".prof"):
+                    prefixes.add((a.asname or a.name) + ".")
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == _MODULE or mod.endswith(".prof"):
+                for a in node.names:
+                    if a.name == "scope":
+                        names.add(a.asname or a.name)
+            else:
+                for a in node.names:
+                    if a.name == "prof":
+                        prefixes.add((a.asname or a.name) + ".")
+    return prefixes, names
+
+
+def _scope_call(call: ast.Call, prefixes, names):
+    """The phase-name argument node when `call` enters a phase scope
+    (jax.named_scope or a bound obs.prof scope()), else None-marker
+    False."""
+    qn = qualname(call.func)
+    if qn is None:
+        return False
+    if qn in ("jax.named_scope", "named_scope"):
+        return call.args[0] if call.args else None
+    if qn in names:
+        return call.args[0] if call.args else None
+    if any(qn == p + "scope" for p in prefixes):
+        return call.args[0] if call.args else None
+    return False
+
+
+def check(tree: ast.Module, src: str, path: str, ctx) -> list[Finding]:
+    if RULE not in ctx.config.rules:
+        return []
+    if path.replace("\\", "/").endswith(_EXEMPT_SUFFIXES):
+        return []
+    prefixes, names = _prof_bindings(tree)
+    findings: list[Finding] = []
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        arg = _scope_call(node, prefixes, names)
+        if arg is False:
+            continue
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value not in _PHASE_SET:
+                findings.append(Finding(
+                    RULE, path, node.lineno, node.col_offset,
+                    f"phase scope {arg.value!r} is not in the tt-prof "
+                    f"registry (obs/prof.py PHASES) — unregistered "
+                    f"scopes land in the profiler's `unattributed` "
+                    f"bucket or collide with future registry names; "
+                    f"declare the phase in PHASES or reuse an "
+                    f"existing one"))
+        else:
+            findings.append(Finding(
+                RULE, path, node.lineno, node.col_offset,
+                "phase scope name is not a string literal — the "
+                "tt-prof attribution join is static (registry "
+                "membership must be checkable at lint time); pass a "
+                "literal from obs/prof.py PHASES"))
+
+    # handler paths: entering ANY scope is jax machinery on a scrape
+    # thread (same reachable set as TT602)
+    suffixes = tuple(getattr(ctx.config, "handler_api_suffixes",
+                             ("Api",)))
+    for where, fn in _reachable(tree, suffixes):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if _scope_call(node, prefixes, names) is False:
+                continue
+            findings.append(Finding(
+                RULE, path, node.lineno, node.col_offset,
+                f"phase scope entered on the HTTP handler path "
+                f"`{where}` — named_scope pushes jax's thread-local "
+                f"trace-name stack from a scrape thread; handlers are "
+                f"stdlib-only readers (obs/http.py design rules) and "
+                f"a scope pushed here mis-stamps the next trace on "
+                f"this thread"))
+    return findings

@@ -63,6 +63,16 @@ trace of its next N dispatches into its `--profile-dir`.
 
     python -m timetabling_ga_tpu.cli profile 127.0.0.1:9100 --for 5
 
+`hotspots` subcommand — phase-level device-time attribution (README
+"Phase profiler (tt-prof)"; obs/prof.py): walk a jax.profiler capture
+directory (or the profEntry records of a run's JSONL log), bucket
+device-op durations by their tt.* named_scope phase, and print a
+ranked phase/op table; `--diff A B` prints per-phase deltas between
+two captures.
+
+    python -m timetabling_ga_tpu.cli hotspots /tmp/prof-dir
+    python -m timetabling_ga_tpu.cli hotspots --diff before/ after/
+
 `fleet` / `submit` subcommands — the N-replica serving front (README
 "Fleet"; timetabling_ga_tpu/fleet): a gateway HTTP API with a
 bucket-affine router over replicas (`tt serve --http` workers), and
@@ -120,6 +130,13 @@ def main(argv=None) -> int:
         # capture its next N dispatches (obs/cost.py ProfileCapture)
         from timetabling_ga_tpu.obs.cost import main_profile
         return main_profile(argv[1:])
+    if argv and argv[0] == "hotspots":
+        # deferred + jax-free like trace/stats: rank device time by
+        # tt.* phase from a profiler capture dir (or a log's profEntry
+        # records) and diff two captures (obs/prof.py, README "Phase
+        # profiler")
+        from timetabling_ga_tpu.obs.prof import main_hotspots
+        return main_hotspots(argv[1:])
     if argv and argv[0] == "scale":
         # deferred + jax-free like trace/stats: render the tt-scale
         # autoscaler's decision log (scaleEntry records with their

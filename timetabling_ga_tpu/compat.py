@@ -80,7 +80,7 @@ JAX_COMPAT_TABLE = {
     "jax": ["lax", "numpy",
             # attribute surface (TT502)
             "jit", "vmap", "devices", "local_devices",
-            "block_until_ready",
+            "block_until_ready", "named_scope",
             "make_array_from_callback", "process_count",
             "process_index", "clear_caches", "device_get",
             "device_put",

@@ -402,6 +402,15 @@ class CostProgram:
         cost = extract_cost(exe)
         self._obs.record_compile(self.program, sig, t1 - t0, t2 - t1,
                                  cost, retries=retries)
+        # tt-prof sidecar harvest: the compiled module's per-op
+        # metadata carries the named_scope phase path the trace events
+        # don't — compile time is the only free moment to read it
+        # (the same TT603 argument as extract_cost above)
+        try:
+            from timetabling_ga_tpu.obs import prof as obs_prof
+            obs_prof.note_executable(exe)
+        except Exception:
+            pass
         return {"exe": exe, "cost": cost, "seconds": t2 - t0}
 
     def __call__(self, *args):
@@ -584,7 +593,16 @@ class ProfileCapture:
     the worker (the capture never materializes; dispatches continue),
     `die` ends it — either way nothing on the solve path blocks (tests
     pin it). One capture at a time: `trigger` while one is active
-    answers busy instead of queueing."""
+    answers busy instead of queueing.
+
+    tt-prof rides the worker too: set `on_complete` to a callable of
+    the finished capture's directory (obs/prof.capture_hook — sidecar
+    write + attribution + gauge/profEntry publish) and it runs ON THIS
+    WORKER after each successful stop; its return value is kept as
+    `last()` for the /profile?last=1 poll `tt profile --attribute`
+    reads. Hook failures warn and never break the capture machinery;
+    the close-race teardown path skips the hook (the capture being
+    abandoned was never cleanly stopped)."""
 
     def __init__(self, start_fn, stop_fn, default_dir: str | None = None,
                  registry=None):
@@ -599,6 +617,10 @@ class ProfileCapture:
         self._busy = False        # trigger accepted, capture not closed
         self._remaining = 0       # dispatches left in the live capture
         self._closed = False
+        self.on_complete = None   # callable(dir) after a clean stop
+        self._active_dir = None   # dir of the live capture
+        self._last_attr = None    # last on_complete return (tt-prof)
+        self._completed = 0       # captures fully stopped
         self._thread = threading.Thread(
             target=self._worker, name="tt-profile", daemon=True)
         self._thread.start()
@@ -639,6 +661,15 @@ class ProfileCapture:
         with self._lock:
             return self._busy
 
+    def last(self) -> dict:
+        """Completed-capture count plus the newest attribution result
+        (None until an on_complete hook has produced one). Served by
+        /profile?last=1 — a pure read, like every handler-path touch
+        of this object (TT602)."""
+        with self._lock:
+            return {"completed": self._completed,
+                    "result": self._last_attr}
+
     def _worker(self) -> None:
         while True:
             self._wake.wait()
@@ -676,18 +707,37 @@ class ProfileCapture:
                 self._reg.counter("profile.captures").inc()
                 with self._lock:
                     self._remaining = cmd[1]
+                    self._active_dir = cmd[2]
             elif cmd[0] == "stop":
+                stopped = True
                 try:
                     _faults().maybe_fail("profile")
                     self._stop_fn()
                 except SystemExit:
                     return
                 except Exception as e:
+                    stopped = False
                     print(f"warning: profiler capture failed to stop: "
                           f"{str(e)[:120]}", file=sys.stderr)
                 with self._lock:
                     self._busy = False
                     self._remaining = 0
+                    hook, cdir = self.on_complete, self._active_dir
+                    self._active_dir = None
+                # tt-prof attribution on THIS worker (never the
+                # dispatch path): sidecar + parse + publish; a hook
+                # failure degrades to an unattributed capture, the
+                # capture machinery itself never breaks on it
+                res = None
+                if stopped and hook is not None and cdir is not None:
+                    try:
+                        res = hook(cdir)
+                    except Exception as e:
+                        print(f"warning: profile attribution failed: "
+                              f"{str(e)[:120]}", file=sys.stderr)
+                with self._lock:
+                    self._last_attr = res
+                    self._completed += 1
             # a close() that arrived WITH the command just processed
             # (its wake was consumed above) must end the worker now —
             # looping back to wait() would park the thread forever and
@@ -727,24 +777,40 @@ class ProfileCapture:
 
 
 def main_profile(argv) -> int:
-    """`tt profile <url> [--for N]` — trigger an on-demand profiler
-    capture on a live run/serve process through its `--obs-listen`
-    front (GET /profile?for=N). Stdlib-only and device-free, like
-    `tt trace`/`tt stats`: it talks to the process, it is not one."""
-    url, n = None, 1
+    """`tt profile <url> [--for N] [--attribute [--timeout S]]` —
+    trigger an on-demand profiler capture on a live run/serve process
+    through its `--obs-listen` front (GET /profile?for=N).
+    `--attribute` then polls GET /profile?last=1 until the capture
+    lands and renders the tt-prof phase breakdown (obs/prof.render).
+    Stdlib-only and device-free, like `tt trace`/`tt stats`: it talks
+    to the process, it is not one."""
+    url, n, attrib, timeout_s = None, 1, False, 120.0
     i = 0
     while i < len(argv):
         a = argv[i]
         if a in ("-h", "--help"):
-            print("usage: tt profile <http://host:port> [--for N]\n\n"
+            print("usage: tt profile <http://host:port> [--for N] "
+                  "[--attribute [--timeout S]]\n\n"
                   "ask a live run (--obs-listen) to capture a "
                   "jax.profiler trace of its next N dispatches into "
-                  "its --profile-dir; view with tensorboard/xprof")
+                  "its --profile-dir; view with tensorboard/xprof.\n"
+                  "--attribute waits for the capture to land and "
+                  "renders the tt-prof per-phase device-time table")
             return 0
         if a == "--for":
             if i + 1 >= len(argv):
                 raise SystemExit("flag --for needs a value")
             n = int(argv[i + 1])
+            i += 2
+            continue
+        if a == "--attribute":
+            attrib = True
+            i += 1
+            continue
+        if a == "--timeout":
+            if i + 1 >= len(argv):
+                raise SystemExit("flag --timeout needs a value")
+            timeout_s = float(argv[i + 1])
             i += 2
             continue
         if url is None:
@@ -754,23 +820,49 @@ def main_profile(argv) -> int:
         raise SystemExit(f"unknown argument: {a}")
     if url is None:
         raise SystemExit("usage: tt profile <http://host:port> "
-                         "[--for N]")
+                         "[--for N] [--attribute]")
     if "://" not in url:
         url = "http://" + url
     import json as _json
     import urllib.error
     import urllib.request
-    try:
-        with urllib.request.urlopen(
-                f"{url.rstrip('/')}/profile?for={int(n)}",
-                timeout=10) as resp:
-            body = _json.loads(resp.read().decode())
-    except urllib.error.HTTPError as e:
+
+    def get(path: str) -> dict:
         try:
-            body = _json.loads(e.read().decode())
-        except Exception:
-            body = {"ok": False, "reason": str(e)}
-    except Exception as e:
-        raise SystemExit(f"tt profile: {e}") from None
+            with urllib.request.urlopen(
+                    f"{url.rstrip('/')}{path}", timeout=10) as resp:
+                return _json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                return _json.loads(e.read().decode())
+            except Exception:
+                return {"ok": False, "reason": str(e)}
+        except Exception as e:
+            raise SystemExit(f"tt profile: {e}") from None
+
+    before = get("/profile?last=1").get("completed", 0) if attrib else 0
+    body = get(f"/profile?for={int(n)}")
     print(_json.dumps(body))
-    return 0 if body.get("ok") else 1
+    if not body.get("ok"):
+        return 1
+    if not attrib:
+        return 0
+    # poll until the capture's stop (and its worker-side attribution)
+    # lands — the completed counter bumps exactly once per capture
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        last = get("/profile?last=1")
+        if last.get("completed", 0) > before:
+            res = last.get("result")
+            if res is None:
+                print("tt profile: capture landed but no attribution "
+                      "(no on-complete hook or parse failed)",
+                      file=sys.stderr)
+                return 1
+            from timetabling_ga_tpu.obs import prof as obs_prof
+            print(obs_prof.render(res))
+            return 0
+        time.sleep(0.5)
+    print(f"tt profile: capture did not land within {timeout_s:.0f}s "
+          f"(needs {int(n)} more dispatches?)", file=sys.stderr)
+    return 1

@@ -30,7 +30,10 @@ scrapes and k8s-style probes need no sidecar at all:
   /profile   on-demand profiler trigger (?for=N): flips the cost
              observatory's ProfileCapture state and wakes ITS worker
              thread — no blocking I/O, no registry touch (TT602-pure);
-             `tt profile URL --for N` is the stdlib client
+             `tt profile URL --for N` is the stdlib client. ?last=1
+             reads the newest completed capture's tt-prof phase
+             attribution (obs/prof.py; produced on the capture
+             worker) — the poll `tt profile --attribute` rides
 
 Design rules (enforced by tt-analyze TT602):
 
@@ -257,6 +260,14 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 return
             params = dict(
                 p.split("=", 1) for p in query.split("&") if "=" in p)
+            if params.get("last"):
+                # tt-prof poll: the newest completed capture's
+                # attribution (obs/prof.capture_hook ran on the
+                # capture worker). A pure READ of worker-produced
+                # state — no trigger, no registry touch (TT602).
+                last = capture.last()
+                self._reply_json(200, {"ok": True, **last})
+                return
             try:
                 n = int(params.get("for", 1))
             except ValueError:

@@ -177,6 +177,7 @@ def summarize(records) -> str:
     #                         summarize_entries — the tt-scale
     #                         decision log)
     quality_recs: list = []  # whole records (obs/quality.py summarize)
+    prof_recs: list = []    # profEntry bodies (tt-prof attribution)
     counts: dict = {}
     last_metrics = None
     for rec in records:
@@ -210,6 +211,8 @@ def summarize(records) -> str:
             scale_recs.append(rec)
         elif kind == "qualityEntry":
             quality_recs.append(rec)
+        elif kind == "profEntry":
+            prof_recs.append(body)
         elif kind == "metricsEntry":
             last_metrics = body
 
@@ -387,6 +390,34 @@ def summarize(records) -> str:
                 f"  {trig}: {len(durs)}x, time-to-dump "
                 f"p50 {_pctl(durs, 0.5):.3f}s "
                 f"p99 {_pctl(durs, 0.99):.3f}s")
+
+    if prof_recs:
+        # tt-prof (obs/prof.py): per-phase share of attributed device
+        # time across this log's profiler captures — p50/p95 of each
+        # phase's fraction over the profEntry records, so a phase whose
+        # share GREW between captures shows as a spread, not an average
+        lines.append(f"== phases ({len(prof_recs)} profEntry records)")
+        shares: dict = {}
+        secs: dict = {}
+        for b in prof_recs:
+            for name, ph in (b.get("phases") or {}).items():
+                shares.setdefault(name, []).append(
+                    float(ph.get("frac", 0.0)))
+                secs.setdefault(name, []).append(
+                    float(ph.get("s", 0.0)))
+            shares.setdefault("unattributed", []).append(
+                float(b.get("unattributedFrac", 0.0)))
+            secs.setdefault("unattributed", []).append(
+                float(b.get("unattributedSeconds", 0.0)))
+        order = sorted(shares, key=lambda n: -sorted(shares[n])[
+            min(len(shares[n]) - 1, len(shares[n]) // 2)])
+        for name in order:
+            fr = sorted(shares[name])
+            lines.append(
+                f"  {name}: share p50 {_pctl(fr, 0.5):.1%} "
+                f"p95 {_pctl(fr, 0.95):.1%} "
+                f"({sum(secs[name]):.3f}s over "
+                f"{len(fr)} capture{'s' if len(fr) != 1 else ''})")
 
     if compiles:
         # cost observatory (obs/cost.py): per-program compile count,

@@ -1,0 +1,759 @@
+"""tt-prof phase profiler: phase-level device-time attribution for
+jax.profiler captures, hotspot ranking/diffing, and the profEntry feed.
+
+ROADMAP item 4 is an indictment the rest of tt-obs could not answer:
+gens/s has been flat across bench rounds and `tt profile` captures
+device timelines NOBODY parses — the roofline gauges say how fast the
+machine runs, not WHICH phase of a generation (room matching vs Move1
+sweep vs fitness vs migration) owns the missing time. This module
+closes the capture -> attribute -> rank -> gate loop:
+
+  PHASE SCOPES — `scope(name)` wraps `jax.named_scope` with a single
+  validated registry (`PHASES`); the ops modules and island runners
+  enter a scope around each algorithmic phase so every XLA op's HLO
+  metadata `op_name` carries its phase path. Scopes are METADATA-ONLY:
+  record streams, trajectories and trace counts are bit-identical with
+  scopes on or off (tests/test_prof.py pins it, the TT202 discipline),
+  and TT_PROF_SCOPES=0 is the kill switch that turns every scope into
+  a nullcontext. tt-analyze TT310 rejects free-form scope strings —
+  a typo'd scope silently unattributes.
+
+  SIDECAR JOIN — on CPU (and some TPU runtimes) the trace events carry
+  `{hlo_module, hlo_op}` args but NOT the named_scope path; the scope
+  lives in the compiled module's per-instruction metadata. So the cost
+  observatory calls `note_executable(exe)` at compile time (the one
+  moment introspection is free — the TT603 argument), which regex-walks
+  `exe.as_text()` for `metadata={... op_name="..."}` and keeps a
+  bounded {hlo module -> {op -> phase}} map; `write_scope_map(dir)`
+  drops it as a `tt_scope_map.json` sidecar into the capture dir, and
+  the parser joins trace events against it. Events the sidecar misses
+  fall back to scanning the event strings for `tt.*` tokens; events
+  neither path can place land in an HONEST `unattributed` bucket —
+  never silently folded into a phase.
+
+  ATTRIBUTION — `attribute(capture_dir)` walks a jax.profiler capture
+  directory (the Chrome trace.json.gz the plugin writes), computes
+  per-event SELF time (container ops like `while.N` span their body
+  ops on the same thread — raw durations double-count; a stack pass
+  subtracts each child from its immediate parent), buckets self time
+  by innermost `tt.*` scope, and returns the per-phase table: seconds,
+  fraction of device time, top-K ops per phase.
+
+  WIRING — `capture_hook(out, registry, now)` builds the ProfileCapture
+  on-complete callback: sidecar write + attribute + `publish` into
+  `prof.phase_seconds.<phase>` gauges (the history ring samples them
+  for free) and a `profEntry` JSONL record when an emitter is bound.
+  profEntry is a TIMING record (jsonl.TIMING_RECORDS): the stream
+  identity contract holds with profiling on or off by construction.
+
+  CLI — `tt hotspots DIR|LOG [--top K] [--json]` renders the ranked
+  table from a capture dir or a log's profEntries; `tt hotspots
+  --diff A B` prints per-phase deltas between two captures — the A/B
+  instrument every item-4 kernel attack verifies with.
+
+Import-time stdlib-only, like the rest of obs/ (`tt hotspots` must run
+on a machine with no jax); the one jax touch (named_scope) hides
+behind a function-local import that only engine/serve processes take.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import threading
+
+from timetabling_ga_tpu.obs import metrics as obs_metrics
+
+# THE scope registry: every named_scope string in the package comes
+# from here (tt-analyze TT310 enforces it — a free-form scope string
+# would silently land in `unattributed`). One entry per algorithmic
+# phase of the memetic loop; names are dotted so the innermost-wins
+# attribution can pick them out of an op_name path.
+PHASES = ("tt.fitness", "tt.rooms", "tt.delta", "tt.sweep", "tt.ga",
+          "tt.moves", "tt.migrate", "tt.lahc", "tt.polish",
+          "tt.quality")
+
+_PHASE_SET = frozenset(PHASES)
+
+# kill switch: TT_PROF_SCOPES=0 turns every scope() into a
+# nullcontext and note_executable into a no-op — the bit-identity
+# A/B's other leg, like TT_COST_OBS for the cost observatory
+SCOPES_ENABLED = os.environ.get("TT_PROF_SCOPES", "1") != "0"
+
+# sidecar file name written into a capture dir: the compile-time
+# {hlo module -> {op -> phase}} join table
+SIDECAR = "tt_scope_map.json"
+
+# cap on remembered HLO modules (serve processes compile one program
+# per bucket; a runaway would otherwise grow without bound)
+_MAX_MODULES = 64
+
+
+def short(phase: str) -> str:
+    """Gauge/JSON key for a phase: the registry name minus the `tt.`
+    prefix (`prof.phase_seconds.sweep`, profEntry `phases.sweep`)."""
+    return phase[3:] if phase.startswith("tt.") else phase
+
+
+class _NullScope(contextlib.nullcontext, contextlib.ContextDecorator):
+    """nullcontext that also works as a decorator (stdlib nullcontext
+    grew that only in 3.12) — scope() must swap in for jax.named_scope
+    in BOTH positions when scopes are off."""
+
+
+def scope(name: str):
+    """Phase scope `name` (must be in PHASES) as a jax.named_scope —
+    usable as a context manager or a function decorator, a trace-time
+    METADATA annotation either way: no op changes, no record changes,
+    no compile-cache key changes. Returns a null scope when scopes are
+    disabled (TT_PROF_SCOPES=0) or jax is not importable (host-only
+    tools never pay the import)."""
+    if name not in _PHASE_SET:
+        raise ValueError(
+            f"unknown phase scope {name!r}: tt-prof scopes must come "
+            f"from obs/prof.py PHASES {sorted(_PHASE_SET)}")
+    if not SCOPES_ENABLED:
+        return _NullScope()
+    try:
+        import jax
+    except Exception:        # pragma: no cover - jax-free host tools
+        return _NullScope()
+    return jax.named_scope(name)
+
+
+# ------------------------------------------------- compile-time sidecar
+
+# {hlo module name -> {instruction name -> phase}} harvested from
+# compiled executables; only tt-phased ops are kept (the join table
+# stays small — a few hundred entries per program)
+_SCOPE_MAPS: dict = {}
+# {hlo module name -> {instruction name}} assigned DIFFERENT phases by
+# two same-named executables — the trace only records the module name,
+# so such an op can't be attributed without guessing (note_executable)
+_AMBIG_OPS: dict = {}
+_MAPS_LOCK = threading.Lock()
+
+_HLO_MODULE_RE = re.compile(r"^HloModule\s+([^\s,]+)")
+# one HLO instruction line: `  %name = type op(...), ...,
+# metadata={... op_name="jit(f)/.../tt.sweep/dot_general" ...}` —
+# anchored at the assignment so `calls=`/`dimensions={...}` noise
+# inside the line cannot fake a match
+_HLO_OP_RE = re.compile(
+    r'^\s*(?:ROOT\s+)?%?([^\s=]+)\s+=\s+.*'
+    r'metadata=\{[^}]*op_name="([^"]+)"')
+# any instruction line (metadata or not) — for the call-graph fallback
+_HLO_ANY_OP_RE = re.compile(r'^\s+(?:ROOT\s+)?%?([^\s=]+)\s+=\s+')
+# a computation header starts at column 0: `%name (params...) -> ... {`
+# (the ENTRY computation keeps the module's name and is irrelevant to
+# the fallback — instructions calling into it don't exist)
+_HLO_COMP_RE = re.compile(r'^(?:ENTRY\s+)?%?([^\s(]+)\s*\(')
+# computations an instruction calls into: `calls=%f`, `body=%b`,
+# `condition=%c`, `to_apply=%t` — optimizer-synthesized whiles/fusions
+# often carry NO metadata, so their phase is recovered from the ops of
+# the computations they call (majority vote)
+_HLO_CALLS_RE = re.compile(
+    r'(?:calls|body|condition|to_apply)=%?([\w.\-]+)')
+
+
+def phase_of_op_name(op_name: str):
+    """Innermost `tt.*` component of an HLO op_name path, or None.
+    Scopes nest (`.../tt.ga/.../tt.sweep/dot`): the INNERMOST scope is
+    the phase that actually owns the op — attributing to the outermost
+    would fold every nested phase into `tt.ga`."""
+    last = None
+    for part in op_name.split("/"):
+        if part in _PHASE_SET:
+            last = part
+    return last
+
+
+def note_executable(exe) -> None:
+    """Harvest {op -> phase} from a freshly compiled executable's HLO
+    metadata into the module-keyed sidecar map. Called by the cost
+    observatory at compile time (CostProgram._compile) — the only
+    moment executable introspection is free (TT603); duck-typed and
+    failure-swallowing so a backend without `as_text()` degrades to
+    the substring fallback instead of breaking a compile."""
+    if not SCOPES_ENABLED:
+        return
+    try:
+        text = exe.as_text()
+    except Exception:
+        return
+    if not text:
+        return
+    module = None
+    ops: dict = {}
+    comp_counts: dict = {}      # computation -> {phase -> op count}
+    insts: list = []            # (op, [callee comps], containing comp)
+    comp = None
+    entry_comps: set = set()
+    for line in text.splitlines():
+        if module is None:
+            m = _HLO_MODULE_RE.match(line)
+            if m:
+                module = m.group(1)
+                continue
+        if line and not line[0].isspace():
+            m = _HLO_COMP_RE.match(line)
+            if m:
+                comp = m.group(1)
+                if line.startswith("ENTRY"):
+                    entry_comps.add(comp)
+            continue
+        if " parameter(" in line:
+            continue   # no compute; names repeat across computations
+        m = _HLO_OP_RE.match(line)
+        if m:
+            insts.append((m.group(1), _HLO_CALLS_RE.findall(line),
+                          comp))
+            phase = phase_of_op_name(m.group(2))
+            if phase is not None:
+                ops[m.group(1)] = phase
+                cc = comp_counts.setdefault(comp, {})
+                cc[phase] = cc.get(phase, 0) + 1
+            continue
+        m = _HLO_ANY_OP_RE.match(line)
+        if m and "metadata=" not in line:
+            insts.append((m.group(1), _HLO_CALLS_RE.findall(line),
+                          comp))
+    # Fixpoint over the call graph, both directions. Optimizer-
+    # synthesized whiles/fusions carry no op_name, and whole scan
+    # bodies can end up metadata-free; an unresolved op takes:
+    #   1. UP   the majority phase of the ops inside the computations
+    #           it calls (calls=/body=/condition=/to_apply=), else the
+    #           inherited phase of those computations;
+    #   2. DOWN the phase its own computation inherits from its
+    #           callers — every phase-resolved op calling into a
+    #           computation agrees => the computation runs inside that
+    #           phase (time in a tt.rooms while body IS rooms time);
+    #   3. the majority phase of its sibling ops (non-entry only).
+    # Entry-computation glue with no resolvable phase stays out of
+    # `ops` and lands in the parser's honest `unattributed` bucket —
+    # folding it into the entry's majority would overclaim a phase.
+    # Once resolved, an op votes in its own computation, so nested
+    # synthesized loops resolve outward; bounded iterations (call
+    # graphs are shallow).
+    pending = [i for i in insts if i[0] not in ops]
+    for _ in range(8):
+        caller_ph: dict = {}
+        for op, callees, _owner in insts:
+            ph = ops.get(op)
+            if ph is not None:
+                for c in callees:
+                    caller_ph.setdefault(c, set()).add(ph)
+        comp_phase = {c: next(iter(s))
+                      for c, s in caller_ph.items() if len(s) == 1}
+        progressed = False
+        still = []
+        for op, callees, owner in pending:
+            votes: dict = {}
+            for c in callees:
+                for ph, n in comp_counts.get(c, {}).items():
+                    votes[ph] = votes.get(ph, 0) + n
+            if not votes:
+                for c in callees:
+                    ph = comp_phase.get(c)
+                    if ph is not None:
+                        votes[ph] = votes.get(ph, 0) + 1
+            if not votes and owner not in entry_comps:
+                votes = dict(comp_counts.get(owner, {}))
+                if not votes and owner in comp_phase:
+                    votes = {comp_phase[owner]: 1}
+            if votes:
+                phase = max(votes.items(), key=lambda kv: (kv[1], kv[0]))[0]
+                ops[op] = phase
+                cc = comp_counts.setdefault(owner, {})
+                cc[phase] = cc.get(phase, 0) + 1
+                progressed = True
+            else:
+                still.append((op, callees, owner))
+        pending = still
+        if not progressed or not pending:
+            break
+    if module is None or not ops:
+        return
+    with _MAPS_LOCK:
+        existing = _SCOPE_MAPS.get(module)
+        if existing is None:
+            if len(_SCOPE_MAPS) >= _MAX_MODULES:
+                return
+            _SCOPE_MAPS[module] = ops
+            return
+        # Same module name compiled again. XLA names a module after
+        # the jitted callable, so two structurally DIFFERENT programs
+        # can collide (the islands._donate `name=` parameter keeps the
+        # stock runners distinct, but user jits can still clash) — and
+        # the trace only records the module NAME. Merge the op tables;
+        # an op name two variants put in DIFFERENT phases is dropped
+        # (and pinned dropped) to the honest unattributed bucket
+        # rather than attributed by guess.
+        ambig = _AMBIG_OPS.setdefault(module, set())
+        for name, phase in ops.items():
+            if name in ambig:
+                continue
+            cur = existing.get(name)
+            if cur is None:
+                existing[name] = phase
+            elif cur != phase:
+                del existing[name]
+                ambig.add(name)
+
+
+def write_scope_map(capture_dir: str):
+    """Drop the harvested join table as `tt_scope_map.json` inside
+    `capture_dir` (next to the plugin's `plugins/` tree, so the
+    sidecar travels with the capture). Returns the path, or None when
+    nothing was harvested (the parser then runs on its substring
+    fallback alone)."""
+    with _MAPS_LOCK:
+        if not _SCOPE_MAPS:
+            return None
+        payload = {"modules": {k: dict(v)
+                               for k, v in _SCOPE_MAPS.items()}}
+    try:
+        path = os.path.join(capture_dir, SIDECAR)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        return path
+    except OSError:
+        return None
+
+
+def _reset_scope_maps() -> None:
+    """Test hook: forget every harvested module."""
+    with _MAPS_LOCK:
+        _SCOPE_MAPS.clear()
+        _AMBIG_OPS.clear()
+
+
+# ------------------------------------------------------------ the parser
+
+
+def _find_trace_files(capture_dir: str) -> list:
+    """Trace files of the NEWEST profiler run under `capture_dir` —
+    `plugins/profile/<run>/<host>.trace.json.gz` is where the plugin
+    writes; a dir holding trace files directly, or a single trace file
+    path, is accepted too (synthetic fixtures, copied captures)."""
+    if os.path.isfile(capture_dir):
+        return [capture_dir]
+    direct = sorted(
+        glob.glob(os.path.join(capture_dir, "*.trace.json.gz"))
+        + glob.glob(os.path.join(capture_dir, "*.trace.json")))
+    if direct:
+        return direct
+    runs = sorted(glob.glob(os.path.join(
+        capture_dir, "plugins", "profile", "*")))
+    if not runs:
+        return []
+    newest = runs[-1]
+    return sorted(
+        glob.glob(os.path.join(newest, "*.trace.json.gz"))
+        + glob.glob(os.path.join(newest, "*.trace.json")))
+
+
+def _load_trace(path: str) -> dict:
+    if path.endswith(".gz"):
+        with gzip.open(path, "rt", encoding="utf-8", errors="replace") as f:
+            return json.load(f)
+    with open(path, encoding="utf-8", errors="replace") as f:
+        return json.load(f)
+
+
+def _load_sidecar(capture_dir: str, trace_files: list) -> dict:
+    """The sidecar join table for a capture: looked up next to the
+    capture root AND next to the trace files (copies may keep either
+    layout)."""
+    cands = []
+    if os.path.isdir(capture_dir):
+        cands.append(os.path.join(capture_dir, SIDECAR))
+    for tf in trace_files:
+        cands.append(os.path.join(os.path.dirname(tf), SIDECAR))
+    for path in cands:
+        if os.path.isfile(path):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    return json.load(f).get("modules", {})
+            except (OSError, ValueError):
+                continue
+    return {}
+
+
+def _self_times(events: list) -> list:
+    """Per-event SELF duration for one thread's complete events.
+
+    Container ops (`while.N`, fusion wrappers) are emitted as events
+    spanning their body ops on the SAME thread — summing raw durations
+    counts the body twice. Sort by (ts, -dur) so parents precede their
+    children, then a stack pass subtracts each event's duration from
+    its immediate parent's self time. Returns (event, self_dur) pairs;
+    self is clamped at 0 against clock jitter."""
+    evs = sorted(events, key=lambda e: (e["ts"], -e["dur"]))
+    out = []
+    stack: list = []      # [ev_index_in_out, end_ts]
+    for ev in evs:
+        while stack and stack[-1][1] <= ev["ts"]:
+            stack.pop()
+        out.append([ev, ev["dur"]])
+        if stack:
+            parent = out[stack[-1][0]]
+            parent[1] -= ev["dur"]
+        stack.append([len(out) - 1, ev["ts"] + ev["dur"]])
+    return [(ev, max(0.0, s)) for ev, s in out]
+
+
+def _event_phase(ev: dict, args: dict, sidecar: dict):
+    """Attribute one device-op event: the sidecar join (module+op from
+    the event args against the compile-time map) wins; misses fall
+    back to scanning the event's own strings for `tt.*` tokens, the
+    INNERMOST (last-occurring) token winning — some runtimes inline
+    the scope path into the event name. None = unattributed."""
+    module = args.get("hlo_module")
+    op = args.get("hlo_op") or ev.get("name")
+    if module is not None:
+        phase = sidecar.get(module, {}).get(op)
+        if phase is not None:
+            return phase
+    hay = [str(ev.get("name", ""))]
+    for v in args.values():
+        if isinstance(v, str):
+            hay.append(v)
+    text = "/".join(hay)
+    best, best_pos = None, -1
+    for phase in PHASES:
+        pos = text.rfind(phase)
+        if pos > best_pos:
+            best, best_pos = phase, pos
+    return best if best_pos >= 0 else None
+
+
+def attribute(capture_dir: str, top_k: int = 5) -> dict:
+    """Walk a jax.profiler capture dir and return the per-phase
+    device-time table:
+
+      {"capture_dir": ..., "trace_files": [...], "n_events": N,
+       "total_s": t, "phases": {"sweep": {"seconds": s, "frac": f,
+                                          "top_ops": [[op, s], ...]},
+                                ...},
+       "unattributed_s": u, "unattributed_frac": uf,
+       "unattributed_top_ops": [[op, s], ...]}
+
+    Device ops are the complete ("X") events carrying hlo_op/
+    hlo_module args; their SELF time (container-corrected) is what is
+    bucketed, so total_s is real device-op time, counted once. The
+    `unattributed` bucket is honest: everything neither the sidecar
+    nor the token scan can place, reported — never folded."""
+    trace_files = _find_trace_files(capture_dir)
+    if not trace_files:
+        raise FileNotFoundError(
+            f"no trace.json(.gz) under {capture_dir!r} (expected a "
+            f"jax.profiler capture dir: plugins/profile/<run>/)")
+    sidecar = _load_sidecar(capture_dir, trace_files)
+    phase_s: dict = {}
+    phase_ops: dict = {}
+    unattr_s = 0.0
+    unattr_ops: dict = {}
+    n_events = 0
+    for tf in trace_files:
+        trace = _load_trace(tf)
+        by_tid: dict = {}
+        for ev in trace.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            args = ev.get("args") or {}
+            if "hlo_op" not in args and "hlo_module" not in args:
+                continue
+            try:
+                ts = float(ev["ts"])
+                dur = float(ev.get("dur", 0.0))
+            except (KeyError, TypeError, ValueError):
+                continue
+            if dur <= 0:
+                continue
+            by_tid.setdefault(
+                (ev.get("pid"), ev.get("tid")), []).append(
+                    {"ts": ts, "dur": dur, "name": ev.get("name"),
+                     "args": args})
+        for evs in by_tid.values():
+            for ev, self_us in _self_times(evs):
+                if self_us <= 0:
+                    continue
+                n_events += 1
+                sec = self_us / 1e6
+                phase = _event_phase(ev, ev["args"], sidecar)
+                opname = str(ev["args"].get("hlo_op")
+                             or ev.get("name") or "?")
+                if phase is None:
+                    unattr_s += sec
+                    unattr_ops[opname] = unattr_ops.get(opname, 0.0) + sec
+                else:
+                    phase_s[phase] = phase_s.get(phase, 0.0) + sec
+                    ops = phase_ops.setdefault(phase, {})
+                    ops[opname] = ops.get(opname, 0.0) + sec
+    total = sum(phase_s.values()) + unattr_s
+
+    def top(ops: dict) -> list:
+        return [[op, round(s, 6)] for op, s in
+                sorted(ops.items(), key=lambda kv: -kv[1])[:top_k]]
+
+    phases = {}
+    for phase, sec in sorted(phase_s.items(), key=lambda kv: -kv[1]):
+        phases[short(phase)] = {
+            "seconds": round(sec, 6),
+            "frac": round(sec / total, 4) if total else 0.0,
+            "top_ops": top(phase_ops.get(phase, {}))}
+    return {"capture_dir": str(capture_dir),
+            "trace_files": [os.path.basename(t) for t in trace_files],
+            "n_events": n_events,
+            "total_s": round(total, 6),
+            "phases": phases,
+            "unattributed_s": round(unattr_s, 6),
+            "unattributed_frac": (round(unattr_s / total, 4)
+                                  if total else 0.0),
+            "unattributed_top_ops": top(unattr_ops)}
+
+
+# ------------------------------------------------------- publish / hook
+
+
+def publish(attr: dict, registry=None, out=None, now=None) -> None:
+    """Feed one attribution result into the metrics registry
+    (`prof.phase_seconds.<phase>`, `prof.total_seconds`,
+    `prof.unattributed_seconds` — the history ring samples them for
+    free) and, when an emitter is bound (`--obs`), emit the profEntry
+    record. profEntry is a TIMING record: strip_timing drops it, so
+    the stream identity contract (profiling on vs off) holds by
+    construction."""
+    reg = obs_metrics.REGISTRY if registry is None else registry
+    for name, d in attr.get("phases", {}).items():
+        reg.gauge(f"prof.phase_seconds.{name}").set(d["seconds"])
+    reg.gauge("prof.total_seconds").set(attr.get("total_s", 0.0))
+    reg.gauge("prof.unattributed_seconds").set(
+        attr.get("unattributed_s", 0.0))
+    if out is None:
+        return
+    try:
+        from timetabling_ga_tpu.runtime import jsonl
+        payload = {"dir": attr.get("capture_dir"),
+                   "totalSeconds": attr.get("total_s", 0.0),
+                   "phases": {n: {"s": d["seconds"], "frac": d["frac"],
+                                  "top_ops": d.get("top_ops", [])[:3]}
+                              for n, d in attr.get("phases",
+                                                   {}).items()},
+                   "unattributedSeconds": attr.get("unattributed_s",
+                                                   0.0),
+                   "unattributedFrac": attr.get("unattributed_frac",
+                                                0.0)}
+        ts = None
+        if now is not None:
+            try:
+                ts = max(0.0, float(now()))
+            except Exception:
+                ts = None
+        jsonl.prof_entry(out, payload, ts=ts)
+    except Exception:
+        pass   # telemetry must never fail a capture
+
+
+def capture_hook(out=None, registry=None, now=None):
+    """The ProfileCapture on-complete callback: write the sidecar into
+    the finished capture dir, attribute it, publish gauges/profEntry,
+    and return the attribution (ProfileCapture keeps it as `last()`
+    for the /profile?last=1 poll `tt profile --attribute` rides).
+    Runs on the capture WORKER thread — never the dispatch path."""
+
+    def hook(capture_dir: str):
+        write_scope_map(capture_dir)
+        attr = attribute(capture_dir)
+        publish(attr, registry=registry, out=out, now=now)
+        return attr
+
+    return hook
+
+
+# --------------------------------------------------------- render / diff
+
+
+def render(attr: dict, top_k: int = 3) -> str:
+    """The ranked phase table as text (`tt hotspots`, `tt profile
+    --attribute`)."""
+    lines = [f"== phases ({attr.get('capture_dir', '?')}: "
+             f"{attr.get('n_events', 0)} device ops, "
+             f"{attr.get('total_s', 0.0):.4f}s device time)"]
+    rows = list(attr.get("phases", {}).items())
+    rows.sort(key=lambda kv: -kv[1]["seconds"])
+    for name, d in rows:
+        ops = ", ".join(f"{op} {s:.4f}s"
+                        for op, s in d.get("top_ops", [])[:top_k])
+        lines.append(f"  {('tt.' + name):<13} {d['seconds']:>9.4f}s "
+                     f"{100 * d['frac']:>5.1f}%"
+                     + (f"   {ops}" if ops else ""))
+    ua = attr.get("unattributed_s", 0.0)
+    uf = attr.get("unattributed_frac", 0.0)
+    ops = ", ".join(f"{op} {s:.4f}s"
+                    for op, s in attr.get("unattributed_top_ops",
+                                          [])[:top_k])
+    lines.append(f"  {'unattributed':<13} {ua:>9.4f}s "
+                 f"{100 * uf:>5.1f}%" + (f"   {ops}" if ops else ""))
+    return "\n".join(lines)
+
+
+def diff(a: dict, b: dict) -> dict:
+    """Per-phase deltas B - A between two attribution results: seconds
+    delta and fraction-point delta per phase (union of both sides;
+    `unattributed` included as its own row). The A/B instrument a
+    kernel attack verifies with: phase X should shrink, nothing else
+    should grow."""
+    rows = {}
+    pa = dict(a.get("phases", {}))
+    pb = dict(b.get("phases", {}))
+    for name in sorted(set(pa) | set(pb)):
+        sa = pa.get(name, {}).get("seconds", 0.0)
+        sb = pb.get(name, {}).get("seconds", 0.0)
+        fa = pa.get(name, {}).get("frac", 0.0)
+        fb = pb.get(name, {}).get("frac", 0.0)
+        rows[name] = {"a_s": sa, "b_s": sb,
+                      "delta_s": round(sb - sa, 6),
+                      "delta_frac_pts": round(100 * (fb - fa), 2)}
+    rows["unattributed"] = {
+        "a_s": a.get("unattributed_s", 0.0),
+        "b_s": b.get("unattributed_s", 0.0),
+        "delta_s": round(b.get("unattributed_s", 0.0)
+                         - a.get("unattributed_s", 0.0), 6),
+        "delta_frac_pts": round(
+            100 * (b.get("unattributed_frac", 0.0)
+                   - a.get("unattributed_frac", 0.0)), 2)}
+    return {"a": a.get("capture_dir"), "b": b.get("capture_dir"),
+            "a_total_s": a.get("total_s", 0.0),
+            "b_total_s": b.get("total_s", 0.0),
+            "rows": rows}
+
+
+def render_diff(d: dict) -> str:
+    lines = [f"== phase diff  A={d.get('a')} ({d.get('a_total_s'):.4f}s)"
+             f"  B={d.get('b')} ({d.get('b_total_s'):.4f}s)"]
+    rows = sorted(d.get("rows", {}).items(),
+                  key=lambda kv: -abs(kv[1]["delta_s"]))
+    for name, r in rows:
+        label = name if name == "unattributed" else "tt." + name
+        lines.append(f"  {label:<13} {r['a_s']:>9.4f}s -> "
+                     f"{r['b_s']:>9.4f}s   "
+                     f"{r['delta_s']:+.4f}s "
+                     f"({r['delta_frac_pts']:+.1f} pts)")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------ log input
+
+
+def prof_entries(path: str) -> list:
+    """The profEntry bodies of a JSONL record stream (newest last)."""
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "profEntry" in rec:
+                out.append(rec["profEntry"])
+    return out
+
+
+def _entry_to_attr(entry: dict) -> dict:
+    """A profEntry body re-shaped into the attribute() result shape so
+    render()/diff() serve both inputs."""
+    phases = {}
+    for name, d in (entry.get("phases") or {}).items():
+        phases[name] = {"seconds": d.get("s", 0.0),
+                        "frac": d.get("frac", 0.0),
+                        "top_ops": d.get("top_ops", [])}
+    total = entry.get("totalSeconds", 0.0)
+    return {"capture_dir": entry.get("dir", "?"),
+            "trace_files": [], "n_events": entry.get("n_events", 0),
+            "total_s": total, "phases": phases,
+            "unattributed_s": entry.get("unattributedSeconds", 0.0),
+            "unattributed_frac": entry.get("unattributedFrac", 0.0),
+            "unattributed_top_ops": []}
+
+
+def _load_input(path: str) -> dict:
+    """One `tt hotspots` input: a capture dir (or trace file) is
+    attributed fresh; a JSONL log yields its NEWEST profEntry."""
+    if os.path.isdir(path):
+        return attribute(path)
+    if path.endswith((".json.gz", ".trace.json")):
+        return attribute(path)
+    entries = prof_entries(path)
+    if entries:
+        return _entry_to_attr(entries[-1])
+    # not a log with profEntries — try it as a raw trace file
+    return attribute(path)
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def main_hotspots(argv) -> int:
+    """`tt hotspots <capture-dir|log.jsonl> [--top K] [--json]` /
+    `tt hotspots --diff A B` — ranked phase/op table from a capture
+    dir or a log's profEntry records; --diff prints per-phase deltas
+    between two captures. Stdlib-only and device-free, like
+    `tt trace` (the capture may live on a machine with no jax)."""
+    args = list(argv)
+    top_k, as_json, diff_pair, inputs = 3, False, None, []
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a in ("-h", "--help"):
+            print("usage: tt hotspots <capture-dir|records.jsonl> "
+                  "[--top K] [--json]\n"
+                  "       tt hotspots --diff A B [--json]\n\n"
+                  "rank device time by tt.* phase from a jax.profiler "
+                  "capture dir (plugins/profile/...) or from a log's "
+                  "profEntry records; --diff prints per-phase deltas "
+                  "B - A (each side a capture dir or log)")
+            return 0
+        if a == "--top":
+            if i + 1 >= len(args):
+                raise SystemExit("flag --top needs a value")
+            top_k = int(args[i + 1])
+            i += 2
+            continue
+        if a == "--json":
+            as_json = True
+            i += 1
+            continue
+        if a == "--diff":
+            if i + 2 >= len(args):
+                raise SystemExit("--diff needs two inputs: A B")
+            diff_pair = (args[i + 1], args[i + 2])
+            i += 3
+            continue
+        inputs.append(a)
+        i += 1
+    try:
+        if diff_pair is not None:
+            d = diff(_load_input(diff_pair[0]),
+                     _load_input(diff_pair[1]))
+            print(json.dumps(d) if as_json else render_diff(d))
+            return 0
+        if len(inputs) != 1:
+            raise SystemExit("usage: tt hotspots "
+                             "<capture-dir|records.jsonl> [--top K] "
+                             "[--json]  (or --diff A B)")
+        attr = _load_input(inputs[0])
+        print(json.dumps(attr) if as_json
+              else render(attr, top_k=top_k))
+        return 0
+    except FileNotFoundError as e:
+        print(f"tt hotspots: {e}", file=sys.stderr)
+        return 1

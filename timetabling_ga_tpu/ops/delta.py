@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from timetabling_ga_tpu.obs import prof as obs_prof
 from timetabling_ga_tpu.ops import fitness
 from timetabling_ga_tpu.ops.rooms import capacity_rank, choose_room, occupancy
 
@@ -58,6 +59,7 @@ class LSState(NamedTuple):
     scv: jnp.ndarray     # (P,) int32
 
 
+@obs_prof.scope("tt.delta")
 def init_state(pa, slots, rooms_arr) -> LSState:
     """Build maintained tensors + baseline fitness for a population."""
     pen, hcv, scv = fitness.batch_penalty(pa, slots, rooms_arr)
@@ -84,6 +86,7 @@ def _day_scv(patch_bool):
     return consec + single
 
 
+@obs_prof.scope("tt.delta")
 def _delta_one(pa, slots, rooms_arr, att, occ, evs, new_slots, active,
                cap_rank):
     """Delta evaluation of one padded 3-relocation candidate on one
@@ -181,6 +184,7 @@ def _delta_one(pa, slots, rooms_arr, att, occ, evs, new_slots, active,
     return d_hcv, d_scv, new_rooms
 
 
+@obs_prof.scope("tt.delta")
 def _apply_move(pa, state_i, evs, new_slots, new_rooms):
     """Commit an accepted candidate to one individual's maintained state.
     Inactive pad entries (new == old) cancel exactly in every update.
@@ -204,6 +208,7 @@ def _apply_move(pa, state_i, evs, new_slots, new_rooms):
     return slots, rooms_arr, att32.astype(jnp.int16), occ32.astype(jnp.int16)
 
 
+@obs_prof.scope("tt.delta")
 def batch_local_search_delta(pa, key, slots, rooms_arr, n_rounds: int,
                              n_candidates: int = 8,
                              p1: float = 1.0, p2: float = 1.0,

@@ -30,6 +30,13 @@ import functools
 import jax
 import jax.numpy as jnp
 
+# tt-prof phase scopes (obs/prof.py): @obs_prof.scope("tt.<phase>")
+# wraps tracing in jax.named_scope — metadata-only, so records, RNG
+# streams and compile-cache keys are bit-identical with scopes on or
+# off (tests/test_prof.py asserts this). The profiler's attribution
+# joins device ops back to these names.
+from timetabling_ga_tpu.obs import prof as obs_prof
+
 # Penalty encoding (reference Solution.cpp:167 and ga.cpp:191):
 INFEASIBLE_OFFSET = 1_000_000
 
@@ -46,6 +53,7 @@ def room_onehot(rooms: jnp.ndarray, n_rooms: int) -> jnp.ndarray:
             ).astype(jnp.float32)
 
 
+@obs_prof.scope("tt.fitness")
 def compute_hcv(pa, slots: jnp.ndarray, rooms: jnp.ndarray) -> jnp.ndarray:
     """Hard-constraint violations of one individual (int32 scalar).
 
@@ -83,6 +91,7 @@ def compute_hcv(pa, slots: jnp.ndarray, rooms: jnp.ndarray) -> jnp.ndarray:
         jnp.int32)
 
 
+@obs_prof.scope("tt.fitness")
 def attendance_matrix(pa, slots: jnp.ndarray) -> jnp.ndarray:
     """Per-(student, slot) attended-event counts A (S, T) float32.
 
@@ -93,6 +102,7 @@ def attendance_matrix(pa, slots: jnp.ndarray) -> jnp.ndarray:
     return pa.attends @ X.T                         # (S, T)
 
 
+@obs_prof.scope("tt.fitness")
 def scv_from_attendance(pa, slots: jnp.ndarray,
                         att: jnp.ndarray) -> jnp.ndarray:
     """Soft-constraint violations given the attendance count matrix.
@@ -138,6 +148,7 @@ def base_penalty(hcv, scv):
     return jnp.where(hcv == 0, scv, INFEASIBLE_OFFSET + hcv)
 
 
+@obs_prof.scope("tt.fitness")
 def anchor_cost(pa, slots) -> jnp.ndarray:
     """Anchored-objective term of one individual (int32 scalar):
     `sum_e anchor_w[e] * [slots[e] != anchor_slots[e]]` — a weighted
@@ -150,6 +161,7 @@ def anchor_cost(pa, slots) -> jnp.ndarray:
                    * (slots != pa.anchor_slots).astype(jnp.int32))
 
 
+@obs_prof.scope("tt.fitness")
 def anchor_delta(pa, slots, evs, new_slots) -> jnp.ndarray:
     """Anchor-cost change of a sparse move: events `evs` (M,) moving from
     `slots[evs]` to `new_slots` (M,). Inactive move lanes (padding in the

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from timetabling_ga_tpu.obs import prof as obs_prof
 from timetabling_ga_tpu.ops import fitness
 from timetabling_ga_tpu.ops.moves import random_move
 from timetabling_ga_tpu.ops.rooms import (
@@ -116,6 +117,7 @@ def evaluate(pa, slots, rooms_arr) -> PopState:
                     penalty=penalty[order], hcv=hcv[order], scv=scv[order])
 
 
+@obs_prof.scope("tt.ga")
 def init_population(pa, key, pop_size: int,
                     cfg: "GAConfig" = None) -> PopState:
     """Random initial population: uniform random timeslots then greedy room
@@ -162,6 +164,7 @@ def tournament(key, penalty: jnp.ndarray, scv: jnp.ndarray,
     return draws[jnp.lexsort((scv[draws], penalty[draws]))[0]]
 
 
+@obs_prof.scope("tt.ga")
 def _make_child(pa, key, state: PopState, cfg: GAConfig, mo_stats=None):
     """Breed one child: 2x tournament -> crossover(p) -> mutation(p).
 
@@ -214,6 +217,7 @@ def _make_child(pa, key, state: PopState, cfg: GAConfig, mo_stats=None):
     return slots, rooms_arr, do_x, do_m, ia
 
 
+@obs_prof.scope("tt.ga")
 def generation(pa, key, state: PopState, cfg: GAConfig,
                with_quality: bool = False):
     """One generation: breed P children in a single vmapped batch, then

@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from timetabling_ga_tpu.obs import prof as obs_prof
 from timetabling_ga_tpu.ops import fitness
 from timetabling_ga_tpu.ops.delta import (
     LSState, _apply_move, _delta_one, init_state)
@@ -84,6 +85,7 @@ def _lex_lt(p_a, s_a, p_b, s_b):
     return (p_a < p_b) | ((p_a == p_b) & (s_a < s_b))
 
 
+@obs_prof.scope("tt.lahc")
 def init_lahc(pa, slots, rooms_arr, hist_len: int) -> LahcState:
     """Start P walkers at the given genotypes; history primed with each
     walker's initial cost (the standard LAHC initialization: hist[k] :=
@@ -100,6 +102,7 @@ def init_lahc(pa, slots, rooms_arr, hist_len: int) -> LahcState:
         best_pen=ls.pen, best_hcv=ls.hcv, best_scv=ls.scv)
 
 
+@obs_prof.scope("tt.lahc")
 def lahc_steps(pa, key, state: LahcState, n_steps,
                p1: float = 1.0, p2: float = 1.0, p3: float = 0.0,
                k_cands: int = 1):

@@ -32,10 +32,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from timetabling_ga_tpu.obs import prof as obs_prof
 from timetabling_ga_tpu.ops.rooms import (
     capacity_rank, choose_room, occupancy)
 
 
+@obs_prof.scope("tt.moves")
 def move1(pa, slots, rooms_arr, e, t, cap_rank=None):
     """Move event `e` to timeslot `t` (Solution::Move1, Solution.cpp:357).
 
@@ -55,6 +57,7 @@ def move1(pa, slots, rooms_arr, e, t, cap_rank=None):
     return slots.at[e].set(t), rooms_arr.at[e].set(r)
 
 
+@obs_prof.scope("tt.moves")
 def move2(pa, slots, rooms_arr, e1, e2, cap_rank=None):
     """Swap the timeslots of events e1, e2 (Solution::Move2,
     Solution.cpp:378); both are re-roomed in their new slots."""
@@ -74,6 +77,7 @@ def move2(pa, slots, rooms_arr, e1, e2, cap_rank=None):
     return slots, rooms_arr
 
 
+@obs_prof.scope("tt.moves")
 def move3(pa, slots, rooms_arr, e1, e2, e3, cap_rank=None):
     """3-cycle: e1 -> slot of e2, e2 -> slot of e3, e3 -> slot of e1
     (Solution::Move3, Solution.cpp:405; the local search tries both cycle
@@ -98,6 +102,7 @@ def move3(pa, slots, rooms_arr, e1, e2, e3, cap_rank=None):
     return slots, rooms_arr
 
 
+@obs_prof.scope("tt.moves")
 def sample_move(pa, key, slots,
                 p1: float = 1.0, p2: float = 1.0, p3: float = 0.0):
     """Sample one random move in padded 3-relocation form.
@@ -140,6 +145,7 @@ def sample_move(pa, key, slots,
     return evs, new_slots, active
 
 
+@obs_prof.scope("tt.moves")
 def apply_relocation(pa, slots, rooms_arr, evs, new_slots, active,
                      cap_rank=None):
     """Apply a padded 3-relocation: remove the active events from the
@@ -164,6 +170,7 @@ def apply_relocation(pa, slots, rooms_arr, evs, new_slots, active,
     return slots, rooms_arr
 
 
+@obs_prof.scope("tt.moves")
 def random_move(pa, key, slots, rooms_arr,
                 p1: float = 1.0, p2: float = 1.0, p3: float = 0.0,
                 cap_rank=None):

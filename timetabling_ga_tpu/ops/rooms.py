@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from timetabling_ga_tpu.obs import prof as obs_prof
+
 # Composite-key weights: marginal hcv cost >> suitability tie >> capacity.
 _W_COST = 1 << 13
 _W_UNSUIT = 1 << 12
@@ -87,6 +89,7 @@ def _room_key(pa, occ_row: jnp.ndarray, event: jnp.ndarray,
             + _dead_rooms(pa))
 
 
+@obs_prof.scope("tt.rooms")
 def choose_room(pa, occ_row: jnp.ndarray, event: jnp.ndarray,
                 cap_rank: jnp.ndarray = None) -> jnp.ndarray:
     """Pick a room for `event` given its slot's occupancy counts (R,).
@@ -101,6 +104,7 @@ def choose_room(pa, occ_row: jnp.ndarray, event: jnp.ndarray,
         jnp.int32)
 
 
+@obs_prof.scope("tt.rooms")
 def assign_rooms(pa, slots: jnp.ndarray) -> jnp.ndarray:
     """Full-solution room matching: (E,) slots -> (E,) rooms.
 
@@ -146,6 +150,7 @@ def batch_assign_rooms(pa, slots: jnp.ndarray) -> jnp.ndarray:
 _BIG = 1 << 20
 
 
+@obs_prof.scope("tt.rooms")
 def augment_rooms(pa, slots: jnp.ndarray, rooms_arr: jnp.ndarray,
                   n_rounds: int = 4, cap_rank: jnp.ndarray = None
                   ) -> jnp.ndarray:
@@ -295,6 +300,7 @@ def augment_rooms(pa, slots: jnp.ndarray, rooms_arr: jnp.ndarray,
                      rooms_arr)
 
 
+@obs_prof.scope("tt.rooms")
 def parallel_assign_rooms(pa, slots: jnp.ndarray,
                           n_rounds: int = 4) -> jnp.ndarray:
     """O(1)-depth room assignment: best-fit init + bounded augmentation.
@@ -323,6 +329,7 @@ def batch_parallel_assign_rooms(pa, slots: jnp.ndarray,
         lambda s: parallel_assign_rooms(pa, s, n_rounds))(slots)
 
 
+@obs_prof.scope("tt.rooms")
 def occupancy(pa, slots: jnp.ndarray, rooms: jnp.ndarray) -> jnp.ndarray:
     """Occupancy counts (T, R) of one solution — the dense replacement for
     the reference's ragged `timeslot_events` index (Solution.h:37).

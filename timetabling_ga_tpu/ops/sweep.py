@@ -55,6 +55,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from timetabling_ga_tpu.obs import prof as obs_prof
 from timetabling_ga_tpu.ops import fitness
 from timetabling_ga_tpu.ops.delta import (
     LSState, _apply_move, _day_scv, _delta_one, init_state)
@@ -73,6 +74,7 @@ def _neighbor_masks(b):
     return bp[:, :, :-4], bp[:, :, 1:-3], bp[:, :, 3:-1], bp[:, :, 4:]
 
 
+@obs_prof.scope("tt.sweep")
 def _move1_sweep(pa, slots, rooms_arr, att, occ, e, cap_rank):
     """Delta-evaluate Move1(e, t) for EVERY target slot t of one
     individual. Returns (d_hcv (T,), d_scv (T,), new_rooms (T,)).
@@ -167,6 +169,7 @@ def _distinct_pad(e1, e2, E: int):
     return jnp.where(pad == e2, (e1 + 2) % E, pad)
 
 
+@obs_prof.scope("tt.sweep")
 def event_heat(pa, slots, rooms_arr, att, occ, hcv):
     """Per-event violation involvement of ONE individual — the tensor
     form of the reference's sweep skip rule (phase 1 examines an event
@@ -223,6 +226,7 @@ def event_heat(pa, slots, rooms_arr, att, occ, hcv):
     return jnp.where(hcv > 0, hcv_heat, scv_heat) * pa.event_mask
 
 
+@obs_prof.scope("tt.sweep")
 def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
                block_events: int = 1, sideways: float = 0.0,
                hot_k: int = 0, p3: float = 0.0,
@@ -587,6 +591,7 @@ def sweep_pass(pa, key, state: LSState, swap_block: int = 8,
     return state, accepted.any()
 
 
+@obs_prof.scope("tt.sweep")
 def sweep_local_search(pa, key, slots, rooms_arr, n_sweeps: int,
                        swap_block: int = 8, converge: bool = False,
                        block_events: int = 1, sideways: float = 0.0,

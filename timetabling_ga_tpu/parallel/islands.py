@@ -47,6 +47,7 @@ from timetabling_ga_tpu.compat import shard_map
 # stdlib-only layout constants + host decode of the quality block the
 # runners append to the telemetry leaf (README "Search-quality
 # observatory"); the device-side packing lives HERE, with the leaf
+from timetabling_ga_tpu.obs import prof as obs_prof
 from timetabling_ga_tpu.obs import quality as obs_quality
 from timetabling_ga_tpu.ops import fitness, ga
 
@@ -67,7 +68,7 @@ def _mark_trace(tag: str) -> None:
     TRACE_COUNTS[tag] += 1
 
 
-def _donate(fn, donate: bool, argnum: int):
+def _donate(fn, donate: bool, argnum: int, name: str = None):
     """jit a runner, optionally donating its PopState/LahcState argument.
 
     Donation lets XLA alias the (up to pop 32768 x events) population
@@ -79,8 +80,29 @@ def _donate(fn, donate: bool, argnum: int):
     dispatch: callers that reuse the input state afterwards (tests,
     exploratory notebooks) would hit 'Array has been deleted'. The
     engine opts in and never reuses a dispatched state (tt-analyze
-    TT203 is the lint guard for that discipline)."""
-    return jax.jit(fn, donate_argnums=(argnum,) if donate else ())
+    TT203 is the lint guard for that discipline).
+
+    `name` becomes the compiled HLO module's name (jit_<name>). Every
+    builder here names its variant after its STATIC build parameters:
+    an engine run compiles several structurally different programs
+    from functions all called `_run`, and XLA would name every one of
+    them `jit__run` — the tt-prof sidecar (obs/prof.py) joins trace
+    events to phases by (module, op), so same-named variants would
+    shadow each other's op tables and the executed variant's ops could
+    look unattributable. Purely a label: no cache key, record, or
+    numeric depends on it."""
+    return _named_jit(fn, name, donate_argnums=(argnum,) if donate else ())
+
+
+def _named_jit(fn, name: str = None, **jit_kwargs):
+    """jax.jit with an explicit HLO module name (see _donate)."""
+    if name is not None:
+        try:
+            fn.__name__ = name
+            fn.__qualname__ = name
+        except (AttributeError, TypeError):
+            pass
+    return jax.jit(fn, **jit_kwargs)
 
 
 def delete_state(state) -> None:
@@ -187,6 +209,7 @@ def init_island_population(pa, key, mesh: Mesh, pop_size: int,
     return _init(pa, key)
 
 
+@obs_prof.scope("tt.migrate")
 def _migrate(state: ga.PopState, n_islands: int, L: int = 1,
              return_gain: bool = False):
     """Bidirectional ring migration of 1 migrant each way over ALL
@@ -368,7 +391,9 @@ def make_island_runner(mesh: Mesh, cfg: ga.GAConfig, n_epochs: int,
         global_best = lax.pmin(best_local, AXIS)
         return state, trace, global_best
 
-    return _donate(_run, donate, 2)
+    return _donate(_run, donate, 2,
+                   name=(f"isl_run_e{n_epochs}x{gens_per_epoch}"
+                         f"_{trace_mode}" + ("_q" if quality else "")))
 
 
 # Python int, NOT a jnp scalar: a module-level device array would
@@ -466,6 +491,7 @@ def _hamming_stride(pop: int) -> int:
     return 1
 
 
+@obs_prof.scope("tt.quality")
 def _div_stats(event_mask, slots, pen, scv):
     """One island's diversity block: (obs_quality.N_DIV,) bitcast-int32
     of penalty mean/var/min/max, scv mean/var/min/max, and the bounded
@@ -715,6 +741,7 @@ def make_polish_runner(mesh: Mesh, cfg: ga.GAConfig,
         out_specs=(ga.PopState(slots=P(AXIS), rooms=P(AXIS), penalty=P(AXIS),
                                hcv=P(AXIS), scv=P(AXIS)), P(None, AXIS)),
         check_vma=False)
+    @obs_prof.scope("tt.polish")
     def _polish(pa, key, state, n_sweeps):
         from timetabling_ga_tpu.ops.sweep import sweep_local_search
         my_key = jax.random.fold_in(key, lax.axis_index(AXIS))
@@ -748,7 +775,8 @@ def make_polish_runner(mesh: Mesh, cfg: ga.GAConfig,
                 axis=0)
         return st, stats
 
-    return _donate(_polish, donate, 2)
+    return _donate(_polish, donate, 2,
+                   name="polish" + ("_wp" if with_passes else ""))
 
 
 # Hard bound on the kick's runtime perturbation depth (the scan length
@@ -822,7 +850,7 @@ def make_kick_runner(mesh: Mesh, cfg: ga.GAConfig,
         return _flat(jax.vmap(kick_island)(
             sb, jax.random.split(my_key, L)))
 
-    return _donate(_kick, donate, 2)
+    return _donate(_kick, donate, 2, name=f"kick_m{max_moves}")
 
 
 def make_shrink_runner(mesh: Mesh, pop_in: int, pop_out: int,
@@ -853,7 +881,7 @@ def make_shrink_runner(mesh: Mesh, pop_in: int, pop_out: int,
         blk = _blocks(state, L, pop_in)
         return _flat(jax.tree.map(lambda x: x[:, :pop_out], blk))
 
-    return jax.jit(_shrink)
+    return _named_jit(_shrink, name=f"shrink_{pop_in}to{pop_out}")
 
 
 def _lahc_specs():
@@ -944,8 +972,11 @@ def make_lahc_runners(mesh: Mesh, cfg: ga.GAConfig, hist_len: int,
             lstate.best_scv.reshape(L, pop))
         return _flat(blk)
 
-    return (_donate(_init, donate, 1), _donate(_run, donate, 2),
-            _donate(_finalize, donate, 0))
+    return (_donate(_init, donate, 1, name=f"lahc_init_h{hist_len}"),
+            _donate(_run, donate, 2,
+                    name=(f"lahc_run_h{hist_len}_k{k_cands}"
+                          + ("_m" if with_moments else ""))),
+            _donate(_finalize, donate, 0, name="lahc_fin"))
 
 
 def make_island_runner_dynamic(mesh: Mesh, cfg: ga.GAConfig,
@@ -1031,7 +1062,9 @@ def make_island_runner_dynamic(mesh: Mesh, cfg: ga.GAConfig,
         global_best = lax.pmin(best_local, AXIS)
         return state, trace, global_best
 
-    return _donate(_run, donate, 2)
+    return _donate(_run, donate, 2,
+                   name=(f"isl_rundyn_g{max_gens}_{trace_mode}"
+                         + ("_q" if quality else "")))
 
 
 # ---------------------------------------------------------------------------
@@ -1076,7 +1109,7 @@ def make_lane_init(mesh: Mesh, pop_size: int, cfg: ga.GAConfig,
         _mark_trace("lane_init")
         return _init(pa_l, seeds)
 
-    return jax.jit(run)
+    return _named_jit(run, name=f"lane_init_p{pop_size}_l{n_lanes}")
 
 
 def make_lane_runner(mesh: Mesh, cfg: ga.GAConfig, max_gens: int,
@@ -1180,4 +1213,6 @@ def make_lane_runner(mesh: Mesh, cfg: ga.GAConfig, max_gens: int,
         _mark_trace("lane_runner")
         return _run(pa_l, seeds, chunks, state, gens)
 
-    return _donate(run, donate, 3)
+    return _donate(run, donate, 3,
+                   name=(f"lane_run_g{max_gens}_l{n_lanes}_{trace_mode}"
+                         + ("_q" if quality else "")))

@@ -230,14 +230,54 @@ class ControlChannel:
         agreement instead of hanging at its missing collective); a
         peer whose heartbeat died raises PeerLost within
         --peer-timeout. This is what converts 'infinite hang inside
-        the collective' into a classified host-side fault."""
+        the collective' into a classified host-side fault.
+
+        Instrumented (tt-prof satellite): the whole-rendezvous wait
+        lands in the `accord.fence_wait_s` histogram and each peer's
+        individual wait in `accord.peer_wait_s.<p>` gauges — fence
+        waits ARE the straggler diagnostic (a persistently-slow peer
+        shows up as a skewed gauge long before it misses a timeout).
+        Host-side and registry-only: the record stream is untouched."""
         if self.nproc == 1:
             return
         base = f"e{self.epoch}/g/{self._next('g')}"
+        t0 = time.monotonic()
         self._set(f"{base}/{self.pid}", "1")
         for p in range(self.nproc):
             if p != self.pid:
                 self._await(f"{base}/{p}", peer=p)
+                self._observe_peer_wait(p, time.monotonic() - t0)
+        self._observe_fence_wait(time.monotonic() - t0)
+
+    # fence-wait instrumentation: the process-global registry unless
+    # the channel was handed a private one (serve embeds its own).
+    # Failure-swallowing — the channel must keep agreeing even when
+    # the registry is frozen mid-snapshot or the obs package is
+    # stripped from a deployment.
+    _registry = None
+
+    def _observe_fence_wait(self, wait_s: float) -> None:
+        try:
+            reg = self._registry
+            if reg is None:
+                from timetabling_ga_tpu.obs import metrics as obs_metrics
+                reg = obs_metrics.REGISTRY
+            reg.histogram("accord.fence_wait_s").observe(wait_s)
+        except Exception:
+            pass
+
+    def _observe_peer_wait(self, peer: int, wait_s: float) -> None:
+        """Per-peer arrival gauge: wait from THIS process's fence entry
+        until `peer`'s arrival was observed — the cross-peer spread of
+        these gauges is the fence's straggler skew."""
+        try:
+            reg = self._registry
+            if reg is None:
+                from timetabling_ga_tpu.obs import metrics as obs_metrics
+                reg = obs_metrics.REGISTRY
+            reg.gauge(f"accord.peer_wait_s.{peer}").set(wait_s)
+        except Exception:
+            pass
 
     def agree_on_fault(self, local_verdict: dict) -> dict:
         """Fault-recovery consensus: post this process's verdict

@@ -226,10 +226,11 @@ def cached_init(mesh, pop_size: int, gacfg: ga.GAConfig,
     k = (_mesh_key(mesh), pop_size, gacfg, n_islands)
     f = _INIT_CACHE.get(k)
     if f is None:
-        f = obs_cost.instrument(
-            jax.jit(lambda pa, key: islands.init_island_population(
-                pa, key, mesh, pop_size, gacfg, n_islands=n_islands)),
-            "init")
+        init_fn = lambda pa, key: islands.init_island_population(
+            pa, key, mesh, pop_size, gacfg, n_islands=n_islands)
+        init_fn.__name__ = init_fn.__qualname__ = \
+            f"init_pop{pop_size}_i{n_islands}"
+        f = obs_cost.instrument(jax.jit(init_fn), "init")
         _INIT_CACHE[k] = f
     return f
 
@@ -993,6 +994,13 @@ def run(cfg: RunConfig, out=None) -> int:
                 lambda d: jax.profiler.start_trace(d),
                 jax.profiler.stop_trace,
                 default_dir=cfg.profile_dir)
+            # tt-prof: finished captures attribute themselves on the
+            # capture worker — sidecar write, per-phase device-time
+            # parse, prof.phase_seconds.* gauges, and (under --obs)
+            # the profEntry record through THIS run's writer
+            from timetabling_ga_tpu.obs import prof as obs_prof
+            prof_cap.on_complete = obs_prof.capture_hook(
+                writer if cfg.obs else None, now=tracer.now)
             if cfg.profile_for > 0:
                 prof_cap.trigger(cfg.profile_for)
         if cfg.obs_listen:

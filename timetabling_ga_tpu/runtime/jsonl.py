@@ -446,6 +446,32 @@ def cost_entry(stream: IO, program: str, **extra) -> None:
     _write(stream, {"costEntry": rec})
 
 
+def prof_entry(stream: IO, payload: dict, ts=None, **extra) -> None:
+    """Observability EXTENSION record (tt-prof phase profiler,
+    obs/prof.py; emitted only when a run's capture hook has a bound
+    emitter — i.e. under --obs): one attributed profiler capture —
+
+      {"profEntry":{"dir":"tt-profile","totalSeconds":2.31,
+                    "phases":{"sweep":{"s":1.1,"frac":0.47,
+                                       "top_ops":[["fusion.3",0.8]]},
+                              ...},
+                    "unattributedSeconds":0.12,
+                    "unattributedFrac":0.05,"ts":41.2}}
+
+    Per-phase DEVICE self-time of one jax.profiler capture, bucketed
+    by tt.* scope (obs/prof.py attribute); `tt hotspots LOG` and the
+    `tt stats` "== phases" section read these so a log alone answers
+    "where did the time go". Pure timing telemetry: strip_timing drops
+    the whole record, so the stream identity contract (profiling on vs
+    off) holds by construction."""
+    rec = dict(payload)
+    if ts is not None:
+        rec["ts"] = round(max(0.0, float(ts)), 6)
+    for k, v in extra.items():
+        rec[k] = v
+    _write(stream, {"profEntry": rec})
+
+
 def route_entry(stream: IO, job: str, bucket, replica: str,
                 outcome: str, **extra) -> None:
     """Observability EXTENSION record (tt-obs v5, the fleet
@@ -556,7 +582,7 @@ TIMING_FIELDS = {"logEntry": ("time",), "solution": ("totalTime",),
 # qualityEntry/timing records — tests/test_quality.py).
 TIMING_RECORDS = ("phase", "faultEntry", "spanEntry", "metricsEntry",
                   "costEntry", "qualityEntry", "routeEntry",
-                  "usageEntry", "scaleEntry")
+                  "usageEntry", "scaleEntry", "profEntry")
 
 
 def strip_timing(records: List[dict]) -> List[dict]:

@@ -138,6 +138,14 @@ class SolveService:
                 lambda d: jax.profiler.start_trace(d),
                 jax.profiler.stop_trace,
                 default_dir=cfg.profile_dir)
+            # tt-prof, mirroring engine.run's wiring: finished
+            # captures attribute themselves on the capture worker
+            # into THIS service's registry (and its writer under
+            # --obs — profEntry is a TIMING record)
+            from timetabling_ga_tpu.obs import prof as obs_prof
+            self.profile_capture.on_complete = obs_prof.capture_hook(
+                self.writer if cfg.obs else None,
+                registry=self._registry, now=self.tracer.now)
             if cfg.profile_for > 0:
                 self.profile_capture.trigger(cfg.profile_for)
         # tt-meter (obs/usage.py, README "Usage metering"): the usage

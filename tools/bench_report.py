@@ -122,6 +122,16 @@ _METRICS = [
     ("edit distance warm", "edit.warm", "edit_distance"),
     ("edit demoted warm", "edit.warm", "demoted"),
     ("edit identical w0", "edit", "records_identical_w0"),
+    # extra.prof (ISSUE 20, tt-prof): profiler-capture overhead on the
+    # dispatch loop, where the attributed device time went (the item-4
+    # attack order), the honest unattributed remainder, and the
+    # capture-off/on stream identity
+    ("prof ms/dispatch", "prof", "prof_overhead_ms_per_dispatch"),
+    ("prof frac rooms", "prof", "frac_rooms"),
+    ("prof frac sweep", "prof", "frac_sweep"),
+    ("prof frac fitness", "prof", "frac_fitness"),
+    ("prof unattributed", "prof", "unattributed_frac"),
+    ("prof identical", "prof", "records_identical_modulo_timing"),
 ]
 
 _NUM = r"(-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)"
@@ -324,6 +334,46 @@ def _scaling_section(rounds, multis) -> list:
                          f"r{_fmt(m['round'])} "
                          f"{_fmt(m['n_devices'])}dev "
                          f"gens={_fmt(m['gens'])}" for m in multis))
+    # gens/s vs devices AND hosts (ROADMAP item 2): one curve per
+    # round from whatever width legs that round recorded — the
+    # 1-device generation_parallel point, the full-mesh width the
+    # serve_mesh leg proved, and the multichip dry-run width. Rounds
+    # with no multi-host leg say so explicitly rather than letting a
+    # single-host curve read as a scaling result.
+    by_round_dev = {m["round"]: m["n_devices"] for m in multis}
+    curve_rows = []
+    for r in rounds:
+        m = r["metrics"]
+        g1 = m.get("gens/s parallel")
+        if g1 is None:
+            continue
+        pts = [f"1dev {_fmt(g1)} gens/s"]
+        ndev = m.get("devices") or by_round_dev.get(r["round"])
+        if ndev and ndev > 1:
+            pts.append(f"widest proven {_fmt(ndev)}dev")
+        curve_rows.append(f"  r{_fmt(r['round'])}: " + ", ".join(pts))
+    if curve_rows:
+        lines.append("gens/s vs devices/hosts (generation_parallel "
+                     "point + widest proven mesh; no multi-HOST "
+                     "throughput leg recorded yet — item 2's open "
+                     "half):")
+        lines.extend(curve_rows)
+    # tt-prof (ISSUE 20): where the attributed device time went, per
+    # round — the phase mix that orders the item-4 kernel attacks
+    prof = [(r["round"], r["metrics"].get("prof frac rooms"),
+             r["metrics"].get("prof frac sweep"),
+             r["metrics"].get("prof frac fitness"),
+             r["metrics"].get("prof unattributed"))
+            for r in rounds
+            if r["metrics"].get("prof frac rooms") is not None]
+    if prof:
+        lines.append("phase mix (extra.prof, fraction of attributed "
+                     "device time): "
+                     + ", ".join(
+                         f"r{_fmt(n)} rooms {_fmt(ro)} sweep "
+                         f"{_fmt(sw)} fitness {_fmt(fi)} "
+                         f"unattributed {_fmt(ua)}"
+                         for n, ro, sw, fi, ua in prof))
     return lines
 
 

@@ -6,13 +6,18 @@
 #   tools/ci_check.sh --analyze-only # the strict whole-program analyzer
 #                                    # pass alone (editor/pre-commit hook
 #                                    # speed: seconds)
+#   tools/ci_check.sh --perf FILE    # perf-regression gate alone: a
+#                                    # fresh bench JSON (or driver
+#                                    # capture) vs the newest committed
+#                                    # BENCH_r*.json (tools/perf_gate.py)
 #
 # Steps (each failure is fatal):
 #   1. tt-analyze --strict --warn-unused-ignores over timetabling_ga_tpu/
-#      — the JAX-aware static rules, 25 of them including the
+#      — the JAX-aware static rules, 26 of them including the
 #      whole-program device-taint/donation/fence/residency pass
-#      (TT303/TT304/TT305/TT306) and the tt-accord recovery-path
-#      collective ban (TT307), plus stale-suppression detection
+#      (TT303/TT304/TT305/TT306), the tt-accord recovery-path
+#      collective ban (TT307) and the tt-prof phase-registry check
+#      (TT310), plus stale-suppression detection
 #      (TT901; README "Static analysis & sanitizers")
 #   2. python -m compileall — syntax across every tree we ship
 #   3. the tier-1 pytest command from ROADMAP.md
@@ -24,6 +29,21 @@ fail=0
 step() {
     echo "== ci_check: $1" >&2
 }
+
+if [ "${1:-}" = "--perf" ]; then
+    # standalone mode: no analyzer/test run — compare a fresh bench
+    # result against the committed perf history and exit nonzero on a
+    # regression beyond tolerance (tools/perf_gate.py)
+    if [ -z "${2:-}" ]; then
+        echo "usage: ci_check.sh --perf <fresh-bench.json>" >&2
+        exit 2
+    fi
+    step "perf gate (tools/perf_gate.py vs newest BENCH_r*.json)"
+    python tools/perf_gate.py "$2" || fail=1
+    [ "$fail" -eq 0 ] && step "OK (perf gate)"
+    [ "$fail" -ne 0 ] && step "FAILED"
+    exit $fail
+fi
 
 step "tt-analyze --strict --warn-unused-ignores timetabling_ga_tpu/"
 JAX_PLATFORMS=cpu python -m timetabling_ga_tpu.analysis --strict \
@@ -101,6 +121,13 @@ if [ "${1:-}" = "--fast" ]; then
     step "incremental re-solve tests (tests/test_edit.py)"
     timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
         tests/test_edit.py -q -p no:cacheprovider -m 'not slow' \
+        || fail=1
+    # tt-prof: parser units, scope-identity A/B, attribution honesty,
+    # hotspot CLI and perf-gate units; the heavy capture e2e is
+    # slow-tiered
+    step "phase profiler tests (tests/test_prof.py)"
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_prof.py -q -p no:cacheprovider -m 'not slow' \
         || fail=1
     [ "$fail" -eq 0 ] && step "OK (fast mode: full test tier skipped)"
     exit $fail

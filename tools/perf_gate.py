@@ -1,0 +1,180 @@
+"""Perf-regression gate: fresh bench numbers vs the committed history.
+
+Compares a freshly produced `python bench.py` JSON (or a driver-style
+`{"n", "cmd", "rc", "tail", "parsed"}` capture of one) against the
+newest committed `BENCH_r0N.json` round and FAILS (exit 1) when a gated
+metric regressed beyond its tolerance band — the check that turns the
+perf history from a post-hoc table (tools/bench_report.py) into a
+merge-time gate (`tools/ci_check.sh --perf`).
+
+Gated metrics and directions:
+
+    gens/s parallel     higher is better   (headline throughput)
+    gens/s scan         higher is better
+    ms/gen sweep128     lower  is better   (sweep LS latency)
+    soak jobs/min       higher is better   (serve throughput)
+
+Both sides go through bench_report's salvage ladder (parsed ->
+tail-JSON -> regex), so a truncated capture still gates on whatever
+metrics survived; a metric missing on EITHER side is reported and
+skipped, never silently passed off as a comparison. The tolerance band
+is deliberately wide by default (25%): CPU bench numbers jitter with
+host load, and a gate that cries wolf gets deleted — it exists to
+catch the 2x cliffs (a lost jit cache, an accidental host sync per
+generation), not 3% noise.
+
+    python tools/perf_gate.py fresh.json                 # vs newest round
+    python tools/perf_gate.py fresh.json --baseline BENCH_r04.json
+    python tools/perf_gate.py fresh.json --tolerance 0.15
+    python tools/perf_gate.py fresh.json --json          # machine-readable
+
+Stdlib-only and device-free, like every tools/ reader.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_report import (  # noqa: E402
+    REPO, _METRICS, _decode_tail_json, _metric, load_bench_round)
+
+# (bench_report column header, direction). direction +1: higher is
+# better (throughput); -1: lower is better (latency).
+GATED = [
+    ("gens/s parallel", +1),
+    ("gens/s scan", +1),
+    ("ms/gen sweep128", -1),
+    ("soak jobs/min", +1),
+]
+
+DEFAULT_TOLERANCE = 0.25
+
+
+def extract_metrics(path: str) -> dict:
+    """bench_report-header -> value for one bench result file.
+
+    Accepts either a raw `python bench.py` JSON document or a driver
+    capture wrapper around one; both run the same salvage ladder so the
+    gate never depends on the capture having been clean.
+    """
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and ("tail" in doc or "parsed" in doc):
+        return load_bench_round(path)["metrics"]
+    if not isinstance(doc, dict):
+        doc = _decode_tail_json(text)
+    metrics = {}
+    for header, leg, key in _METRICS:
+        v = _metric(doc, text, leg, key)
+        if v is not None:
+            metrics[header] = v
+    return metrics
+
+
+def newest_baseline(root: str = REPO):
+    rounds = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    return rounds[-1] if rounds else None
+
+
+def check(fresh: dict, base: dict,
+          tolerance: float = DEFAULT_TOLERANCE) -> list:
+    """Compare gated metrics; one result row per gate.
+
+    A row is a dict {metric, base, fresh, change, status} where
+    `change` is the signed relative change in the GOOD direction
+    (+0.10 = 10% better, -0.30 = 30% worse) and status is "ok",
+    "regression", or "skipped" (metric missing on either side).
+    """
+    rows = []
+    for name, direction in GATED:
+        b, f = base.get(name), fresh.get(name)
+        if b is None or f is None or b == 0:
+            rows.append({"metric": name, "base": b, "fresh": f,
+                         "change": None, "status": "skipped"})
+            continue
+        change = direction * (f - b) / abs(b)
+        rows.append({"metric": name, "base": b, "fresh": f,
+                     "change": change,
+                     "status": ("regression" if change < -tolerance
+                                else "ok")})
+    return rows
+
+
+def render(rows: list, tolerance: float) -> str:
+    lines = [f"== perf gate (tolerance {tolerance:.0%})"]
+    for r in rows:
+        if r["status"] == "skipped":
+            lines.append(f"  {r['metric']:<18} skipped "
+                         f"(base={r['base']} fresh={r['fresh']})")
+        else:
+            lines.append(
+                f"  {r['metric']:<18} base {r['base']:<10.4g} "
+                f"fresh {r['fresh']:<10.4g} "
+                f"{r['change']:+.1%}  {r['status'].upper()}")
+    bad = [r for r in rows if r["status"] == "regression"]
+    compared = [r for r in rows if r["status"] != "skipped"]
+    if not compared:
+        lines.append("  NO metrics comparable — gate cannot pass "
+                     "vacuously")
+    lines.append("  verdict: " + ("REGRESSION" if bad or not compared
+                                  else "pass"))
+    return "\n".join(lines)
+
+
+def main(argv) -> int:
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    tolerance = DEFAULT_TOLERANCE
+    if "--tolerance" in argv:
+        i = argv.index("--tolerance")
+        tolerance = float(argv[i + 1])
+        del argv[i:i + 2]
+    baseline = None
+    if "--baseline" in argv:
+        i = argv.index("--baseline")
+        baseline = argv[i + 1]
+        del argv[i:i + 2]
+    if not argv:
+        print("usage: perf_gate.py <fresh-bench.json> "
+              "[--baseline BENCH_r0N.json] [--tolerance F] [--json]",
+              file=sys.stderr)
+        return 2
+    fresh_path = argv[0]
+    if baseline is None:
+        baseline = newest_baseline()
+        if baseline is None:
+            print("perf_gate: no committed BENCH_r*.json baseline",
+                  file=sys.stderr)
+            return 2
+    try:
+        fresh = extract_metrics(fresh_path)
+        base = extract_metrics(baseline)
+    except OSError as e:
+        print(f"perf_gate: {e}", file=sys.stderr)
+        return 2
+    rows = check(fresh, base, tolerance)
+    compared = [r for r in rows if r["status"] != "skipped"]
+    bad = [r for r in rows if r["status"] == "regression"]
+    ok = bool(compared) and not bad
+    if as_json:
+        print(json.dumps({"baseline": os.path.basename(baseline),
+                          "fresh": os.path.basename(fresh_path),
+                          "tolerance": tolerance, "rows": rows,
+                          "ok": ok}, indent=2))
+    else:
+        print(f"baseline: {baseline}")
+        print(render(rows, tolerance))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
